@@ -1,0 +1,18 @@
+"""maelstrom_tpu: a TPU-native workbench for toy distributed systems.
+
+A brand-new framework with the capabilities of Maelstrom (reference:
+jepsen-io/maelstrom): simulated networks with latency distributions, message
+loss and partitions; Jepsen-style workload generators, histories and fault
+injection; built-in consistency services; network journals and Lamport
+diagrams; and checkers up to linearizability and strict serializability.
+
+Instead of one OS process per node (reference `process.clj:168-215`), nodes
+are rows of device arrays stepped in lockstep by jitted/vmapped JAX state
+machines; the network is scatter/gather over a node-id axis
+(reference `net.clj:188-246` becomes `maelstrom_tpu.net.tpu`); faults are
+boolean masks. A host compatibility path (`maelstrom_tpu.process`) still runs
+external node binaries over newline-delimited JSON stdio, exactly like the
+reference.
+"""
+
+__version__ = "0.1.0"

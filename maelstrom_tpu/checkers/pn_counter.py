@@ -1,0 +1,45 @@
+"""PN-counter interval-arithmetic checker.
+
+Verifies that every final read is the sum of all known-completed adds plus
+any subset of possibly-completed (indeterminate) adds. This is the same
+interval-set algorithm as the reference (`workload/pn_counter.clj:79-125`):
+start with the definite sum, then for each indeterminate add union in a
+shifted copy of the acceptable set. Output format matches the reference
+checker exactly (see `test/maelstrom/workload/pn_counter_test.clj:7-36`).
+"""
+
+from __future__ import annotations
+
+from . import Checker
+from ..history import coerce_history
+from ..intervals import IntervalSet
+
+
+class PNCounterChecker(Checker):
+    name = "pn-counter"
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        adds = [o for o in history if o.f == "add"]
+        definite_sum = sum(o.value for o in adds if o.is_ok())
+
+        acceptable = IntervalSet([(definite_sum, definite_sum)])
+        for add in adds:
+            if add.is_info():
+                # The add may or may not have happened: allow both outcomes
+                # (reference `pn_counter.clj:100-109`).
+                acceptable = acceptable.union(acceptable.shift(add.value))
+
+        reads = [o for o in history if o.final and o.is_ok()]
+        errors = []
+        for r in reads:
+            assert isinstance(r.value, int), (
+                "fractional reads break the interval arithmetic "
+                f"(got {r.value!r})")
+            if r.value not in acceptable:
+                errors.append(r.to_dict())
+
+        return {"valid": not errors,
+                "errors": errors or None,
+                "final-reads": [r.value for r in reads],
+                "acceptable": acceptable.to_vecs()}

@@ -1,0 +1,152 @@
+"""Operation histories.
+
+The history is the framework's core observable artifact: a list of operations
+recorded by client worker threads, in the Jepsen style the reference inherits.
+Each operation appears (usually) twice: once as an `invoke` and once as a
+completion (`ok`, `fail`, or `info`):
+
+  - invoke: the client began the operation
+  - ok:     the operation definitely completed
+  - fail:   the operation definitely did NOT take place (definite errors,
+            reference `client.clj:214-233`)
+  - info:   the outcome is unknown (timeouts / indefinite errors); the op may
+            take effect at any later time
+
+Checkers are pure functions of histories (reference test strategy,
+`test/maelstrom/workload/pn_counter_test.clj`), so Op is a plain dataclass
+that round-trips to JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+
+@dataclass
+class Op:
+    type: str                   # invoke | ok | fail | info
+    f: Optional[str] = None     # e.g. "read", "add", "broadcast", "txn"
+    value: Any = None
+    process: Any = None         # worker thread id or :nemesis
+    time: int = 0               # nanoseconds since test start (virtual or real)
+    index: int = -1             # position in the history
+    error: Any = None
+    final: bool = False         # marks final reads (pn-counter/set checkers)
+
+    def is_invoke(self):
+        return self.type == INVOKE
+
+    def is_ok(self):
+        return self.type == OK
+
+    def is_fail(self):
+        return self.type == FAIL
+
+    def is_info(self):
+        return self.type == INFO
+
+    def to_dict(self) -> dict:
+        d = {"index": self.index, "type": self.type, "f": self.f,
+             "value": self.value, "process": self.process, "time": self.time}
+        if self.error is not None:
+            d["error"] = self.error
+        if self.final:
+            d["final"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Op":
+        return cls(type=d["type"], f=d.get("f"), value=d.get("value"),
+                   process=d.get("process"), time=d.get("time", 0),
+                   index=d.get("index", -1), error=d.get("error"),
+                   final=d.get("final", False))
+
+
+def op(type: str, f=None, value=None, **kw) -> Op:
+    return Op(type=type, f=f, value=value, **kw)
+
+
+class History:
+    """An indexed operation history with invoke/completion pairing
+    (the analogue of knossos.history/pair-index used by the echo checker,
+    reference `workload/echo.clj:49-63`)."""
+
+    def __init__(self, ops: Iterable[Op] = ()):
+        self.ops: list[Op] = []
+        for o in ops:
+            self.append(o)
+
+    def append(self, o: Op) -> Op:
+        if o.index < 0:
+            o.index = len(self.ops)
+        self.ops.append(o)
+        return o
+
+    def __iter__(self):
+        return iter(self.ops)
+
+    def __len__(self):
+        return len(self.ops)
+
+    def __getitem__(self, i):
+        return self.ops[i]
+
+    def pairs(self) -> list[tuple[Op, Optional[Op]]]:
+        """Pairs each invoke with its completion (same process, next
+        occurrence). Returns [(invoke, completion-or-None), ...]."""
+        out = []
+        open_by_process: dict[Any, int] = {}
+        for o in self.ops:
+            if o.type == INVOKE:
+                open_by_process[o.process] = len(out)
+                out.append((o, None))
+            elif o.process in open_by_process:
+                i = open_by_process.pop(o.process)
+                out[i] = (out[i][0], o)
+        return out
+
+    def completions(self) -> list[Op]:
+        return [o for o in self.ops if o.type in (OK, FAIL, INFO)]
+
+    def oks(self) -> list[Op]:
+        return [o for o in self.ops if o.type == OK]
+
+    def invokes(self) -> list[Op]:
+        return [o for o in self.ops if o.type == INVOKE]
+
+    def client_ops(self) -> list[Op]:
+        return [o for o in self.ops if o.process != "nemesis"]
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(o.to_dict(), default=str)
+                         for o in self.ops)
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "History":
+        h = cls()
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                h.append(Op.from_dict(json.loads(line)))
+        return h
+
+
+def coerce_history(history) -> History:
+    """Accepts a History, a list of Ops, or a list of dicts (fixture
+    style, mirroring the reference's literal-history checker tests)."""
+    if isinstance(history, History):
+        return history
+    h = History()
+    for o in history:
+        if isinstance(o, Op):
+            h.append(o)
+        else:
+            h.append(Op.from_dict(o))
+    return h

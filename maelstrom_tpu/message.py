@@ -1,0 +1,83 @@
+"""Network messages (reference: src/maelstrom/net/message.clj).
+
+Messages always have a `src`, `dest`, and `body`; an `id` is assigned
+internally by the network (reference `net.clj:26-32`, `message.clj:8-25`).
+Bodies are arbitrary JSON objects at this (host) layer; the TPU network core
+uses a fixed-width integer encoding (`maelstrom_tpu.net.tpu.BodyCodec`) and
+converts at the host boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class Message:
+    id: int
+    src: str
+    dest: str
+    body: Any
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "src": self.src, "dest": self.dest,
+                "body": self.body}
+
+
+def message(src: str, dest: str, body, id: int = -1) -> Message:
+    """Constructs a new Message. If no ID is provided, uses -1
+    (reference `message.clj:10-15`)."""
+    return Message(id=id, src=src, dest=dest, body=body)
+
+
+class MalformedMessage(Exception):
+    def __init__(self, msg, why: str):
+        self.message = msg
+        super().__init__(why)
+
+
+def validate(m) -> Message:
+    """Checks that a message is well-formed (reference `message.clj:17-25`,
+    `net.clj:165-175`)."""
+    if not isinstance(m, Message):
+        raise MalformedMessage(m, f"Expected message {m!r} to be a Message")
+    if not m.src:
+        raise MalformedMessage(m, f"No source for message {m!r}")
+    if not m.dest:
+        raise MalformedMessage(m, f"No destination for message {m!r}")
+    if not isinstance(m.body, dict):
+        raise MalformedMessage(
+            m, f"Message body must be an object, got {m.body!r}")
+    return m
+
+
+def parse_msg(node_id: str, line: str) -> Message:
+    """Parses a JSON line printed by a node process into a Message, with
+    teaching errors (reference `process.clj:35-66`)."""
+    import json
+    try:
+        parsed = json.loads(line)
+    except json.JSONDecodeError:
+        raise MalformedMessage(
+            line,
+            f"Node {node_id} printed a line to STDOUT which was not "
+            f"well-formed JSON:\n{line}\nDid you mean to encode this line as "
+            "JSON? Or was this line intended for STDERR? See doc/protocol.md "
+            "for more guidance.")
+    if not isinstance(parsed, dict) or not isinstance(parsed.get("body"), dict):
+        raise MalformedMessage(
+            parsed,
+            f"Malformed network message. Node {node_id} tried to send the "
+            f"following message via STDOUT:\n\n{line}\n\nMessages must be "
+            "JSON objects with src, dest, and an object body. See "
+            "doc/protocol.md for more guidance.")
+    m = Message(id=int(parsed.get("id", -1)), src=parsed.get("src"),
+                dest=parsed.get("dest"), body=parsed["body"])
+    if not m.src or not m.dest:
+        raise MalformedMessage(
+            parsed,
+            f"Malformed network message from node {node_id}: messages "
+            f"require both src and dest:\n\n{line}\n\nSee doc/protocol.md "
+            "for more guidance.")
+    return m

@@ -1,0 +1,189 @@
+"""A tiny structural schema language for message bodies.
+
+Fills the role of prismatic/schema in the reference: every RPC request and
+response body is validated at the boundary, and schema violations become rich
+teaching errors (reference `client.clj:242-273`, `process.clj:56-65`).
+Schemas also render to readable JSON-ish text for the generated docs
+(doc/workloads.md), mirroring `doc.clj`'s use of `s/explain`.
+
+Schema language:
+  Eq(x)                 -- exactly the value x
+  Any                   -- anything
+  int / str / bool      -- Python type atoms
+  [schema]              -- list of schema
+  Tup(s1, s2, ...)      -- fixed-length positional sequence
+  Either(s1, s2, ...)   -- any of the alternatives
+  {key: schema, ...}    -- map; string keys required, Optional(key) optional
+  Optional(key)         -- marks a map key optional
+"""
+
+from __future__ import annotations
+
+
+class _Any:
+    def __repr__(self):
+        return "any"
+
+
+Any = _Any()
+
+
+class Eq:
+    def __init__(self, value):
+        self.value = value
+
+    def __repr__(self):
+        import json
+        return json.dumps(self.value)
+
+
+class Optional:
+    def __init__(self, key: str):
+        self.key = key
+
+    def __hash__(self):
+        return hash(("optional", self.key))
+
+    def __eq__(self, other):
+        return isinstance(other, Optional) and other.key == self.key
+
+    def __repr__(self):
+        return f"{self.key}?"
+
+
+class Either:
+    def __init__(self, *alts):
+        self.alts = alts
+
+    def __repr__(self):
+        return " | ".join(repr(explain(a)) for a in self.alts)
+
+
+class Tup:
+    """Fixed-length heterogeneous sequence, e.g. txn micro-ops
+    (reference `txn_list_append.clj:55-59`)."""
+
+    def __init__(self, *parts):
+        self.parts = parts
+
+
+def check(schema, data):
+    """Returns None if data conforms to schema, else an 'explanation'
+    structure mirroring the shape of the data (like schema.core checkers,
+    reference `client.clj:242-247`)."""
+    if schema is Any or schema is None:
+        return None
+    if isinstance(schema, Eq):
+        if data != schema.value:
+            return f"expected {schema.value!r}, got {data!r}"
+        return None
+    if schema is int:
+        # bool is an int subtype in Python; exclude it.
+        if isinstance(data, bool) or not isinstance(data, int):
+            return f"expected an integer, got {data!r}"
+        return None
+    if schema is str:
+        if not isinstance(data, str):
+            return f"expected a string, got {data!r}"
+        return None
+    if schema is bool:
+        if not isinstance(data, bool):
+            return f"expected a boolean, got {data!r}"
+        return None
+    if isinstance(schema, Either):
+        errs = []
+        for alt in schema.alts:
+            e = check(alt, data)
+            if e is None:
+                return None
+            errs.append(e)
+        return {"none-of": errs}
+    if isinstance(schema, Tup):
+        if not isinstance(data, (list, tuple)):
+            return f"expected a {len(schema.parts)}-element array, got {data!r}"
+        if len(data) != len(schema.parts):
+            return (f"expected a {len(schema.parts)}-element array, "
+                    f"got {len(data)} elements")
+        errs = [check(p, d) for p, d in zip(schema.parts, data)]
+        if any(e is not None for e in errs):
+            return errs
+        return None
+    if isinstance(schema, list):
+        assert len(schema) == 1, "list schemas take a single element schema"
+        if not isinstance(data, (list, tuple)):
+            return f"expected an array, got {data!r}"
+        errs = {i: e for i, d in enumerate(data)
+                if (e := check(schema[0], d)) is not None}
+        return errs or None
+    if isinstance(schema, dict):
+        if not isinstance(data, dict):
+            return f"expected an object, got {data!r}"
+        errs = {}
+        seen = set()
+        for k, vschema in schema.items():
+            optional = isinstance(k, Optional)
+            key = k.key if optional else k
+            # map-key schemas (e.g. {NodeId: [NodeId]}) — any-key maps
+            if key is str or key is Any:
+                for dk, dv in data.items():
+                    seen.add(dk)
+                    if key is str and not isinstance(dk, str):
+                        errs[dk] = "key should be a string"
+                    e = check(vschema, dv)
+                    if e is not None:
+                        errs[dk] = e
+                continue
+            seen.add(key)
+            if key not in data:
+                if not optional:
+                    errs[key] = "missing required key"
+                continue
+            e = check(vschema, data[key])
+            if e is not None:
+                errs[key] = e
+        for dk in data:
+            if dk not in seen:
+                errs[dk] = "disallowed key"
+        return errs or None
+    # Literal atom fallback
+    if data != schema:
+        return f"expected {schema!r}, got {data!r}"
+    return None
+
+
+def explain(schema):
+    """Renders a schema as a JSON-ish plain structure for docs and error
+    messages (the analogue of schema.core's `explain`)."""
+    if schema is Any:
+        return "any"
+    if schema is int:
+        return "int"
+    if schema is str:
+        return "string"
+    if schema is bool:
+        return "bool"
+    if isinstance(schema, Eq):
+        return schema.value
+    if isinstance(schema, Either):
+        return {"either": [explain(a) for a in schema.alts]}
+    if isinstance(schema, Tup):
+        return [explain(p) for p in schema.parts]
+    if isinstance(schema, list):
+        return [explain(schema[0])]
+    if isinstance(schema, dict):
+        out = {}
+        for k, v in schema.items():
+            if isinstance(k, Optional):
+                out[f"{k.key}?"] = explain(v)
+            elif k is str:
+                out["<string>"] = explain(v)
+            else:
+                out[k] = explain(v)
+        return out
+    return repr(schema)
+
+
+def format_schema(schema, indent: int = 0) -> str:
+    """Pretty-prints an explained schema, JSON-style."""
+    import json
+    return json.dumps(explain(schema), indent=2, default=str)

@@ -1,0 +1,37 @@
+"""Kitchen sink utilities (reference: src/maelstrom/util.clj)."""
+
+from __future__ import annotations
+
+import re
+
+
+def is_client(node_id: str) -> bool:
+    """Is a given node id a client? (reference `util.clj:7-10`)"""
+    return bool(node_id) and node_id[0] == "c"
+
+
+def involves_client(message) -> bool:
+    """Does a given network message involve a client? (`util.clj:12-16`)"""
+    return is_client(message.src) or is_client(message.dest)
+
+
+_NODE_RE = re.compile(r"(\w+?)(\d+)")
+
+
+def node_sort_key(node_id: str):
+    """Natural sort key for node ids: 'c2' < 'c10', services last
+    (reference `util.clj:18-28`)."""
+    m = _NODE_RE.fullmatch(node_id)
+    if m:
+        return (0, m.group(1), int(m.group(2)))
+    return (1, node_id, 0)
+
+
+def sort_clients(node_ids):
+    """Sorts a collection of node ids naturally (`util.clj:18-28`)."""
+    return sorted(node_ids, key=node_sort_key)
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n."""
+    return n // 2 + 1

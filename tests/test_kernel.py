@@ -1,0 +1,234 @@
+"""Unit tests for the pure-Python kernel: errors, schema, intervals,
+history, and the fixture-tested checkers (reference test strategy §4:
+checkers are pure functions of histories)."""
+
+import pytest
+
+from maelstrom_tpu import errors, schema, util
+from maelstrom_tpu.history import History, Op
+from maelstrom_tpu.intervals import IntervalSet
+from maelstrom_tpu.checkers import Compose, Stats, merge_valid
+from maelstrom_tpu.checkers.pn_counter import PNCounterChecker
+from maelstrom_tpu.checkers.echo import EchoChecker
+from maelstrom_tpu.checkers.set_full import SetFullChecker
+
+
+# --- errors ---
+
+def test_error_registry_codes():
+    # The standard error table (reference client.clj:57-100)
+    assert errors.ERROR_REGISTRY[0].name == "timeout"
+    assert not errors.ERROR_REGISTRY[0].definite
+    assert errors.ERROR_REGISTRY[1].definite
+    assert errors.ERROR_REGISTRY[13].name == "crash"
+    assert not errors.ERROR_REGISTRY[13].definite
+    assert errors.ERROR_REGISTRY[14].definite
+
+
+def test_duplicate_error_raises():
+    with pytest.raises(errors.DuplicateError):
+        errors.deferror(0, "other-name", "different doc")
+
+
+def test_rpc_error():
+    e = errors.RPCError(14, {"text": "nope"})
+    assert e.definite and e.name == "abort"
+    t = errors.Timeout()
+    assert not t.definite and t.code == 0
+
+
+# --- util ---
+
+def test_client_ids():
+    assert util.is_client("c1") and not util.is_client("n1")
+    assert util.sort_clients(["c10", "c2", "lin-kv", "c1"]) == \
+        ["c1", "c2", "c10", "lin-kv"]
+
+
+# --- schema ---
+
+def test_schema_check():
+    s = {"type": schema.Eq("echo"), "echo": schema.Any, "msg_id": int}
+    assert schema.check(s, {"type": "echo", "echo": [1], "msg_id": 3}) is None
+    assert schema.check(s, {"type": "echo", "msg_id": 3}) == \
+        {"echo": "missing required key"}
+    assert schema.check(s, {"type": "nope", "echo": 1, "msg_id": 3})
+    assert schema.check(s, {"type": "echo", "echo": 1, "msg_id": "x"})
+    # disallowed extra keys
+    assert schema.check(s, {"type": "echo", "echo": 1, "msg_id": 3, "z": 1})
+
+
+def test_schema_tuple_either():
+    micro = schema.Either(
+        schema.Tup(schema.Eq("r"), schema.Any, schema.Eq(None)),
+        schema.Tup(schema.Eq("append"), schema.Any, schema.Any))
+    assert schema.check([micro], [["r", 5, None], ["append", 5, 3]]) is None
+    assert schema.check([micro], [["r", 5, 3]])  # read with value: invalid
+
+
+def test_schema_map_of_any_keys():
+    s = {str: [str]}
+    assert schema.check(s, {"n0": ["n1"], "n1": ["n0"]}) is None
+    assert schema.check(s, {"n0": "n1"})
+
+
+# --- intervals ---
+
+def test_interval_set_merge_adjacent():
+    s = IntervalSet([(0, 0)])
+    s.add(1, 2)
+    assert s.to_vecs() == [[0, 2]]
+    s.add(5, 6)
+    assert s.to_vecs() == [[0, 2], [5, 6]]
+    s.add(3, 4)
+    assert s.to_vecs() == [[0, 6]]
+    assert 4 in s and 7 not in s and -1 not in s
+
+
+def test_interval_shift_union():
+    s = IntervalSet([(5, 5)])
+    s2 = s.union(s.shift(3))
+    assert s2.to_vecs() == [[5, 5], [8, 8]]
+
+
+# --- pn-counter checker (fixtures from the reference's own unit test,
+# test/maelstrom/workload/pn_counter_test.clj:7-36) ---
+
+def check_pn(history):
+    return PNCounterChecker().check({}, history)
+
+
+def test_pn_counter_empty():
+    r = check_pn([])
+    assert r == {"valid": True, "errors": None, "final-reads": [],
+                 "acceptable": [[0, 0]]}
+
+
+def test_pn_counter_definite():
+    r = check_pn([
+        {"type": "ok", "f": "add", "value": 2},
+        {"type": "ok", "f": "add", "value": 3},
+        {"type": "ok", "f": "read", "final": True, "value": 5},
+        {"type": "ok", "f": "read", "final": True, "value": 4},
+    ])
+    assert r["valid"] is False
+    assert r["final-reads"] == [5, 4]
+    assert r["acceptable"] == [[5, 5]]
+    assert len(r["errors"]) == 1 and r["errors"][0]["value"] == 4
+
+
+def test_pn_counter_indefinite():
+    r = check_pn([
+        {"type": "ok", "f": "add", "value": 10},
+        {"type": "info", "f": "add", "value": 5},
+        {"type": "info", "f": "add", "value": -1},
+        {"type": "info", "f": "add", "value": -1},
+        {"type": "ok", "f": "read", "final": True, "value": 11},
+        {"type": "ok", "f": "read", "final": True, "value": 15},
+    ])
+    assert r["valid"] is False
+    assert r["final-reads"] == [11, 15]
+    assert r["acceptable"] == [[8, 10], [13, 15]]
+    assert [e["value"] for e in r["errors"]] == [11]
+
+
+# --- echo checker ---
+
+def test_echo_checker():
+    h = [
+        {"type": "invoke", "f": "echo", "value": "hi", "process": 0, "time": 0},
+        {"type": "ok", "f": "echo", "value": {"type": "echo_ok", "echo": "hi"},
+         "process": 0, "time": 1},
+    ]
+    assert EchoChecker().check({}, h)["valid"] is True
+    h[1]["value"] = {"type": "echo_ok", "echo": "bye"}
+    assert EchoChecker().check({}, h)["valid"] is False
+
+
+# --- set-full checker ---
+
+MS = 1_000_000  # ns per ms
+
+
+def _add(p, t, v, ok=True):
+    return [
+        {"type": "invoke", "f": "add", "value": v, "process": p, "time": t},
+        {"type": "ok" if ok else "info", "f": "add", "value": v,
+         "process": p, "time": t + MS},
+    ]
+
+
+def _read(p, t, els, final=False):
+    return [
+        {"type": "invoke", "f": "read", "value": None, "process": p,
+         "time": t},
+        {"type": "ok", "f": "read", "value": els, "process": p,
+         "time": t + MS, "final": final},
+    ]
+
+
+def test_set_full_stable():
+    h = (_add(0, 0, 1) + _add(0, 2 * MS, 2) +
+         _read(1, 10 * MS, [1, 2], final=True))
+    r = SetFullChecker().check({}, h)
+    assert r["valid"] is True
+    assert r["stable-count"] == 2 and r["lost-count"] == 0
+
+
+def test_set_full_lost():
+    h = (_add(0, 0, 1) + _add(0, 2 * MS, 2) +
+         _read(1, 10 * MS, [1], final=True))
+    r = SetFullChecker().check({}, h)
+    assert r["valid"] is False
+    assert r["lost"] == [2]
+
+
+def test_set_full_unacked_absent_ok():
+    # An indeterminate add that never shows up makes no claim
+    h = (_add(0, 0, 1) + _add(0, 2 * MS, 2, ok=False) +
+         _read(1, 10 * MS, [1], final=True))
+    r = SetFullChecker().check({}, h)
+    assert r["valid"] is True
+
+
+def test_set_full_stale_then_stable():
+    # Element 1 acked at ~1ms, missing from a read at 5ms, present at 20ms:
+    # stale but stable.
+    h = (_add(0, 0, 1) + _read(1, 5 * MS, []) + _read(1, 20 * MS, [1]))
+    r = SetFullChecker().check({}, h)
+    assert r["valid"] is True
+    assert r["stale"] == [1] and r["stable-count"] == 1
+
+
+def test_set_full_no_reads_unknown():
+    r = SetFullChecker().check({}, _add(0, 0, 1))
+    assert r["valid"] == "unknown"
+
+
+# --- compose / stats ---
+
+def test_compose_and_stats():
+    h = (_add(0, 0, 1) + _read(1, 5 * MS, [1], final=True))
+    c = Compose({"set": SetFullChecker(), "stats": Stats()})
+    r = c.check({}, h)
+    assert r["valid"] is True
+    assert r["stats"]["by-f"]["add"]["ok-count"] == 1
+    assert merge_valid([True, "unknown"]) == "unknown"
+    assert merge_valid([True, False, "unknown"]) is False
+
+
+# --- history pairing ---
+
+def test_history_pairs():
+    h = History([
+        Op(type="invoke", f="read", process=0, time=0),
+        Op(type="invoke", f="read", process=1, time=1),
+        Op(type="ok", f="read", process=1, time=2),
+        Op(type="info", f="read", process=0, time=3),
+    ])
+    pairs = h.pairs()
+    assert len(pairs) == 2
+    assert pairs[0][1].type == "info" and pairs[1][1].type == "ok"
+    # JSON round-trip
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert [o.to_dict() for o in h2] == [o.to_dict() for o in h]
