@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Broadcast demo node with neighbor gossip and retry until acknowledged,
+so values survive partitions (counterpart of demo/ruby/broadcast.rb)."""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+node = Node()
+lock = threading.Lock()
+messages = set()
+neighbors = []
+unacked = {}        # neighbor -> set of values not yet acknowledged
+
+
+@node.on("topology")
+def topology(msg):
+    global neighbors
+    with lock:
+        neighbors = msg["body"]["topology"].get(node.node_id, [])
+        for n in neighbors:
+            unacked.setdefault(n, set())
+    node.log(f"My neighbors are {neighbors}")
+    node.reply(msg, {"type": "topology_ok"})
+
+
+def accept(value, sender=None):
+    with lock:
+        if value in messages:
+            return
+        messages.add(value)
+        for n in neighbors:
+            if n != sender:
+                unacked[n].add(value)
+
+
+@node.on("broadcast")
+def broadcast(msg):
+    accept(msg["body"]["message"], sender=msg["src"])
+    if msg["body"].get("msg_id") is not None:
+        node.reply(msg, {"type": "broadcast_ok"})
+
+
+@node.on("read")
+def read(msg):
+    with lock:
+        vals = sorted(messages)
+    node.reply(msg, {"type": "read_ok", "messages": vals})
+
+
+@node.every(0.5)
+def retry():
+    """Re-send unacknowledged values to neighbors until they ack."""
+    with lock:
+        pending = [(n, v) for n, vs in unacked.items() for v in vs]
+    for n, v in pending:
+        def on_ack(reply, n=n, v=v):
+            with lock:
+                unacked.get(n, set()).discard(v)
+        node.rpc(n, {"type": "broadcast", "message": v}, on_ack)
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""Echo server demo node (counterpart of demo/ruby/echo.rb)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+node = Node()
+
+
+@node.on("echo")
+def echo(msg):
+    node.reply(msg, {"type": "echo_ok", "echo": msg["body"]["echo"]})
+
+
+if __name__ == "__main__":
+    node.run()

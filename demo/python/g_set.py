@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Grow-only set demo node: periodic full-state gossip CRDT
+(counterpart of demo/ruby/g_set.rb)."""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+node = Node()
+lock = threading.Lock()
+elements = set()
+
+
+@node.on("add")
+def add(msg):
+    with lock:
+        elements.add(msg["body"]["element"])
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    with lock:
+        vals = sorted(elements)
+    node.reply(msg, {"type": "read_ok", "value": vals})
+
+
+@node.on("replicate")
+def replicate(msg):
+    with lock:
+        elements.update(msg["body"]["value"])
+
+
+@node.every(0.7)
+def gossip():
+    with lock:
+        vals = sorted(elements)
+    for other in node.node_ids:
+        if other != node.node_id:
+            node.send_msg(other, {"type": "replicate", "value": vals})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,181 @@
+"""A tiny node library for writing Maelstrom-protocol nodes in Python.
+
+The userland counterpart of the reference's per-language node libraries
+(demo/ruby/node.rb): handler registration, replies, async RPCs with
+callbacks, synchronous RPCs, and periodic tasks — speaking newline-delimited
+JSON on stdin/stdout and logging to stderr (doc/protocol.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, text: str):
+        self.code = code
+        self.text = text
+        super().__init__(text)
+
+    def to_body(self) -> dict:
+        return {"type": "error", "code": self.code, "text": self.text}
+
+    @classmethod
+    def timeout(cls, text):
+        return cls(0, text)
+
+    @classmethod
+    def not_supported(cls, text):
+        return cls(10, text)
+
+    @classmethod
+    def temporarily_unavailable(cls, text):
+        return cls(11, text)
+
+    @classmethod
+    def abort(cls, text):
+        return cls(14, text)
+
+    @classmethod
+    def key_does_not_exist(cls, text):
+        return cls(20, text)
+
+    @classmethod
+    def precondition_failed(cls, text):
+        return cls(22, text)
+
+    @classmethod
+    def txn_conflict(cls, text):
+        return cls(30, text)
+
+
+class Node:
+    def __init__(self):
+        self.node_id = None
+        self.node_ids = []
+        self.next_msg_id = 0
+        self.handlers = {}
+        self.callbacks = {}
+        self.periodic = []          # (interval_s, fn)
+        self.lock = threading.RLock()
+        self.log_lock = threading.Lock()
+
+        @self.on("init")
+        def handle_init(msg):
+            self.node_id = msg["body"]["node_id"]
+            self.node_ids = msg["body"]["node_ids"]
+            self.log(f"Node {self.node_id} initialized")
+            self.reply(msg, {"type": "init_ok"})
+            for interval, fn in self.periodic:
+                t = threading.Thread(target=self._every, args=(interval, fn),
+                                     daemon=True)
+                t.start()
+
+    # --- registration ---
+
+    def on(self, type: str):
+        def register(fn):
+            if type in self.handlers:
+                raise KeyError(f"already a handler for {type}")
+            self.handlers[type] = fn
+            return fn
+        return register
+
+    def every(self, interval_s: float):
+        def register(fn):
+            self.periodic.append((interval_s, fn))
+            return fn
+        return register
+
+    def _every(self, interval_s, fn):
+        while True:
+            time.sleep(interval_s)
+            try:
+                fn()
+            except Exception as e:
+                self.log(f"periodic task error: {e!r}")
+
+    # --- I/O ---
+
+    def log(self, text: str):
+        with self.log_lock:
+            print(text, file=sys.stderr, flush=True)
+
+    def send_msg(self, dest: str, body: dict):
+        msg = {"src": self.node_id, "dest": dest, "body": body}
+        with self.lock:
+            print(json.dumps(msg), flush=True)
+
+    def reply(self, request: dict, body: dict):
+        body = dict(body, in_reply_to=request["body"]["msg_id"])
+        self.send_msg(request["src"], body)
+
+    def rpc(self, dest: str, body: dict, callback=None):
+        """Fire an RPC; callback(msg) runs on the reply."""
+        with self.lock:
+            self.next_msg_id += 1
+            msg_id = self.next_msg_id
+            if callback is not None:
+                self.callbacks[msg_id] = callback
+        self.send_msg(dest, dict(body, msg_id=msg_id))
+        return msg_id
+
+    def sync_rpc(self, dest: str, body: dict, timeout_s: float = 5.0) -> dict:
+        """Blocking RPC; raises RPCError on error replies or timeout."""
+        done = threading.Event()
+        box = {}
+
+        def cb(msg):
+            box["msg"] = msg
+            done.set()
+        self.rpc(dest, body, cb)
+        if not done.wait(timeout_s):
+            raise RPCError.timeout(f"RPC to {dest} timed out")
+        rbody = box["msg"]["body"]
+        if rbody.get("type") == "error":
+            raise RPCError(rbody.get("code", 13), rbody.get("text", ""))
+        return rbody
+
+    # --- main loop ---
+
+    def handle(self, msg: dict):
+        body = msg.get("body", {})
+        reply_to = body.get("in_reply_to")
+        if reply_to is not None:
+            with self.lock:
+                cb = self.callbacks.pop(reply_to, None)
+            if cb:
+                cb(msg)
+            return
+        handler = self.handlers.get(body.get("type"))
+        if handler is None:
+            if body.get("msg_id") is not None:
+                self.reply(msg, RPCError.not_supported(
+                    f"don't know how to handle {body.get('type')!r}"
+                ).to_body())
+            return
+        try:
+            handler(msg)
+        except RPCError as e:
+            self.reply(msg, e.to_body())
+        except Exception as e:
+            self.log(f"handler error: {e!r}")
+            self.reply(msg, RPCError(13, repr(e)).to_body())
+
+    def run(self, threaded: bool = True):
+        """Reads messages from stdin forever. With threaded=True each
+        message is handled on its own thread (like the ruby node lib), so
+        sync RPCs inside handlers don't deadlock the loop."""
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            if threaded:
+                threading.Thread(target=self.handle, args=(msg,),
+                                 daemon=True).start()
+            else:
+                self.handle(msg)

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""PN-counter demo node: a pair of G-counters (increments/decrements) with
+periodic gossip merge (counterpart of demo/ruby/pn_counter.rb)."""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+node = Node()
+lock = threading.Lock()
+inc = {}    # node_id -> sum of positive deltas observed locally
+dec = {}    # node_id -> sum of negative magnitude
+
+
+def merge(mine, theirs):
+    for k, v in theirs.items():
+        mine[k] = max(mine.get(k, 0), v)
+
+
+@node.on("add")
+def add(msg):
+    delta = msg["body"]["delta"]
+    with lock:
+        if delta >= 0:
+            inc[node.node_id] = inc.get(node.node_id, 0) + delta
+        else:
+            dec[node.node_id] = dec.get(node.node_id, 0) - delta
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    with lock:
+        value = sum(inc.values()) - sum(dec.values())
+    node.reply(msg, {"type": "read_ok", "value": value})
+
+
+@node.on("replicate")
+def replicate(msg):
+    with lock:
+        merge(inc, msg["body"]["inc"])
+        merge(dec, msg["body"]["dec"])
+
+
+@node.every(0.7)
+def gossip():
+    with lock:
+        body = {"type": "replicate", "inc": dict(inc), "dec": dict(dec)}
+    for other in node.node_ids:
+        if other != node.node_id:
+            node.send_msg(other, body)
+
+
+if __name__ == "__main__":
+    node.run()

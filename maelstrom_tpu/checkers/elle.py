@@ -1,0 +1,299 @@
+"""Elle-lite: transactional consistency checking for list-append workloads.
+
+The reference delegates txn-list-append checking to Elle via
+jepsen.tests.cycle.append (`workload/txn_list_append.clj:112-124`), checking
+up to strict serializability. This is a from-scratch implementation of the
+core of Elle's list-append analysis:
+
+1. Per key, infer the version order from the longest observed list; every
+   read must be a *prefix* of it (list semantics), else `incompatible-order`.
+2. Direct anomalies: G1a (aborted read: observing a value whose append
+   failed), G1b (intermediate read: observing a state mid-transaction),
+   duplicate elements.
+3. Dependency graph over transactions: ww (version succession), wr (read
+   observes a version), rw (anti-dependency: read of v precedes writer of
+   v+1), plus rt (real-time) edges for strict serializability.
+4. Cycle detection via Tarjan SCC; cycles are classified G0 (write cycle),
+   G1c (ww/wr cycle), G-single (one rw edge), G2 (multiple rw edges).
+
+Consistency models map to which anomalies are violations:
+  read-uncommitted:    G0, dirty reads of aborted state (G1a)
+  read-committed:      + G1b, G1c
+  serializable:        + G-single, G2 (ignoring rt edges)
+  strict-serializable: + the same over the graph including rt edges
+"""
+
+from __future__ import annotations
+
+from . import Checker
+from ..history import coerce_history
+
+MODELS = ["read-uncommitted", "read-committed", "serializable",
+          "strict-serializable"]
+
+
+def _txn_ops(history):
+    """Extracts transactions: [{id, txn (completed micro-ops), ok, invoke,
+    complete}]. fail txns definitely didn't execute; info txns may have."""
+    txns = []
+    for invoke, complete in history.pairs():
+        if invoke.f != "txn":
+            continue
+        if complete is not None and complete.is_fail():
+            continue
+        ok = complete is not None and complete.is_ok()
+        micro = (complete.value if ok else invoke.value) or []
+        txns.append({"id": len(txns), "micro": micro, "ok": ok,
+                     "inv": invoke.time,
+                     "ret": complete.time if ok else float("inf")})
+    return txns
+
+
+def _fail_appends(history):
+    out = set()
+    for invoke, complete in history.pairs():
+        if invoke.f != "txn" or complete is None or not complete.is_fail():
+            continue
+        for f, k, v in invoke.value or []:
+            if f == "append":
+                out.add((_hk(k), _hv(v)))
+    return out
+
+
+def _hk(k):
+    return repr(k)
+
+
+def _hv(v):
+    return repr(v)
+
+
+def analyze(history) -> dict:
+    history = coerce_history(history)
+    txns = _txn_ops(history)
+    failed_appends = _fail_appends(history)
+
+    anomalies: dict[str, list] = {}
+
+    def add_anom(kind, item):
+        anomalies.setdefault(kind, []).append(item)
+
+    # appender[(k, v)] = txn id; per-txn appends per key (order within txn)
+    appender: dict = {}
+    txn_appends: dict = {}      # txn id -> {key: [values]}
+    for t in txns:
+        per_key = {}
+        for f, k, v in t["micro"]:
+            if f == "append":
+                kk, vv = _hk(k), _hv(v)
+                if (kk, vv) in appender:
+                    add_anom("duplicate-appends", {"key": k, "value": v})
+                appender[(kk, vv)] = t["id"]
+                per_key.setdefault(kk, []).append(vv)
+        txn_appends[t["id"]] = per_key
+
+    # Longest observed list per key = version order; reads must be prefixes.
+    longest: dict = {}
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f == "r" and isinstance(v, list):
+                kk = _hk(k)
+                vv = [_hv(x) for x in v]
+                if len(vv) > len(longest.get(kk, [])):
+                    longest[kk] = vv
+
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f != "r" or not isinstance(v, list):
+                continue
+            kk = _hk(k)
+            vv = [_hv(x) for x in v]
+            if longest.get(kk, [])[:len(vv)] != vv:
+                add_anom("incompatible-order",
+                         {"key": k, "read": v, "longest": longest.get(kk)})
+            for x, xv in zip(v, vv):
+                if (kk, xv) in failed_appends:
+                    add_anom("G1a", {"key": k, "value": x,
+                                     "txn": t["micro"]})
+                elif (kk, xv) not in appender:
+                    add_anom("phantom-element", {"key": k, "value": x})
+            # G1b: observed the middle of another txn's appends to this key
+            writers_in_order = [appender.get((kk, xv)) for xv in vv]
+            if writers_in_order:
+                last_writer = writers_in_order[-1]
+                if last_writer is not None and last_writer != t["id"]:
+                    w_appends = txn_appends[last_writer].get(kk, [])
+                    if w_appends and vv[-1] != w_appends[-1]:
+                        add_anom("G1b", {"key": k, "read": v,
+                                         "writer-appends": w_appends})
+
+    # --- dependency graph ---
+    # edges: (src, dst, kind) with kind in ww/wr/rw/rt
+    edges: set = set()
+
+    def version_writer(kk, idx):
+        """Writer txn of version idx (1-based position in longest[kk])."""
+        if idx <= 0 or idx > len(longest.get(kk, [])):
+            return None
+        return appender.get((kk, longest[kk][idx - 1]))
+
+    for kk, order in longest.items():
+        for i in range(1, len(order)):
+            a, b = appender.get((kk, order[i - 1])), \
+                appender.get((kk, order[i]))
+            if a is not None and b is not None and a != b:
+                # same-txn multi-appends don't create edges
+                edges.add((a, b, "ww"))
+
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f != "r" or not isinstance(v, list):
+                continue
+            kk = _hk(k)
+            n = len(v)
+            if n > 0:
+                w = version_writer(kk, n)
+                if w is not None and w != t["id"]:
+                    edges.add((w, t["id"], "wr"))
+            nxt = version_writer(kk, n + 1)
+            if nxt is not None and nxt != t["id"]:
+                edges.add((t["id"], nxt, "rw"))
+
+    # Real-time edges via a barrier chain rather than the O(n^2) transitive
+    # closure: each txn points at the barrier for its completion time;
+    # barriers chain forward; each txn is pointed at by the latest barrier
+    # before its invocation. t1 reaches t2 through barriers iff
+    # ret(t1) < inv(t2), preserving exactly the realtime cycles.
+    rt_edges = set()
+    ok_txns = sorted((t for t in txns if t["ok"]), key=lambda t: t["ret"])
+    barrier_times = [t["ret"] for t in ok_txns]
+    for i in range(len(ok_txns) - 1):
+        rt_edges.add((("b", i), ("b", i + 1), "rt"))
+    for i, t in enumerate(ok_txns):
+        rt_edges.add((t["id"], ("b", i), "rt"))
+    import bisect
+    for t in ok_txns:
+        j = bisect.bisect_left(barrier_times, t["inv"]) - 1
+        if j >= 0:
+            rt_edges.add((("b", j), t["id"], "rt"))
+
+    def cycles_with(edge_set):
+        """Tarjan SCC; returns list of cycles (as lists of txn ids)."""
+        adj: dict = {}
+        for a, b, kind in edge_set:
+            adj.setdefault(a, set()).add(b)
+        index = {}
+        low = {}
+        onstack = set()
+        stack = []
+        sccs = []
+        counter = [0]
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(adj.get(v, ()), key=repr)))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        onstack.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ()), key=repr))))
+                        advanced = True
+                        break
+                    elif w in onstack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in list(adj):
+            if v not in index:
+                strongconnect(v)
+        return sccs
+
+    def classify(scc, edge_set):
+        ids = set(scc)
+        kinds = {kind for a, b, kind in edge_set
+                 if a in ids and b in ids}
+        inner = kinds - {"rt"}
+        if inner <= {"ww"}:
+            return "G0"
+        if inner <= {"ww", "wr"}:
+            return "G1c"
+        rw_count = sum(1 for a, b, k in edge_set
+                       if a in ids and b in ids and k == "rw")
+        return "G-single" if rw_count == 1 else "G2"
+
+    def txn_ids(scc):
+        return sorted(x for x in scc if not isinstance(x, tuple))
+
+    base_sccs = cycles_with(edges)
+    for scc in base_sccs:
+        add_anom(classify(scc, edges), {"txns": txn_ids(scc)})
+    base_cycle_ids = {frozenset(txn_ids(s)) for s in base_sccs}
+    for scc in cycles_with(edges | rt_edges):
+        if frozenset(txn_ids(scc)) not in base_cycle_ids:
+            add_anom(classify(scc, edges | rt_edges) + "-realtime",
+                     {"txns": txn_ids(scc)})
+
+    return anomalies
+
+
+ILLEGAL = {
+    "read-uncommitted": {"G0", "G1a", "duplicate-appends",
+                         "incompatible-order", "phantom-element"},
+    "read-committed": {"G0", "G1a", "G1b", "G1c", "duplicate-appends",
+                       "incompatible-order", "phantom-element"},
+    "serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
+                     "duplicate-appends", "incompatible-order",
+                     "phantom-element"},
+    "strict-serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
+                            "G0-realtime", "G1c-realtime",
+                            "G-single-realtime", "G2-realtime",
+                            "duplicate-appends", "incompatible-order",
+                            "phantom-element"},
+}
+
+
+class ElleListAppendChecker(Checker):
+    name = "elle"
+
+    def __init__(self, consistency_models=("strict-serializable",)):
+        self.models = list(consistency_models)
+
+    def check(self, test, history, opts=None):
+        anomalies = analyze(history)
+        illegal = set()
+        for m in self.models:
+            illegal |= ILLEGAL.get(m, ILLEGAL["strict-serializable"])
+        found = {k: v for k, v in anomalies.items() if k in illegal}
+        return {"valid": not found,
+                "anomaly-types": sorted(anomalies),
+                "anomalies": found or None,
+                "models-checked": self.models}

@@ -1,0 +1,146 @@
+"""Linearizability checking for registers (the Knossos role).
+
+The reference checks lin-kv with jepsen.tests.linearizable-register —
+per-key Knossos linearizability over independent keys
+(`workload/lin_kv.clj:95-102`). This module implements the
+Wing & Gong / Lowe (WGL) algorithm with memoization over
+(linearized-set, register-state) pairs, for a register supporting
+read / write / cas:
+
+  - ok ops must linearize with their observed results
+  - info (indeterminate) ops may take effect at any point after their
+    invocation, or never
+  - fail ops definitely didn't happen and are excluded
+
+Histories are partitioned by key (values are [k, v] tuples, mirroring
+jepsen.independent), which keeps each search small.
+"""
+
+from __future__ import annotations
+
+from . import Checker
+from ..history import coerce_history
+
+INF = float("inf")
+
+
+def _apply(f, value, ok: bool, state):
+    """Possible next states for linearizing an op against `state`.
+    Returns a list of states (empty = inconsistent here)."""
+    if f == "read":
+        if ok:
+            return [state] if state == value else []
+        return [state]              # indeterminate read: no effect
+    if f == "write":
+        if ok:
+            return [value]
+        return [value, state]       # may or may not have happened
+    if f == "cas":
+        frm, to = value
+        if ok:
+            return [to] if state == frm else []
+        if state == frm:
+            return [to, state]
+        return [state]
+    raise ValueError(f"unknown register op {f!r}")
+
+
+def check_register_history(ops, max_states: int = 2_000_000):
+    """ops: [{f, value, inv, ret, ok}] with ret=INF for indeterminate ops.
+    Returns {"valid": bool|"unknown", ...}."""
+    n = len(ops)
+    if n == 0:
+        return {"valid": True}
+    if n > 600:
+        return {"valid": "unknown",
+                "error": f"history too long for WGL search ({n} ops)"}
+    full = (1 << n) - 1
+    seen = set()
+    order = sorted(range(n), key=lambda j: ops[j]["inv"])
+
+    # Iterative DFS: stack of (mask, state, iterator position)
+    def candidates(mask):
+        min_ret = INF
+        for k in range(n):
+            if not mask & (1 << k):
+                r = ops[k]["ret"]
+                if r < min_ret:
+                    min_ret = r
+        out = []
+        for j in order:
+            if mask & (1 << j):
+                continue
+            if ops[j]["inv"] > min_ret:
+                break
+            out.append(j)
+        return out
+
+    stack = [(0, None, None)]
+    while stack:
+        mask, state, it = stack.pop()
+        if it is None:
+            if mask == full:
+                return {"valid": True}
+            key = (mask, state)
+            if key in seen:
+                continue
+            seen.add(key)
+            if len(seen) > max_states:
+                return {"valid": "unknown",
+                        "error": "WGL state cap exceeded"}
+            it = iter([(j, s2) for j in candidates(mask)
+                       for s2 in _apply(ops[j]["f"], ops[j]["value"],
+                                        ops[j]["ok"], state)])
+        nxt = next(it, None)
+        if nxt is None:
+            continue
+        j, s2 = nxt
+        stack.append((mask, state, it))
+        stack.append((mask | (1 << j), s2, None))
+    return {"valid": False,
+            "explored-states": len(seen),
+            "op-count": n}
+
+
+class LinearizableRegisterChecker(Checker):
+    """Per-key independent linearizable register checking
+    (the jepsen.tests.linearizable-register equivalent)."""
+
+    name = "linear"
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        by_key: dict = {}
+        for invoke, complete in history.pairs():
+            if invoke.f not in ("read", "write", "cas"):
+                continue
+            if not isinstance(invoke.value, (list, tuple)) or \
+                    len(invoke.value) != 2:
+                continue
+            k, v = invoke.value
+            by_key.setdefault(k, []).append((invoke, complete))
+
+        results = {}
+        failures = []
+        for k, kpairs in sorted(by_key.items(), key=lambda kv: repr(kv[0])):
+            ops = []
+            for invoke, complete in kpairs:
+                if complete is not None and complete.is_fail():
+                    continue
+                ok = complete is not None and complete.is_ok()
+                val = (complete.value[1] if ok and complete.value is not None
+                       else invoke.value[1])
+                ops.append({"f": invoke.f, "value": val,
+                            "inv": invoke.time,
+                            "ret": complete.time if ok else INF,
+                            "ok": ok})
+            r = check_register_history(ops)
+            results[str(k)] = r
+            if r["valid"] is False:
+                failures.append(k)
+        valid = (False if failures else
+                 ("unknown" if any(r["valid"] == "unknown"
+                                   for r in results.values()) else True))
+        return {"valid": valid,
+                "key-count": len(by_key),
+                "failures": failures or None}

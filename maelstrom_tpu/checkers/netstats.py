@@ -1,0 +1,38 @@
+"""Network statistics checker (reference `src/maelstrom/net/checker.clj`):
+journal folds for send/recv/unique-message counts split all/clients/servers,
+msgs-per-op, and the Lamport diagram side effect."""
+
+from __future__ import annotations
+
+import os
+
+from . import Checker
+from ..history import coerce_history
+
+
+class NetStatsChecker(Checker):
+    name = "net"
+
+    def __init__(self, net):
+        self.net = net
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        journal = getattr(self.net, "journal", None)
+        if journal is None:
+            return {"valid": True, "note": "no journal"}
+        # msgs-per-op divides by client invocation count
+        # (reference net/checker.clj:55-66)
+        op_count = sum(1 for o in history
+                       if o.type == "invoke" and o.process != "nemesis")
+        stats = journal.stats(op_count=op_count or None)
+        store_dir = test.get("store_dir")
+        if store_dir:
+            try:
+                from ..viz.lamport import plot_lamport
+                plot_lamport(journal,
+                             os.path.join(store_dir, "messages.svg"))
+            except Exception as e:      # viz must never fail the test
+                stats["viz-error"] = repr(e)
+        stats["valid"] = True
+        return stats

@@ -1,0 +1,57 @@
+"""Perf checker: writes latency-raw.svg, latency-quantiles.svg, rate.svg to
+the store dir and reports latency statistics (jepsen checker/perf
+equivalent, reference `core.clj:83-84`)."""
+
+from __future__ import annotations
+
+from . import Checker
+from ..history import coerce_history
+
+
+def latency_stats(history) -> dict:
+    lats = []
+    for invoke, complete in history.pairs():
+        if invoke.process == "nemesis" or complete is None \
+                or not complete.is_ok():
+            continue
+        lats.append((complete.time - invoke.time) / 1e6)
+    lats.sort()
+    if not lats:
+        return {}
+    q = lambda p: lats[min(len(lats) - 1, int(p * len(lats)))]
+    return {"count": len(lats), "p50": round(q(0.5), 3),
+            "p95": round(q(0.95), 3), "p99": round(q(0.99), 3),
+            "max": round(lats[-1], 3)}
+
+
+class PerfChecker(Checker):
+    name = "perf"
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        out = {"valid": True, "latency-ms": latency_stats(history)}
+        store_dir = test.get("store_dir")
+        if store_dir:
+            try:
+                from ..viz.plots import perf_charts
+                perf_charts(history, store_dir)
+            except Exception as e:
+                out["plot-error"] = repr(e)
+        return out
+
+
+class TimelineChecker(Checker):
+    name = "timeline"
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        store_dir = test.get("store_dir")
+        if store_dir:
+            try:
+                import os
+                from ..viz.timeline import render_timeline
+                render_timeline(history,
+                                os.path.join(store_dir, "timeline.html"))
+            except Exception as e:
+                return {"valid": True, "error": repr(e)}
+        return {"valid": True}

@@ -20,15 +20,32 @@ class PNCounterChecker(Checker):
 
     def check(self, test, history, opts=None):
         history = coerce_history(history)
-        adds = [o for o in history if o.f == "add"]
-        definite_sum = sum(o.value for o in adds if o.is_ok())
+        # Classify adds. Completion-only fixture histories (like the
+        # reference's unit test) and full invoke/complete histories both
+        # work: an invoke with no completion is indeterminate, exactly like
+        # an info completion.
+        definite_sum = 0
+        indeterminate: list = []
+        open_invokes: dict = {}     # process -> Op
+        for o in history:
+            if o.f != "add":
+                continue
+            if o.type == "invoke":
+                open_invokes[o.process] = o
+                continue
+            open_invokes.pop(o.process, None)
+            if o.is_ok():
+                definite_sum += o.value
+            elif o.is_info():
+                indeterminate.append(o.value)
+            # fail: definitely didn't happen
+        indeterminate.extend(o.value for o in open_invokes.values())
 
         acceptable = IntervalSet([(definite_sum, definite_sum)])
-        for add in adds:
-            if add.is_info():
-                # The add may or may not have happened: allow both outcomes
-                # (reference `pn_counter.clj:100-109`).
-                acceptable = acceptable.union(acceptable.shift(add.value))
+        for delta in indeterminate:
+            # The add may or may not have happened: allow both outcomes
+            # (reference `pn_counter.clj:100-109`).
+            acceptable = acceptable.union(acceptable.shift(delta))
 
         reads = [o for o in history if o.final and o.is_ok()]
         errors = []

@@ -1,0 +1,195 @@
+"""The command-line interface: `python -m maelstrom_tpu <cmd>`.
+
+Subcommands mirror the reference CLI (`core.clj:224-241`): `test` runs a
+single test, `serve` browses the store dir, `demo` runs the bundled demo
+binaries against their workloads as a self-test suite, and `doc` regenerates
+the protocol/workload documentation. Flags follow `core.clj:113-195`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="maelstrom_tpu",
+        description="A TPU-native workbench for toy distributed systems.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("test", help="Run a single test")
+    t.add_argument("--bin", help="Path to binary which runs a node")
+    t.add_argument("--node", help="Built-in TPU node program, e.g. "
+                                  "tpu:broadcast (instead of --bin)")
+    t.add_argument("-w", "--workload", default="lin-kv",
+                   choices=["broadcast", "echo", "g-set", "g-counter",
+                            "pn-counter", "lin-kv", "txn-list-append"],
+                   help="What workload to run")
+    t.add_argument("--node-count", type=int,
+                   help="How many nodes to run. Overrides --nodes.")
+    t.add_argument("--nodes", help="Comma-separated node names")
+    t.add_argument("--rate", type=float, default=5.0,
+                   help="Approximate number of requests/sec")
+    t.add_argument("--time-limit", type=float, default=10.0,
+                   help="Test duration in seconds")
+    t.add_argument("--concurrency", type=int,
+                   help="Number of client workers")
+    t.add_argument("--latency", type=float, default=0,
+                   help="Mean network latency in ms")
+    t.add_argument("--latency-dist", default="constant",
+                   choices=["constant", "uniform", "exponential"],
+                   help="Latency distribution shape")
+    t.add_argument("--nemesis", default="",
+                   help="Comma-separated faults (partition)")
+    t.add_argument("--nemesis-interval", type=float, default=10.0,
+                   help="Seconds between nemesis operations")
+    t.add_argument("--topology", default="grid",
+                   choices=["line", "grid", "tree", "tree2", "tree3",
+                            "tree4", "total"],
+                   help="Network topology offered to broadcast nodes")
+    t.add_argument("--key-count", type=int,
+                   help="Keys to work on at once (append test)")
+    t.add_argument("--max-txn-length", type=int, default=4,
+                   help="Max micro-ops per transaction")
+    t.add_argument("--max-writes-per-key", type=int, default=16,
+                   help="Max writes to any single key (append test)")
+    t.add_argument("--consistency-models", default="strict-serializable",
+                   help="Comma-separated consistency models to check")
+    t.add_argument("--log-stderr", action="store_true",
+                   help="Relay node stderr to the console")
+    t.add_argument("--log-net-send", action="store_true",
+                   help="Log packets as they're sent")
+    t.add_argument("--log-net-recv", action="store_true",
+                   help="Log packets as they're received")
+    t.add_argument("--seed", type=int, default=0, help="PRNG seed")
+    t.add_argument("--store", default="store", help="Store directory root")
+
+    s = sub.add_parser("serve", help="Serve the store directory")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--store", default="store")
+
+    d = sub.add_parser("demo", help="Run the bundled demo suite")
+    d.add_argument("--store", default="store")
+    d.add_argument("--time-limit", type=float, default=5.0)
+    d.add_argument("--only", help="Run only demos whose name contains this")
+
+    doc = sub.add_parser("doc", help="Regenerate protocol/workload docs")
+    doc.add_argument("--dir", default="doc")
+
+    b = sub.add_parser("bench", help="Run the TPU benchmark")
+    b.add_argument("--nodes", type=int, default=100_000)
+    b.add_argument("--rounds", type=int, default=200)
+    return p
+
+
+def opts_from_args(args) -> dict:
+    opts = {
+        "workload": args.workload,
+        "bin": args.bin,
+        "node": args.node,
+        "node_count": args.node_count,
+        "nodes": args.nodes.split(",") if isinstance(args.nodes, str)
+        else None,
+        "rate": args.rate,
+        "time_limit": args.time_limit,
+        "concurrency": args.concurrency,
+        "latency": {"mean": args.latency, "dist": args.latency_dist},
+        "nemesis": set(filter(None, args.nemesis.split(","))),
+        "nemesis_interval": args.nemesis_interval,
+        "topology": args.topology,
+        "key_count": args.key_count,
+        "max_txn_length": args.max_txn_length,
+        "max_writes_per_key": args.max_writes_per_key,
+        "consistency_models": args.consistency_models.split(","),
+        "log_stderr": args.log_stderr,
+        "log_net_send": args.log_net_send,
+        "log_net_recv": args.log_net_recv,
+        "seed": args.seed,
+        "store_root": args.store,
+    }
+    return opts
+
+
+# The bundled demo suite (reference `core.clj:93-103`)
+DEMOS = [
+    {"workload": "echo", "bin": "demo/python/echo.py"},
+    {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
+    {"workload": "g-set", "bin": "demo/python/g_set.py"},
+    {"workload": "pn-counter", "bin": "demo/python/pn_counter.py"},
+    {"workload": "lin-kv", "bin": "demo/python/raft.py",
+     "concurrency": 10},
+    {"workload": "lin-kv", "bin": "demo/python/lin_kv_proxy.py",
+     "concurrency": 10},
+    {"workload": "txn-list-append",
+     "bin": "demo/python/datomic_list_append.py"},
+]
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    args = build_parser().parse_args(argv)
+
+    if args.cmd == "test":
+        from . import core
+        try:
+            results = core.run(opts_from_args(args))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        valid = results.get("valid")
+        print(f"\nEverything looks good! ヽ(‘ー`)ノ" if valid is True else
+              ("\nValidity unknown (;￣ー￣)" if valid == "unknown" else
+               "\nAnalysis invalid! (ﾉಥ益ಥ)ﾉ ┻━┻"))
+        return 0 if valid is True else (2 if valid == "unknown" else 1)
+
+    if args.cmd == "serve":
+        from .serve import serve
+        serve(args.store, args.port)
+        return 0
+
+    if args.cmd == "demo":
+        from . import core
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        failures = []
+        for demo in DEMOS:
+            if args.only and args.only not in demo["bin"]:
+                continue
+            bin_path = os.path.join(repo, demo["bin"])
+            if not os.path.exists(bin_path):
+                print(f"skip {demo['bin']} (not present)")
+                continue
+            opts = {**demo, "bin": bin_path, "node_count": 3,
+                    "time_limit": args.time_limit, "rate": 10,
+                    "store_root": args.store, "recovery_s": 2.5}
+            print(f"\n=== {demo['workload']} :: {demo['bin']} ===")
+            r = core.run(opts)
+            print(f"valid: {r.get('valid')}")
+            if r.get("valid") is not True:
+                failures.append(demo)
+        if failures:
+            print(f"\n{len(failures)} demo(s) failed: {failures}")
+            return 1
+        print("\nAll demos passed.")
+        return 0
+
+    if args.cmd == "doc":
+        from .doc_gen import write_docs
+        for path in write_docs(args.dir):
+            print(f"wrote {path}")
+        return 0
+
+    if args.cmd == "bench":
+        import subprocess
+        return subprocess.call([sys.executable, "bench.py",
+                                "--nodes", str(args.nodes),
+                                "--rounds", str(args.rounds)])
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

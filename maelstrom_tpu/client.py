@@ -1,0 +1,173 @@
+"""Synchronous network clients and typed RPCs.
+
+Reimplements the reference client layer (`src/maelstrom/client.clj`):
+one-outstanding-message synchronous clients with ids `c0, c1, ...`; `rpc`
+send+recv with timeout (default 5000 ms); stale-reply discarding; error
+interpretation via the error registry; `with_errors` mapping RPC failures to
+history `fail`/`info` with idempotent-op awareness; and `defrpc` — typed,
+schema-validated RPC functions that auto-register for doc generation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+from . import schema as S
+from .errors import RPCError, Timeout
+from .history import FAIL, INFO
+
+DEFAULT_TIMEOUT_MS = 5000     # reference client.clj:15-17
+
+
+class SyncClient:
+    """A client which can only do one thing at a time: send a message, or
+    wait for a response (reference `client.clj:102-178`)."""
+
+    def __init__(self, net):
+        self.net = net
+        self.node_id = f"c{next(net.next_client_id)}"
+        net.add_node(self.node_id)
+        self._next_msg_id = 0
+        self._waiting_for = None
+        self._lock = threading.Lock()
+
+    def close(self):
+        self._waiting_for = "closed"
+        self.net.remove_node(self.node_id)
+
+    def msg_id(self) -> int:
+        with self._lock:
+            self._next_msg_id += 1
+            return self._next_msg_id
+
+    def send(self, dest: str, body: dict) -> int:
+        msg_id = body.get("msg_id") or self.msg_id()
+        if self._waiting_for is not None:
+            raise RuntimeError("Can't send more than one message at a time!")
+        self._waiting_for = msg_id
+        body = dict(body, msg_id=msg_id)
+        self.net.send({"src": self.node_id, "dest": dest, "body": body})
+        return msg_id
+
+    def recv(self, timeout_ms: float = DEFAULT_TIMEOUT_MS) -> dict:
+        """Waits for the reply to the outstanding msg_id, discarding stale
+        replies (reference `client.clj:142-178`). Returns the full message."""
+        target = self._waiting_for
+        assert target is not None, "client isn't waiting for any response!"
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        try:
+            while True:
+                remaining_ms = (deadline - _time.monotonic()) * 1000.0
+                msg = (self.net.recv(self.node_id, remaining_ms)
+                       if remaining_ms > 0 else None)
+                if msg is None:
+                    if _time.monotonic() >= deadline:
+                        raise Timeout()
+                    continue
+                if msg.body.get("in_reply_to") != target:
+                    continue    # reply to something we gave up on
+                return msg
+        finally:
+            self._waiting_for = None
+
+    def rpc(self, dest: str, body: dict,
+            timeout_ms: float = DEFAULT_TIMEOUT_MS) -> dict:
+        """Send + recv, raising RPCError on error bodies
+        (reference `client.clj:186-212`)."""
+        self.send(dest, body)
+        msg = self.recv(timeout_ms)
+        rbody = msg.body
+        if rbody.get("type") == "error":
+            raise RPCError(rbody.get("code", 13), rbody)
+        return rbody
+
+
+def with_errors(op: dict, idempotent: set, thunk):
+    """Evaluates thunk() (which returns the completed op); maps RPC errors to
+    completions: timeouts -> info (or fail if idempotent), definite errors ->
+    fail, indefinite -> info (reference `client.clj:214-233`)."""
+    try:
+        return thunk()
+    except Timeout:
+        t = FAIL if op.get("f") in idempotent else INFO
+        return {**op, "type": t, "error": "net-timeout"}
+    except RPCError as e:
+        t = FAIL if (e.definite or op.get("f") in idempotent) else INFO
+        return {**op, "type": t,
+                "error": [e.name, e.body.get("text")]}
+
+
+# --- Typed RPC definitions (reference client.clj:237-331) ---
+
+@dataclass
+class RPCDef:
+    ns: str
+    name: str
+    doc: str
+    send: dict
+    recv: dict
+
+
+RPC_REGISTRY: list[RPCDef] = []
+
+
+class MalformedRPC(Exception):
+    pass
+
+
+def check_body(kind: str, sch, dest, req, body):
+    """Validates a request/response body, raising a rich teaching error
+    (reference `client.clj:242-273`)."""
+    errs = S.check(sch, body)
+    if errs is None:
+        return
+    import json
+    if kind == "send":
+        head = ("Malformed RPC request. Maelstrom should have constructed a "
+                "message body like:")
+        verb = "sent"
+    else:
+        head = (f"Malformed RPC response. Maelstrom sent node {dest} the "
+                f"following request:\n\n{json.dumps(req, indent=2)}\n\n"
+                "And expected a response of the form:")
+        verb = "received"
+    raise MalformedRPC(
+        f"{head}\n\n{S.format_schema(sch)}\n\n... but instead {verb}\n\n"
+        f"{json.dumps(body, indent=2, default=str)}\n\nThis is malformed "
+        f"because:\n\n{json.dumps(errs, indent=2, default=str)}\n\n"
+        "See doc/protocol.md for more guidance.")
+
+
+def send_schema(sch: dict) -> dict:
+    return {**sch, "msg_id": int}
+
+
+def recv_schema(sch: dict) -> dict:
+    return {**sch, S.Optional("msg_id"): int, "in_reply_to": int}
+
+
+def defrpc(name: str, doc: str, send: dict, recv: dict, ns: str):
+    """Defines a typed RPC call: returns fn(client, dest, body, timeout_ms)
+    which stamps the message type, validates both directions, and performs
+    the RPC. Registers the spec for doc generation
+    (reference `client.clj:289-331`)."""
+    full_send = send_schema(send)
+    full_recv = recv_schema(recv)
+    msg_type = send["type"].value
+    assert isinstance(msg_type, str)
+    RPC_REGISTRY.append(RPCDef(ns=ns, name=name, doc=doc,
+                               send=full_send, recv=full_recv))
+
+    def rpc_fn(client: SyncClient, dest: str, body: dict,
+               timeout_ms: float = DEFAULT_TIMEOUT_MS) -> dict:
+        body = dict(body, type=msg_type, msg_id=client.msg_id())
+        check_body("send", full_send, dest, body, body)
+        res = client.rpc(dest, body, timeout_ms)
+        check_body("recv", full_recv, dest, body, res)
+        return res
+
+    rpc_fn.__name__ = name
+    rpc_fn.__doc__ = doc
+    return rpc_fn

@@ -1,0 +1,151 @@
+"""Test composition and execution: the maelstrom-test equivalent.
+
+Builds a full test from parsed options — network, db, workload, nemesis,
+composed generator phases, composed checker suite — and runs it end to end,
+writing artifacts to the store dir (reference `core.clj:44-91` plus the
+jepsen.core/run! orchestration).
+
+Two execution paths, selected like the reference's `--bin` plugin boundary:
+  - bin path: external node binaries on the host network (HostDB)
+  - tpu path: built-in batched node programs on the TPU network
+    (`maelstrom_tpu.runner.tpu_runner`), selected with --node tpu:<name>
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from . import generators as g
+from . import nemesis as nem
+from . import store
+from .checkers import Compose, Stats, UnhandledExceptions
+from .checkers.netstats import NetStatsChecker
+from .checkers.perf import PerfChecker, TimelineChecker
+from .db import HostDB
+from .net.host import HostNet
+from .net.journal import Journal
+from .runner.host_runner import run_test as run_host_test
+from .workloads import registry
+
+log = logging.getLogger("maelstrom")
+
+DEFAULTS = dict(
+    workload="lin-kv", node_count=None, nodes=None, rate=5.0,
+    time_limit=10.0, concurrency=None, latency={"mean": 0,
+                                                "dist": "constant"},
+    nemesis=set(), nemesis_interval=10.0, topology="grid",
+    key_count=None, max_txn_length=4, max_writes_per_key=16,
+    consistency_models=["strict-serializable"], log_stderr=False,
+    log_net_send=False, log_net_recv=False, seed=0, store_root="store",
+)
+
+
+def parse_nodes(opts: dict) -> list[str]:
+    """--node-count N overrides --nodes, generating n0..n(N-1)
+    (reference `core.clj:197-204`)."""
+    if opts.get("node_count"):
+        return [f"n{i}" for i in range(opts["node_count"])]
+    return opts.get("nodes") or ["n0", "n1", "n2", "n3", "n4"]
+
+
+def build_test(opts: dict) -> dict:
+    opts = {**DEFAULTS, **opts}
+    nodes = parse_nodes(opts)
+    opts["nodes"] = nodes
+    if not opts.get("concurrency"):
+        opts["concurrency"] = len(nodes)
+    name = opts.get("name") or str(opts["workload"])
+
+    net = HostNet(latency=opts["latency"], log_send=opts["log_net_send"],
+                  log_recv=opts["log_net_recv"], seed=opts["seed"])
+    opts["net"] = net
+    workload = registry()[opts["workload"]](opts)
+
+    nemesis_pkg = nem.package(set(opts["nemesis"]),
+                              interval_s=opts["nemesis_interval"])
+
+    # Generator composition (reference core.clj:58-71)
+    rate = opts["rate"]
+    if rate > 0:
+        main = g.stagger(1.0 / rate, workload["generator"])
+    else:
+        main = g.sleep(opts["time_limit"])
+    main = g.time_limit(opts["time_limit"],
+                        g.nemesis_wrap(nemesis_pkg["generator"], main))
+    if workload.get("final_generator") is not None:
+        main = g.phases(
+            main,
+            (g.nemesis_gen(nemesis_pkg["final_generator"])
+             if nemesis_pkg["final_generator"] is not None else None),
+            g.Log("Waiting for recovery..."),
+            g.sleep(opts.get("recovery_s", 10)),
+            g.clients(workload["final_generator"]))
+    else:
+        main = g.phases(main)
+
+    checker = Compose({
+        "perf": PerfChecker(),
+        "timeline": TimelineChecker(),
+        "exceptions": UnhandledExceptions(),
+        "stats": Stats(),
+        "net": NetStatsChecker(net),
+        "workload": workload["checker"],
+    })
+
+    test = {**opts,
+            "name": name,
+            "net": net,
+            "workload_map": workload,
+            "client": workload.get("client"),
+            "generator": main,
+            "checker": checker,
+            "nemesis_pkg": nemesis_pkg}
+    return test
+
+
+def run(opts: dict) -> dict:
+    """Runs a complete test: setup, drive, teardown, check, store.
+    Returns the results map (with "valid")."""
+    test = build_test(opts)
+    net: HostNet = test["net"]
+    test_dir = store.make_test_dir(test["store_root"], test["name"])
+    test["store_dir"] = test_dir
+    net.journal = Journal(dir=os.path.join(test_dir, "net-journal"))
+
+    node_spec = test.get("node")
+    if node_spec and str(node_spec).startswith("tpu:"):
+        from .runner.tpu_runner import run_tpu_test
+        return run_tpu_test(test, test_dir)
+
+    if not test.get("bin"):
+        raise ValueError("Expected a --bin PATH_TO_BINARY to test "
+                         "(or --node tpu:<name>)")
+
+    db = HostDB(net, test["bin"], test.get("bin_args") or [],
+                service_seed=test["seed"])
+    test["nemesis"] = (nem.PartitionNemesis(net, test["nodes"],
+                                            seed=test["seed"])
+                       if test["nemesis_pkg"]["generator"] is not None
+                       else None)
+    log.info("Running test %s with nodes %s", test["name"], test["nodes"])
+    crashes = []
+    try:
+        db.setup(test)
+        history = run_host_test(test)
+    finally:
+        crashes = db.teardown()
+        net.journal.close()
+
+    for e in crashes:
+        log.error("node crash: %s", e)
+    results = test["checker"].check(test, history, {})
+    if crashes:
+        results["node-crashes"] = [str(e) for e in crashes]
+        results["valid"] = False
+    store.write_history(test_dir, history)
+    store.write_results(test_dir, results)
+    store.write_test(test_dir, {k: test[k] for k in DEFAULTS if k in test})
+    log.info("Results valid? %s (store: %s)", results["valid"], test_dir)
+    return results

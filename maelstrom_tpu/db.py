@@ -1,0 +1,95 @@
+"""Node lifecycle: setup (services + node + init handshake) and teardown.
+
+Reimplements `src/maelstrom/db.clj`: on setup, the primary node first starts
+the built-in services; each node's process is started and then initialized
+with the `init` RPC (`{"type": "init", "node_id": ..., "node_ids": [...]}`),
+expecting `init_ok` within 10 seconds. Teardown stops the node process
+(raising on crashes) and finally the services.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .client import SyncClient
+from .errors import Timeout
+from .process import NodeProcess
+from .services import ServiceRunner, default_services
+
+log = logging.getLogger("maelstrom.db")
+
+INIT_TIMEOUT_MS = 10_000     # reference db.clj:46-69
+
+
+class InitFailed(Exception):
+    pass
+
+
+def init_node(net, node_id: str, node_ids: list[str],
+              timeout_ms: float = INIT_TIMEOUT_MS):
+    """Performs the init RPC handshake (reference `db.clj:46-69`)."""
+    client = SyncClient(net)
+    try:
+        try:
+            res = client.rpc(node_id,
+                             {"type": "init", "node_id": node_id,
+                              "node_ids": list(node_ids)},
+                             timeout_ms)
+        except Timeout:
+            raise InitFailed(
+                f"Expected node {node_id} to respond to an init message, "
+                "but node did not respond.")
+        if res.get("type") != "init_ok":
+            raise InitFailed(
+                f"Expected an init_ok message, but node responded with "
+                f"{res!r}")
+    finally:
+        client.close()
+
+
+class HostDB:
+    """Runs external-binary nodes on the host network
+    (the reference's only mode; here it's the compatibility path)."""
+
+    def __init__(self, net, bin: str, args: list[str] | None = None,
+                 service_seed: int = 0):
+        self.net = net
+        self.bin = bin
+        self.args = args or []
+        self.services: ServiceRunner | None = None
+        self.processes: dict[str, NodeProcess] = {}
+        self.service_seed = service_seed
+
+    def setup(self, test: dict):
+        nodes = test["nodes"]
+        log_dir = os.path.join(test.get("store_dir", "store"), "node-logs")
+        # services first (reference db.clj:24-29; primary-only there, but we
+        # set up all nodes from one place)
+        self.services = ServiceRunner(
+            self.net, default_services(seed=self.service_seed))
+        self.services.start()
+        for node_id in nodes:
+            log.info("Setting up %s", node_id)
+            self.processes[node_id] = NodeProcess(
+                node_id=node_id, bin=self.bin, args=self.args, net=self.net,
+                log_file=os.path.join(log_dir, f"{node_id}.log"),
+                log_stderr=test.get("log_stderr", False))
+        for node_id in nodes:
+            init_node(self.net, node_id, nodes)
+
+    def teardown(self) -> list[Exception]:
+        """Stops everything; returns (rather than raises) crash exceptions
+        so all nodes get torn down (crashes still fail the test)."""
+        crashes = []
+        for node_id, p in list(self.processes.items()):
+            log.info("Tearing down %s", node_id)
+            try:
+                p.stop()
+            except Exception as e:
+                crashes.append(e)
+            del self.processes[node_id]
+        if self.services:
+            self.services.stop()
+            self.services = None
+        return crashes

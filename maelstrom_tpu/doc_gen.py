@@ -1,0 +1,117 @@
+"""Documentation generator (reference `src/maelstrom/doc.clj`): renders
+doc/workloads.md (per-workload RPC schemas from the RPC registry) and
+doc/protocol.md (the error table from the error registry)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .client import RPC_REGISTRY
+from .errors import ERROR_REGISTRY
+from . import schema as S
+
+PROTOCOL_INTRO = """\
+# Protocol
+
+Maelstrom nodes receive messages on STDIN, send messages on STDOUT, and log
+debugging output on STDERR. Nodes must not print anything that is not a
+message to STDOUT. Maelstrom processes are sequential programs which
+communicate by passing messages.
+
+## Messages
+
+Messages are JSON objects with `src`, `dest`, and `body` fields:
+
+```json
+{"src": "c1", "dest": "n1", "body": {"type": "echo", "msg_id": 1,
+ "echo": "hello"}}
+```
+
+Bodies carry a `type`, an optional `msg_id` (unique per sender), and an
+optional `in_reply_to` linking replies to requests.
+
+## Initialization
+
+At the start of a test Maelstrom sends each node an `init` message:
+
+```json
+{"type": "init", "msg_id": 1, "node_id": "n3",
+ "node_ids": ["n1", "n2", "n3"]}
+```
+
+Nodes must respond with `{"type": "init_ok", "in_reply_to": 1}`.
+
+## Errors
+
+Nodes may respond to requests with errors: a body of type `"error"` with an
+integer `code` and a free-form `text`. *Definite* errors mean the requested
+operation definitely did not happen; *indefinite* errors leave the outcome
+unknown.
+"""
+
+
+def render_errors() -> str:
+    lines = ["| Code | Name | Definite | Description |",
+             "|------|------|----------|-------------|"]
+    for code in sorted(ERROR_REGISTRY):
+        e = ERROR_REGISTRY[code]
+        doc = " ".join(e.doc.split())
+        lines.append(f"| {code} | {e.name} | "
+                     f"{'✓' if e.definite else ' '} | {doc} |")
+    return "\n".join(lines)
+
+
+def render_protocol() -> str:
+    return PROTOCOL_INTRO + "\n" + render_errors() + "\n"
+
+
+def _schema_block(sch) -> str:
+    return "```json\n" + json.dumps(S.explain(sch), indent=2,
+                                    default=str) + "\n```"
+
+
+def render_workloads() -> str:
+    """One section per workload namespace, one subsection per RPC
+    (reference `doc.clj:23-64`)."""
+    by_ns: dict = {}
+    for r in RPC_REGISTRY:
+        by_ns.setdefault(r.ns.split(".")[-1], []).append(r)
+    out = ["# Workloads",
+           "",
+           "A workload specifies the semantics of a distributed system: "
+           "what operations are performed, how clients submit requests to "
+           "the system, what those requests mean, what kind of responses "
+           "are expected, which errors can occur, and how to check the "
+           "resulting history for safety.",
+           ""]
+    for ns in sorted(by_ns):
+        out.append(f"## Workload: {ns}")
+        out.append("")
+        for r in by_ns[ns]:
+            out.append(f"### RPC: {r.name}")
+            out.append("")
+            out.append(" ".join(r.doc.split()))
+            out.append("")
+            out.append("Request:")
+            out.append(_schema_block(r.send))
+            out.append("")
+            out.append("Response:")
+            out.append(_schema_block(r.recv))
+            out.append("")
+    return "\n".join(out)
+
+
+def write_docs(doc_dir: str = "doc"):
+    """Regenerates doc/workloads.md and doc/protocol.md
+    (reference `doc.clj:87-96`)."""
+    # import all workloads so their defrpc/deferror registrations run
+    from .workloads import registry
+    registry()
+    os.makedirs(doc_dir, exist_ok=True)
+    with open(os.path.join(doc_dir, "protocol.md"), "w") as f:
+        f.write(render_protocol())
+    with open(os.path.join(doc_dir, "workloads.md"), "w") as f:
+        f.write(render_workloads())
+    return [os.path.join(doc_dir, "protocol.md"),
+            os.path.join(doc_dir, "workloads.md")]

@@ -1,0 +1,441 @@
+"""Jepsen-style operation generators.
+
+The reference composes its workload generators from jepsen.generator:
+`stagger` (rate limiting), `mix`, `each-thread`, `phases`, `time-limit`,
+`nemesis` wrapping, `sleep`, `log`, and final-generator recovery phases
+(reference `core.clj:58-71`, workload files). This module provides the same
+combinators as *pure* generators so that the same workload definitions drive
+both the real-time host path and the virtual-time TPU path.
+
+A generator responds to `op(ctx)` with a pair `(result, next_gen)`:
+
+  - result is an op dict   -> dispatch it (process/time filled in)
+  - result is PENDING      -> nothing yet; ask again at ctx["time"] >=
+                              the generator's next interesting time
+  - result is None         -> exhausted forever
+
+`update(ctx, event)` lets generators observe invocations/completions.
+ctx is {"time": ns, "free": [process ...], "processes": [...]} where
+"nemesis" is a special process; all others are client workers.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Iterable, Optional
+
+PENDING = "pending"
+NEMESIS = "nemesis"
+
+
+def client_processes(ctx) -> list:
+    """Processes visible in this context. Routing to clients vs the nemesis
+    is done by the OnProcesses wrappers (clients()/nemesis_gen()), so leaf
+    generators simply take whatever the context offers."""
+    return list(ctx["processes"])
+
+
+def free_clients(ctx) -> list:
+    return list(ctx["free"])
+
+
+class Gen:
+    def op(self, ctx):
+        raise NotImplementedError
+
+    def update(self, ctx, event):
+        return self
+
+
+def fill_op(op: dict, ctx, process) -> dict:
+    out = dict(op)
+    out.setdefault("process", process)
+    out["time"] = ctx["time"]
+    out.setdefault("type", "invoke")
+    return out
+
+
+def to_gen(x) -> Optional[Gen]:
+    """Coerces maps, iterables, functions, and generators to Gen."""
+    if x is None or isinstance(x, Gen):
+        return x
+    if isinstance(x, dict):
+        return Once(x)
+    if callable(x):
+        return Fn(x)
+    if isinstance(x, (list, tuple)) or hasattr(x, "__iter__"):
+        return Seq(x)
+    raise TypeError(f"can't coerce {x!r} to a generator")
+
+
+class Once(Gen):
+    """Emits a single op to the first free client."""
+
+    def __init__(self, op_map: dict, done: bool = False):
+        self.op_map = op_map
+        self.done = done
+
+    def op(self, ctx):
+        if self.done:
+            return None, self
+        free = free_clients(ctx)
+        if not free:
+            return PENDING, self
+        return fill_op(self.op_map, ctx, free[0]), Once(self.op_map, True)
+
+
+class Seq(Gen):
+    """Emits ops from an iterable, one per request. Elements may themselves
+    be generators (e.g. the nemesis cycle interleaves Sleep gens with op
+    maps); a nested generator runs until exhausted, then Seq advances."""
+
+    def __init__(self, iterable):
+        self.it = iter(iterable)
+        self.head = None        # lookahead buffer (op map or nested Gen)
+
+    def op(self, ctx):
+        while True:
+            if self.head is None:
+                try:
+                    self.head = next(self.it)
+                except StopIteration:
+                    return None, self
+            h = self.head
+            if isinstance(h, Gen) or callable(h) or not isinstance(h, dict):
+                sub = to_gen(h)
+                res, sub2 = sub.op(ctx)
+                if res is None:
+                    self.head = None    # nested gen exhausted: next element
+                    continue
+                self.head = sub2        # keep successor state
+                return res, self
+            free = free_clients(ctx)
+            if not free:
+                return PENDING, self
+            self.head = None
+            return fill_op(h, ctx, free[0]), self
+
+
+class Fn(Gen):
+    """Calls a zero-arg function to produce each op map (like the
+    reference's `(fn [] {:f :add :value (rand-int ...)})` generators)."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def op(self, ctx):
+        free = free_clients(ctx)
+        if not free:
+            return PENDING, self
+        op_map = self.f()
+        if op_map is None:
+            return None, self
+        return fill_op(op_map, ctx, free[0]), self
+
+
+class Repeat(Gen):
+    def __init__(self, op_map: dict):
+        self.op_map = op_map
+
+    def op(self, ctx):
+        free = free_clients(ctx)
+        if not free:
+            return PENDING, self
+        return fill_op(self.op_map, ctx, free[0]), self
+
+
+class EachThread(Gen):
+    """Emits the op once on every client process
+    (jepsen gen/each-thread; used for final reads,
+    reference `broadcast.clj:239`)."""
+
+    def __init__(self, op_map: dict, done: frozenset = frozenset()):
+        self.op_map = op_map
+        self.done = done
+
+    def op(self, ctx):
+        remaining = [p for p in free_clients(ctx) if p not in self.done]
+        if not remaining:
+            if all(p in self.done for p in client_processes(ctx)):
+                return None, self
+            return PENDING, self
+        p = remaining[0]
+        return (fill_op(self.op_map, ctx, p),
+                EachThread(self.op_map, self.done | {p}))
+
+
+class TimeLimit(Gen):
+    """Stops emitting after dt_ns of ctx time (jepsen gen/time-limit,
+    reference `core.clj:62`)."""
+
+    def __init__(self, dt_ns: int, gen, t0: int | None = None):
+        self.dt_ns = dt_ns
+        self.gen = to_gen(gen)
+        self.t0 = t0
+
+    def op(self, ctx):
+        t0 = ctx["time"] if self.t0 is None else self.t0
+        if ctx["time"] - t0 >= self.dt_ns:
+            return None, self
+        res, g2 = self.gen.op(ctx)
+        return res, TimeLimit(self.dt_ns, g2, t0)
+
+    def update(self, ctx, event):
+        return TimeLimit(self.dt_ns, self.gen.update(ctx, event), self.t0)
+
+
+class Stagger(Gen):
+    """Rate limiting: introduces random delays averaging dt between ops
+    (jepsen gen/stagger; reference `core.clj:59` uses (stagger (/ rate)))."""
+
+    def __init__(self, dt_ns: float, gen, next_time: float | None = None,
+                 rng: random.Random | None = None):
+        self.dt_ns = dt_ns
+        self.gen = to_gen(gen)
+        self.next_time = next_time
+        self.rng = rng or random.Random(1)
+
+    def op(self, ctx):
+        t = ctx["time"]
+        nt = t if self.next_time is None else self.next_time
+        if t < nt:
+            return PENDING, self
+        res, g2 = self.gen.op(ctx)
+        if res is None or res == PENDING:
+            return res, Stagger(self.dt_ns, g2, nt, self.rng)
+        # schedule next emission: uniform in [0, 2*dt] after this one
+        nt2 = nt + self.rng.uniform(0, 2 * self.dt_ns)
+        return res, Stagger(self.dt_ns, g2, nt2, self.rng)
+
+    def update(self, ctx, event):
+        return Stagger(self.dt_ns, self.gen.update(ctx, event),
+                       self.next_time, self.rng)
+
+    def next_interesting_time(self, ctx):
+        return self.next_time
+
+
+class Sleep(Gen):
+    """Emits nothing for dt, then is exhausted (jepsen gen/sleep,
+    reference `core.clj:69`)."""
+
+    def __init__(self, dt_ns: int, t0: int | None = None):
+        self.dt_ns = dt_ns
+        self.t0 = t0
+
+    def op(self, ctx):
+        t0 = ctx["time"] if self.t0 is None else self.t0
+        if self.t0 is None:
+            return PENDING, Sleep(self.dt_ns, ctx["time"])
+        if ctx["time"] - t0 >= self.dt_ns:
+            return None, self
+        return PENDING, self
+
+    def next_interesting_time(self, ctx):
+        t0 = ctx["time"] if self.t0 is None else self.t0
+        return t0 + self.dt_ns
+
+
+class Log(Gen):
+    """Logs a message once, emits no ops (jepsen gen/log,
+    reference `core.clj:68`)."""
+
+    def __init__(self, message: str, done: bool = False):
+        self.message = message
+        self.done = done
+
+    def op(self, ctx):
+        if not self.done:
+            import logging
+            logging.getLogger("maelstrom").info(self.message)
+            self.done = True    # mutate: callers may re-poll the same node
+        return None, Log(self.message, True)
+
+
+class Phases(Gen):
+    """Runs generators in sequence; a phase must be exhausted AND all its
+    ops completed (every process free) before the next phase starts
+    (jepsen gen/phases, reference `core.clj:66-71`)."""
+
+    def __init__(self, *gens):
+        self.gens = [to_gen(g) for g in gens if g is not None]
+
+    def op(self, ctx):
+        if not self.gens:
+            return None, self
+        res, g2 = self.gens[0].op(ctx)
+        if res is None:
+            # phase exhausted; wait for quiescence before advancing
+            if set(ctx["free"]) >= set(ctx["processes"]):
+                nxt = Phases(*self.gens[1:])
+                if not nxt.gens:
+                    return None, nxt
+                return nxt.op(ctx)
+            rest = Phases()
+            rest.gens = [g2] + self.gens[1:]
+            return PENDING, rest
+        p = Phases()
+        p.gens = [g2] + self.gens[1:]
+        return res, p
+
+    def update(self, ctx, event):
+        if not self.gens:
+            return self
+        p = Phases()
+        p.gens = [self.gens[0].update(ctx, event)] + self.gens[1:]
+        return p
+
+
+class OnProcesses(Gen):
+    """Restricts a generator to a subset of processes. The basis for
+    gen/clients (client processes only) and gen/nemesis (the nemesis
+    process), reference `core.clj:60,67,70`."""
+
+    def __init__(self, pred, gen):
+        self.pred = pred
+        self.gen = to_gen(gen)
+
+    def op(self, ctx):
+        sub = dict(ctx)
+        sub["free"] = [p for p in ctx["free"] if self.pred(p)]
+        sub["processes"] = [p for p in ctx["processes"] if self.pred(p)]
+        if not sub["processes"]:
+            return None, self
+        res, g2 = self.gen.op(sub)
+        return res, OnProcesses(self.pred, g2)
+
+    def update(self, ctx, event):
+        return OnProcesses(self.pred, self.gen.update(ctx, event))
+
+
+def clients(gen):
+    return OnProcesses(lambda p: p != NEMESIS, gen)
+
+
+def nemesis_gen(gen):
+    g = OnProcesses(lambda p: p == NEMESIS, gen)
+    return g
+
+
+class Any2(Gen):
+    """Interleaves two generators: each request tries both, preferring
+    whichever has an op ready (used to run nemesis alongside clients,
+    like jepsen's `gen/nemesis` wrapping in `core.clj:60-61`)."""
+
+    def __init__(self, a, b):
+        self.a = to_gen(a)
+        self.b = to_gen(b)
+
+    def op(self, ctx):
+        res_a, a2 = self.a.op(ctx) if self.a else (None, None)
+        if res_a not in (None, PENDING):
+            return res_a, Any2(a2, self.b)
+        res_b, b2 = self.b.op(ctx) if self.b else (None, None)
+        if res_b not in (None, PENDING):
+            return res_b, Any2(a2 if self.a else None, b2)
+        if res_a is None and res_b is None:
+            return None, self
+        return PENDING, Any2(a2 if self.a else None, b2 if self.b else None)
+
+    def update(self, ctx, event):
+        return Any2(self.a.update(ctx, event) if self.a else None,
+                    self.b.update(ctx, event) if self.b else None)
+
+
+def nemesis_wrap(nemesis_g, client_g):
+    """Clients run client_g; the nemesis process runs nemesis_g
+    (jepsen gen/nemesis with two args)."""
+    if nemesis_g is None:
+        return clients(client_g)
+    return Any2(nemesis_gen(nemesis_g), clients(client_g))
+
+
+class Filter(Gen):
+    """Keeps only ops matching pred (jepsen gen/filter; used by g-counter
+    to drop negative deltas, reference `g_counter.clj:30-40`)."""
+
+    def __init__(self, pred, gen):
+        self.pred = pred
+        self.gen = to_gen(gen)
+
+    def op(self, ctx):
+        g = self.gen
+        for _ in range(10000):
+            res, g = g.op(ctx)
+            if res is None or res == PENDING:
+                return res, Filter(self.pred, g)
+            if self.pred(res):
+                return res, Filter(self.pred, g)
+        raise RuntimeError("gen/filter: no matching op in 10000 tries")
+
+    def update(self, ctx, event):
+        return Filter(self.pred, self.gen.update(ctx, event))
+
+
+class FMap(Gen):
+    """Transforms emitted ops with f (jepsen gen/map)."""
+
+    def __init__(self, f, gen):
+        self.f = f
+        self.gen = to_gen(gen)
+
+    def op(self, ctx):
+        res, g2 = self.gen.op(ctx)
+        if res is None or res == PENDING:
+            return res, FMap(self.f, g2)
+        return self.f(res), FMap(self.f, g2)
+
+    def update(self, ctx, event):
+        return FMap(self.f, self.gen.update(ctx, event))
+
+
+class MixG(Gen):
+    """Random mixture of generators (clean implementation)."""
+
+    def __init__(self, gens, rng: random.Random | None = None):
+        self.gens = [to_gen(g) for g in gens]
+        self.rng = rng or random.Random(0)
+
+    def op(self, ctx):
+        live = list(range(len(self.gens)))
+        pending = False
+        while live:
+            j = self.rng.randrange(len(live))
+            i = live[j]
+            res, g2 = self.gens[i].op(ctx)
+            if res is None:
+                live.pop(j)
+                continue
+            if res == PENDING:
+                pending = True
+                live.pop(j)
+                continue
+            gens2 = list(self.gens)
+            gens2[i] = g2
+            return res, MixG(gens2, self.rng)
+        return (PENDING if pending else None), self
+
+
+def mix(gens, rng=None):
+    return MixG(gens, rng)
+
+
+def stagger(dt_seconds: float, gen, rng=None):
+    return Stagger(dt_seconds * 1e9, gen, rng=rng)
+
+
+def time_limit(seconds: float, gen):
+    return TimeLimit(int(seconds * 1e9), gen)
+
+
+def sleep(seconds: float):
+    return Sleep(int(seconds * 1e9))
+
+
+def each_thread(op_map: dict):
+    return EachThread(op_map)
+
+
+def phases(*gens):
+    return Phases(*gens)

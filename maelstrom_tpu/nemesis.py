@@ -1,0 +1,87 @@
+"""Fault injection: the nemesis.
+
+Reimplements the reference's nemesis package (`src/maelstrom/nemesis.clj` +
+jepsen.nemesis.combined/partition-package): a special 'nemesis' process
+receives `start-partition` / `stop-partition` ops from its own generator and
+applies them to the network as directional block-sets (reference
+`net.clj:108-112`). Partition grudges: random halves, majorities-ring, or a
+single isolated node. The package generator emits a fault roughly every
+`interval` seconds and the final generator heals everything so
+eventually-consistent workloads are graded post-recovery
+(reference `core.clj:63-70`).
+"""
+
+from __future__ import annotations
+
+import random
+
+from . import generators as g
+
+
+def split_half(nodes, rng: random.Random):
+    """Random majority/minority split; returns (name, grudge) where grudge
+    maps dest -> set of blocked srcs (both directions blocked)."""
+    nodes = list(nodes)
+    rng.shuffle(nodes)
+    k = len(nodes) // 2
+    a, b = set(nodes[:k]), set(nodes[k:])
+    grudge = {}
+    for d in a:
+        grudge[d] = set(b)
+    for d in b:
+        grudge[d] = set(a)
+    return f"halves {sorted(a)} | {sorted(b)}", grudge
+
+
+def isolate_node(nodes, rng: random.Random):
+    """Cuts one node off from everyone else."""
+    nodes = list(nodes)
+    n = rng.choice(nodes)
+    rest = set(nodes) - {n}
+    grudge = {n: set(rest)}
+    for d in rest:
+        grudge[d] = {n}
+    return f"isolated {n}", grudge
+
+
+GRUDGES = [split_half, isolate_node]
+
+
+class PartitionNemesis:
+    """Executes nemesis ops against the network's fault API."""
+
+    def __init__(self, net, nodes, seed: int = 0):
+        self.net = net
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+
+    def invoke(self, op: dict) -> dict:
+        f = op["f"]
+        if f == "start-partition":
+            name, grudge = self.rng.choice(GRUDGES)(self.nodes, self.rng)
+            for dest, srcs in grudge.items():
+                for src in srcs:
+                    self.net.drop_link(src, dest)
+            return {**op, "type": "info", "value": name}
+        if f == "stop-partition":
+            self.net.heal()
+            return {**op, "type": "info", "value": "healed"}
+        raise ValueError(f"unknown nemesis op {f!r}")
+
+
+def package(faults: set, interval_s: float = 10.0):
+    """Builds {generator, final_generator} for the requested fault set
+    (only :partition, like the reference CLI, `core.clj:40-42`)."""
+    if "partition" not in faults:
+        return {"generator": None, "final_generator": None}
+
+    def cycle():
+        while True:
+            yield g.sleep(interval_s)
+            yield {"f": "start-partition", "type": "invoke"}
+            yield g.sleep(interval_s)
+            yield {"f": "stop-partition", "type": "invoke"}
+
+    return {"generator": g.Seq(cycle()),
+            "final_generator": g.Once({"f": "stop-partition",
+                                       "type": "invoke"})}

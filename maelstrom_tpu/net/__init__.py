@@ -1,0 +1,2 @@
+"""Network simulation: host path (threads + queues, reference semantics)
+and TPU path (batched mailbox arrays, `maelstrom_tpu.net.tpu`)."""

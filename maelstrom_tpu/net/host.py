@@ -1,0 +1,207 @@
+"""The host-path simulated network: threads, queues, real time.
+
+A faithful reimplementation of the reference's in-JVM network
+(`src/maelstrom/net.clj`): per-node priority queues ordered by latency
+deadline, probabilistic loss applied at send, directional partitions applied
+at receive, clients given zero latency, every send/recv journaled. This path
+exists for compatibility — it runs *external node binaries* and host-side
+services exactly like the reference. The TPU path
+(`maelstrom_tpu.net.tpu`) replaces it for batched built-in nodes.
+
+Faults (reference `net.clj:104-121`): `drop_link!` adds src to dest's block
+set, `heal!` clears partitions, `slow!` scales latency x10, `fast!` unscales,
+`flaky!` sets p_loss = 0.5.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import math
+import random
+import threading
+import time as _time
+from typing import Optional
+
+from ..errors import RPCError
+from ..message import Message, message, validate
+from ..util import involves_client
+from .journal import Journal
+
+log = logging.getLogger("maelstrom.net")
+
+
+class LatencyDist:
+    """Latency distributions (reference `net.clj:64-76`):
+    constant(mean), uniform over [0, 2*mean], exponential with mean."""
+
+    def __init__(self, mean: float = 0, dist: str = "constant",
+                 scale: float = 1.0):
+        assert dist in ("constant", "uniform", "exponential"), dist
+        self.mean = mean
+        self.dist = dist
+        self.scale = scale
+
+    def draw(self, rng: random.Random) -> float:
+        if self.mean <= 0:
+            base = 0.0
+        elif self.dist == "constant":
+            base = self.mean
+        elif self.dist == "uniform":
+            base = rng.uniform(0, 2 * self.mean)
+        else:
+            base = rng.expovariate(1.0 / self.mean)
+        return base * self.scale
+
+    def scaled(self, factor: float) -> "LatencyDist":
+        return LatencyDist(self.mean, self.dist, self.scale * factor)
+
+    def unscaled(self) -> "LatencyDist":
+        return LatencyDist(self.mean, self.dist, 1.0)
+
+
+class _NodeQueue:
+    """A blocking priority queue of (deadline, seq, Message), mirroring the
+    per-node PriorityBlockingQueue (reference `net.clj:143-144`)."""
+
+    def __init__(self):
+        self.heap = []
+        self.cond = threading.Condition()
+        self.seq = itertools.count()
+
+    def put(self, deadline: float, msg: Message):
+        with self.cond:
+            heapq.heappush(self.heap, (deadline, next(self.seq), msg))
+            self.cond.notify()
+
+    def poll(self, timeout_s: float):
+        """Pops the earliest-deadline entry, waiting up to timeout_s.
+        Like PriorityBlockingQueue.poll: returns as soon as *any* entry
+        exists (the deadline sleep happens in recv)."""
+        deadline = _time.monotonic() + timeout_s
+        with self.cond:
+            while not self.heap:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.cond.wait(remaining)
+            return heapq.heappop(self.heap)
+
+
+class HostNet:
+    """The mutable simulated network (reference `net.clj:78-102`)."""
+
+    def __init__(self, latency: dict | None = None, log_send: bool = False,
+                 log_recv: bool = False, seed: int = 0):
+        latency = latency or {}
+        self.latency_dist = LatencyDist(latency.get("mean", 0),
+                                        latency.get("dist", "constant"))
+        self.log_send = log_send
+        self.log_recv = log_recv
+        self.journal: Journal | None = None
+        self.p_loss = 0.0
+        self.partitions: dict[str, set[str]] = {}   # dest -> blocked srcs
+        self.queues: dict[str, _NodeQueue] = {}
+        self.next_client_id = itertools.count(0)
+        self.next_message_id = itertools.count(0)
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        self.t0 = _time.monotonic_ns()
+
+    # --- lifecycle ---
+
+    def time_ns(self) -> int:
+        """Linear time since network creation."""
+        return _time.monotonic_ns() - self.t0
+
+    def add_node(self, node_id: str):
+        assert isinstance(node_id, str), f"node id {node_id!r} must be a string"
+        with self.lock:
+            self.queues[node_id] = _NodeQueue()
+        return self
+
+    def remove_node(self, node_id: str):
+        with self.lock:
+            self.queues.pop(node_id, None)
+        return self
+
+    def node_ids(self):
+        return list(self.queues)
+
+    def queue_for(self, node: str) -> _NodeQueue:
+        q = self.queues.get(node)
+        if q is None:
+            # reference net.clj:153-163: error 1, definite
+            raise RPCError(1, {"text": f"No such node in network: {node!r}"})
+        return q
+
+    # --- fault API (reference net.clj:104-121) ---
+
+    def drop_link(self, src: str, dest: str):
+        with self.lock:
+            self.partitions.setdefault(dest, set()).add(src)
+
+    def heal(self):
+        with self.lock:
+            self.partitions = {}
+
+    def slow(self, factor: float = 10.0):
+        self.latency_dist = self.latency_dist.scaled(factor)
+
+    def fast(self):
+        self.latency_dist = self.latency_dist.unscaled()
+
+    def flaky(self, p: float = 0.5):
+        self.p_loss = p
+
+    # --- send / recv (reference net.clj:188-246) ---
+
+    def latency_for_ms(self, msg: Message) -> float:
+        """Clients get zero latency — latency on clients *hides* consistency
+        anomalies (reference `net.clj:177-186`)."""
+        if involves_client(msg):
+            return 0.0
+        return self.latency_dist.draw(self.rng)
+
+    def send(self, msg) -> Message:
+        if isinstance(msg, dict):
+            msg = message(msg.get("src"), msg.get("dest"), msg.get("body"))
+        msg = Message(id=next(self.next_message_id), src=msg.src,
+                      dest=msg.dest, body=msg.body)
+        validate(msg)
+        if msg.src not in self.queues:
+            raise AssertionError(f"Invalid source for message {msg!r}")
+        dest_q = self.queue_for(msg.dest)
+        deadline_ns = self.time_ns() + int(self.latency_for_ms(msg) * 1e6)
+
+        if self.journal is not None:
+            self.journal.log_send(msg, self.time_ns())
+        if self.log_send:
+            log.info("send %r", msg)
+
+        if self.rng.random() < self.p_loss:
+            return msg      # whoops, lost ur packet (net.clj:213-214)
+        dest_q.put(deadline_ns, msg)
+        return msg
+
+    def recv(self, node: str, timeout_ms: float) -> Optional[Message]:
+        """Receive a message for `node`, waiting up to timeout_ms. Applies
+        partitions at delivery time and sleeps until the latency deadline
+        (reference `net.clj:222-246`). Returns None on timeout or when the
+        popped message is partitioned away (which consumes it)."""
+        entry = self.queue_for(node).poll(timeout_ms / 1000.0)
+        if entry is None:
+            return None
+        deadline_ns, _, msg = entry
+        blocked = self.partitions.get(node, ())
+        if msg.src in blocked:
+            return None     # consumed and dropped, like the reference
+        dt_ns = deadline_ns - self.time_ns()
+        if dt_ns > 0:
+            _time.sleep(dt_ns / 1e9)
+        if self.log_recv:
+            log.info("recv %r", msg)
+        if self.journal is not None:
+            self.journal.log_recv(msg, self.time_ns())
+        return msg

@@ -1,0 +1,203 @@
+"""The network journal: a log of every send/recv event.
+
+The journal is the framework's tracing system (reference
+`src/maelstrom/net/journal.clj`): every send and receive is recorded as an
+Event `(id, time, type, message)` and folded at analysis time into
+send/recv/unique-message statistics split across all/clients/servers
+(reference `net/checker.clj:28-41`), plus msgs-per-op.
+
+Two ingestion paths:
+  - host path: `log_send`/`log_recv` record one Event per call (thread-safe),
+    retaining bodies (needed for Lamport diagrams).
+  - TPU path: `log_batch` accepts numpy arrays straight off the device —
+    thousands of events per call, no per-message Python cost. Bodies stay on
+    the device side; only (id, time, type, src_idx, dest_idx) land here.
+
+Events are spilled to `net-journal/` in the store dir as jsonl (host events)
+and .npz chunks (batched events).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..util import is_client
+
+SEND = "send"
+RECV = "recv"
+
+
+@dataclass
+class Event:
+    id: int
+    time: int           # linear-time nanoseconds
+    type: str           # send | recv
+    src: str
+    dest: str
+    body: Optional[dict] = None
+
+
+class Journal:
+    def __init__(self, dir: str | None = None, retain_bodies: bool = True):
+        self.dir = dir
+        self.retain_bodies = retain_bodies
+        self.events: list[Event] = []
+        self.chunks: list[dict] = []    # batched numpy event chunks
+        self.lock = threading.Lock()
+        self.closed = False
+
+    # --- host path (reference journal.clj:225-239) ---
+
+    def log_send(self, message, time_ns: int):
+        self._log(SEND, message, time_ns)
+
+    def log_recv(self, message, time_ns: int):
+        self._log(RECV, message, time_ns)
+
+    def _log(self, type: str, message, time_ns: int):
+        e = Event(id=message.id, time=time_ns, type=type, src=message.src,
+                  dest=message.dest,
+                  body=message.body if self.retain_bodies else None)
+        with self.lock:
+            self.events.append(e)
+
+    # --- TPU path ---
+
+    def log_batch(self, type: str, ids, times, srcs, dests, node_names=None):
+        """Record a batch of events from device arrays. srcs/dests are node
+        *indices*; node_names maps index -> node id string (kept per-chunk so
+        stats can classify client vs server traffic)."""
+        ids = np.asarray(ids)
+        if ids.size == 0:
+            return
+        chunk = {"type": type,
+                 "ids": ids.astype(np.int64),
+                 "times": np.asarray(times).astype(np.int64),
+                 "srcs": np.asarray(srcs).astype(np.int32),
+                 "dests": np.asarray(dests).astype(np.int32),
+                 "node_names": node_names}
+        with self.lock:
+            self.chunks.append(chunk)
+
+    # --- folds (reference journal.clj:305-347, net/checker.clj:28-41) ---
+
+    def stats(self, op_count: int | None = None) -> dict:
+        """send/recv/unique-message counts for all/clients/servers, plus
+        msgs-per-op when op_count is given."""
+        groups = {"all": lambda c: True,
+                  "clients": lambda c: c,
+                  "servers": lambda c: not c}
+        counts = {g: {"send-count": 0, "recv-count": 0} for g in groups}
+        ids = {g: set() for g in groups}
+
+        with self.lock:
+            events = list(self.events)
+            chunks = list(self.chunks)
+
+        for e in events:
+            involves_client = is_client(e.src) or is_client(e.dest)
+            for g, pred in groups.items():
+                if pred(involves_client):
+                    counts[g][f"{e.type}-count"] += 1
+                    ids[g].add(e.id)
+
+        # Batched chunks: vectorized classification
+        for ch in chunks:
+            names = ch["node_names"]
+            if names is not None:
+                client_mask = np.array([is_client(n) for n in names])
+                involves = (client_mask[ch["srcs"]]
+                            | client_mask[ch["dests"]])
+            else:
+                involves = np.zeros(len(ch["ids"]), dtype=bool)
+            key = f"{ch['type']}-count"
+            for g, sel in (("all", np.ones_like(involves)),
+                           ("clients", involves),
+                           ("servers", ~involves)):
+                n = int(sel.sum())
+                counts[g][key] += n
+                if n:
+                    ids[g].update(ch["ids"][sel].tolist())
+
+        out = {}
+        for g in groups:
+            out[g] = {**counts[g], "msg-count": len(ids[g])}
+        if op_count:
+            out["all"]["msgs-per-op"] = out["all"]["msg-count"] / op_count
+            out["servers"]["msgs-per-op"] = (
+                out["servers"]["msg-count"] / op_count)
+        return out
+
+    def all_events(self) -> list[Event]:
+        """Materializes every event (host + batched) sorted by time. Used by
+        the Lamport diagram plotter; beware on huge runs (viz caps itself at
+        10k events, reference `net/viz.clj:13-16`)."""
+        with self.lock:
+            events = list(self.events)
+            chunks = list(self.chunks)
+        for ch in chunks:
+            names = ch["node_names"]
+            for i in range(len(ch["ids"])):
+                src = names[ch["srcs"][i]] if names is not None else str(
+                    ch["srcs"][i])
+                dest = names[ch["dests"][i]] if names is not None else str(
+                    ch["dests"][i])
+                events.append(Event(id=int(ch["ids"][i]),
+                                    time=int(ch["times"][i]),
+                                    type=ch["type"], src=src, dest=dest))
+        events.sort(key=lambda e: (e.time, e.id))
+        return events
+
+    def counts(self) -> dict:
+        with self.lock:
+            n_host = len(self.events)
+            n_batch = sum(len(c["ids"]) for c in self.chunks)
+        return {"host-events": n_host, "batched-events": n_batch,
+                "total": n_host + n_batch}
+
+    # --- persistence (reference journal.clj:183-223 writes stripes) ---
+
+    def close(self):
+        if self.closed or not self.dir:
+            self.closed = True
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        with self.lock:
+            with open(os.path.join(self.dir, "events.jsonl"), "w") as f:
+                for e in self.events:
+                    f.write(json.dumps(
+                        {"id": e.id, "time": e.time, "type": e.type,
+                         "src": e.src, "dest": e.dest, "body": e.body},
+                        default=str) + "\n")
+            for i, ch in enumerate(self.chunks):
+                np.savez_compressed(
+                    os.path.join(self.dir, f"chunk-{i:06d}.npz"),
+                    type=ch["type"], ids=ch["ids"], times=ch["times"],
+                    srcs=ch["srcs"], dests=ch["dests"],
+                    node_names=np.array(ch["node_names"] or [], dtype=object))
+        self.closed = True
+
+    @classmethod
+    def load(cls, dir: str) -> "Journal":
+        j = cls(dir=dir)
+        path = os.path.join(dir, "events.jsonl")
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    d = json.loads(line)
+                    j.events.append(Event(**d))
+        for name in sorted(os.listdir(dir)):
+            if name.startswith("chunk-") and name.endswith(".npz"):
+                z = np.load(os.path.join(dir, name), allow_pickle=True)
+                j.chunks.append({
+                    "type": str(z["type"]), "ids": z["ids"],
+                    "times": z["times"], "srcs": z["srcs"],
+                    "dests": z["dests"],
+                    "node_names": list(z["node_names"]) or None})
+        return j

@@ -1,0 +1,140 @@
+"""External node processes: spawning and stdio pumping.
+
+The compatibility boundary with the reference (`src/maelstrom/process.clj`):
+a node is any binary speaking newline-delimited JSON on STDIN/STDOUT and
+logging to STDERR. We spawn one OS process per node with three pump threads
+(stdin <- net.recv, stdout -> parse -> net.send, stderr -> log file), keep
+32-line ring buffers of recent output for crash reports, and detect crashes
+at teardown (any exit before teardown -- even status 0 -- raises a rich
+exception, matching the reference `process.clj:222-250`: nodes must run
+until killed).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import threading
+from collections import deque
+
+from .message import MalformedMessage, parse_msg
+
+log = logging.getLogger("maelstrom.process")
+
+DEBUG_BUFFER_SIZE = 32      # reference process.clj:22-24
+
+
+class NodeCrashed(Exception):
+    def __init__(self, node_id, exit_code, stdout_tail, stderr_tail,
+                 log_file):
+        self.node_id = node_id
+        self.exit_code = exit_code
+        super().__init__(
+            f"Node {node_id} crashed with exit status {exit_code}. Before "
+            "crashing, it wrote to STDOUT:\n\n" + "\n".join(stdout_tail) +
+            "\n\nAnd to STDERR:\n\n" + "\n".join(stderr_tail) +
+            f"\n\nFull STDERR logs are available in {log_file}")
+
+
+class NodeProcess:
+    """A running node binary plus its three I/O pump threads
+    (reference `process.clj:168-215`)."""
+
+    def __init__(self, node_id: str, bin: str, args: list[str], net,
+                 log_file: str, log_stderr: bool = False, dir: str = None):
+        self.node_id = node_id
+        self.net = net
+        self.log_file = log_file
+        self.running = True
+        self.stdout_buffer = deque(maxlen=DEBUG_BUFFER_SIZE)
+        self.stderr_buffer = deque(maxlen=DEBUG_BUFFER_SIZE)
+
+        net.add_node(node_id)
+        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+        self.log_writer = open(log_file, "w")
+        bin_path = os.path.abspath(bin)
+        log.info("launching %s %r", bin_path, args)
+        self.process = subprocess.Popen(
+            [bin_path] + list(args),
+            cwd=dir or None,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        self.log_stderr = log_stderr
+
+        self.threads = [
+            threading.Thread(target=self._stdin_loop,
+                             name=f"{node_id} stdin", daemon=True),
+            threading.Thread(target=self._stdout_loop,
+                             name=f"{node_id} stdout", daemon=True),
+            threading.Thread(target=self._stderr_loop,
+                             name=f"{node_id} stderr", daemon=True),
+        ]
+        for t in self.threads:
+            t.start()
+
+    # --- pumps (reference process.clj:115-166) ---
+
+    def _stdin_loop(self):
+        """net.recv -> process stdin (reference `process.clj:154-166`)."""
+        while self.running:
+            try:
+                msg = self.net.recv(self.node_id, 1000)
+                if msg is not None:
+                    self.process.stdin.write(
+                        json.dumps(msg.to_json()) + "\n")
+                    self.process.stdin.flush()
+            except (BrokenPipeError, ValueError, OSError):
+                pass    # process crashed; teardown will report it
+            except Exception:
+                log.exception("Error in %s stdin pump", self.node_id)
+
+    def _stdout_loop(self):
+        """process stdout -> parse -> net.send
+        (reference `process.clj:136-152`)."""
+        for line in self.process.stdout:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            self.stdout_buffer.append(line)
+            try:
+                self.net.send(parse_msg(self.node_id, line))
+            except MalformedMessage as e:
+                log.error("%s", e)
+            except Exception:
+                if self.running:
+                    log.exception("Error handling stdout of %s",
+                                  self.node_id)
+
+    def _stderr_loop(self):
+        """process stderr -> log file + ring buffer
+        (reference `process.clj:115-134`)."""
+        for line in self.process.stderr:
+            line = line.rstrip("\n")
+            if self.log_stderr:
+                log.info("%s: %s", self.node_id, line)
+            self.stderr_buffer.append(line)
+            try:
+                self.log_writer.write(line + "\n")
+                self.log_writer.flush()
+            except ValueError:
+                break   # log closed during teardown
+
+    # --- teardown (reference process.clj:217-256) ---
+
+    def stop(self) -> dict:
+        crashed = self.process.poll() is not None
+        if not crashed:
+            self.process.kill()
+            self.process.wait(timeout=5)
+        self.running = False
+        for t in self.threads:
+            t.join(timeout=2)
+        self.net.remove_node(self.node_id)
+        self.log_writer.close()
+        if crashed:
+            raise NodeCrashed(self.node_id, self.process.returncode,
+                              list(self.stdout_buffer),
+                              list(self.stderr_buffer), self.log_file)
+        return {"exit": self.process.returncode}

@@ -1,0 +1,4 @@
+"""Test runners: the generator interpreters that drive clients against the
+network and record histories. `host_runner` uses real threads and wall-clock
+time (for external-binary nodes); `tpu_runner` drives the batched TPU
+simulation in virtual time."""

@@ -1,0 +1,150 @@
+"""The host-path generator interpreter: real threads, real time.
+
+The equivalent of jepsen.core/run!'s worker loop for the compatibility path
+(external node binaries): N client worker threads each own a connection;
+the main loop asks the generator for ops, dispatches them to free workers,
+and records invoke/completion pairs in the history. The nemesis runs as one
+extra worker applying fault ops to the network
+(reference call stack, SURVEY.md section 3.1).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+
+from .. import generators as g
+from ..history import History, Op
+
+log = logging.getLogger("maelstrom.runner")
+
+
+class Worker(threading.Thread):
+    """One client worker: owns a connection, executes ops serially."""
+
+    def __init__(self, process, client, node: str, test: dict,
+                 results: "queue.Queue"):
+        super().__init__(name=f"worker-{process}", daemon=True)
+        self.process = process
+        self.client = client
+        self.node = node
+        self.test = test
+        self.results = results
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.running = True
+
+    def run(self):
+        while self.running:
+            try:
+                op = self.inbox.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if op is None:
+                return
+            try:
+                completed = self.client.invoke(self.test, op)
+            except Exception as e:
+                log.exception("process %s op crashed", self.process)
+                completed = {**op, "type": "info",
+                             "error": ["exception", repr(e)]}
+            self.results.put((self.process, completed))
+
+    def stop(self):
+        self.running = False
+        self.inbox.put(None)
+
+
+def run_test(test: dict) -> History:
+    """Drives the generator against live clients. `test` needs:
+    nodes, net, client (factory with open/setup/invoke/close),
+    generator (composed), concurrency, nemesis (invoke(op) executor or
+    None), time_source (callable -> ns, defaults to net.time_ns)."""
+    net = test["net"]
+    nodes = test["nodes"]
+    concurrency = test.get("concurrency", len(nodes))
+    time_source = test.get("time_source", net.time_ns)
+    gen = g.to_gen(test["generator"])
+    nemesis = test.get("nemesis")
+
+    history = History()
+    results: "queue.Queue" = queue.Queue()
+    workers: dict = {}
+    processes = []
+
+    for i in range(concurrency):
+        node = nodes[i % len(nodes)]
+        client = test["client"].open(test, node)
+        client.setup(test)
+        w = Worker(i, client, node, test, results)
+        w.start()
+        workers[i] = w
+        processes.append(i)
+    if nemesis is not None:
+        processes.append(g.NEMESIS)
+
+    free = set(processes)
+    deadline = _time.monotonic() + test.get("hard_deadline_s", 3600)
+    lock = threading.Lock()
+
+    def nemesis_invoke(op):
+        completed = nemesis.invoke(op)
+        results.put((g.NEMESIS, completed))
+
+    try:
+        while _time.monotonic() < deadline:
+            # Drain completions
+            try:
+                while True:
+                    process, completed = results.get_nowait()
+                    op = Op(type=completed.get("type", "info"),
+                            f=completed.get("f"),
+                            value=completed.get("value"),
+                            process=process, time=time_source(),
+                            error=completed.get("error"),
+                            final=completed.get("final", False))
+                    history.append(op)
+                    free.add(process)
+                    ctx = {"time": time_source(), "free": sorted(free, key=str),
+                           "processes": processes}
+                    gen = gen.update(ctx, completed)
+            except queue.Empty:
+                pass
+
+            ctx = {"time": time_source(), "free": sorted(free, key=str),
+                   "processes": processes}
+            res, gen = gen.op(ctx)
+            if res is None:
+                if len(free) == len(processes):
+                    break       # exhausted and quiescent
+                _time.sleep(0.001)
+                continue
+            if res == g.PENDING:
+                _time.sleep(0.001)
+                continue
+            # Dispatch
+            process = res["process"]
+            free.discard(process)
+            invoke = Op(type="invoke", f=res.get("f"),
+                        value=res.get("value"), process=process,
+                        time=time_source(),
+                        final=res.get("final", False))
+            history.append(invoke)
+            op_for_worker = {k: v for k, v in res.items() if k != "time"}
+            if process == g.NEMESIS:
+                threading.Thread(target=nemesis_invoke,
+                                 args=(op_for_worker,), daemon=True).start()
+            else:
+                workers[process].inbox.put(op_for_worker)
+    finally:
+        for w in workers.values():
+            w.stop()
+        for w in workers.values():
+            w.join(timeout=2)
+        for w in workers.values():
+            try:
+                w.client.close()
+            except Exception:
+                pass
+    return history

@@ -1,0 +1,58 @@
+"""`serve`: a web server over the store directory, for browsing past test
+runs (the counterpart of jepsen's serve-cmd, reference `core.clj:230`,
+`doc/results.md:7-10`)."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import socketserver
+from functools import partial
+
+
+class StoreHandler(http.server.SimpleHTTPRequestHandler):
+    """Serves store files, rendering directory listings with validity
+    badges pulled from results.json."""
+
+    def list_directory(self, path):
+        try:
+            entries = sorted(os.listdir(path))
+        except OSError:
+            self.send_error(404)
+            return None
+        rel = os.path.relpath(path, self.directory)
+        rows = []
+        for name in entries:
+            full = os.path.join(path, name)
+            badge = ""
+            results = os.path.join(full, "results.json")
+            if os.path.isdir(full) and os.path.exists(results):
+                try:
+                    with open(results) as f:
+                        valid = json.load(f).get("valid")
+                    color = {"True": "#2ca02c", "False": "#d62728"}.get(
+                        str(valid), "#ff7f0e")
+                    badge = (f' <span style="color:{color}">'
+                             f'[valid: {valid}]</span>')
+                except Exception:
+                    pass
+            slash = "/" if os.path.isdir(full) else ""
+            rows.append(f'<li><a href="{name}{slash}">{name}{slash}</a>'
+                        f'{badge}</li>')
+        body = (f"<html><head><title>store: {rel}</title></head><body>"
+                f"<h2>{rel}</h2><ul>{''.join(rows)}</ul></body></html>")
+        encoded = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(encoded)))
+        self.end_headers()
+        self.wfile.write(encoded)
+        return None
+
+
+def serve(store_root: str = "store", port: int = 8080):
+    handler = partial(StoreHandler, directory=os.path.abspath(store_root))
+    with socketserver.TCPServer(("", port), handler) as httpd:
+        print(f"Serving {store_root} on http://localhost:{port}")
+        httpd.serve_forever()

@@ -1,0 +1,277 @@
+"""Built-in services: infrastructure nodes Maelstrom runs for your nodes.
+
+Reimplements `src/maelstrom/service.clj`: pure persistent state machines
+(`PersistentKV` read/write/cas with create_if_not_exists, `LWWKV` with
+Lamport clocks and last-write-wins merge, `PersistentTSO`) wrapped in
+consistency adapters:
+
+  - Linearizable: all ops act on the single latest state
+    (`service.clj:141-149`)
+  - Sequential: ops may act on any past state consistent with per-client
+    monotonicity; state-changing ops jump to the newest state
+    (`service.clj:161-209`)
+  - Eventual: n independent replicas, randomly gossiped/merged
+    (`service.clj:213-242`)
+
+Default services (`service.clj:289-295`): lww-kv (eventual LWWKV), seq-kv
+(sequential KV), lin-kv (linearizable KV), lin-tso (linearizable TSO).
+
+Services are *pure handlers* plus thin adapters, so the same implementations
+run as host threads (reference style, `service_thread`) or synchronously
+inside the TPU runner's virtual-time loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from collections import deque
+
+from .errors import error_body
+
+log = logging.getLogger("maelstrom.service")
+
+
+# --- Persistent (pure) services -------------------------------------------
+
+class PersistentKV:
+    """Immutable KV state machine (reference `service.clj:31-56`)."""
+
+    def __init__(self, m: dict | None = None):
+        self.m = m if m is not None else {}
+
+    def handle(self, message):
+        body = message.body
+        k = _key(body.get("key"))
+        t = body["type"]
+        if t == "read":
+            if k in self.m:
+                return self, {"type": "read_ok", "value": self.m[k]}
+            return self, error_body(20, "key does not exist")
+        if t == "write":
+            return (PersistentKV({**self.m, k: body.get("value")}),
+                    {"type": "write_ok"})
+        if t == "cas":
+            if k in self.m:
+                if body.get("from") == self.m[k]:
+                    return (PersistentKV({**self.m, k: body.get("to")}),
+                            {"type": "cas_ok"})
+                return self, error_body(
+                    22, f"current value {self.m[k]!r} is not "
+                        f"{body.get('from')!r}")
+            if body.get("create_if_not_exists"):
+                return (PersistentKV({**self.m, k: body.get("to")}),
+                        {"type": "cas_ok"})
+            return self, error_body(20, "key does not exist")
+        return self, error_body(10, f"unsupported op {t!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, PersistentKV) and self.m == other.m
+
+
+class LWWKV:
+    """Last-write-wins KV with a Lamport clock; values carry timestamps and
+    merge by (ts, then keep-ours) (reference `service.clj:65-106`)."""
+
+    def __init__(self, clock: int = 0, m: dict | None = None):
+        self.clock = clock
+        self.m = m if m is not None else {}   # key -> (ts, value)
+
+    def handle(self, message):
+        body = message.body
+        k = _key(body.get("key"))
+        t = body["type"]
+        if t == "read":
+            if k in self.m:
+                return self, {"type": "read_ok", "value": self.m[k][1]}
+            return self, error_body(20, "key does not exist")
+        if t == "write":
+            return (LWWKV(self.clock + 1,
+                          {**self.m, k: (self.clock, body.get("value"))}),
+                    {"type": "write_ok"})
+        if t == "cas":
+            if k in self.m:
+                if body.get("from") == self.m[k][1]:
+                    return (LWWKV(self.clock + 1,
+                                  {**self.m, k: (self.clock,
+                                                 body.get("to"))}),
+                            {"type": "cas_ok"})
+                return self, error_body(
+                    22, f"current value {self.m[k][1]!r} is not "
+                        f"{body.get('from')!r}")
+            return self, error_body(20, "key does not exist")
+        return self, error_body(10, f"unsupported op {t!r}")
+
+    def merge(self, other: "LWWKV") -> "LWWKV":
+        """Lamport-clock max; per-key merge by timestamp, ties keep ours
+        (reference `service.clj:93-106`)."""
+        m = dict(self.m)
+        for k, (ts2, v2) in other.m.items():
+            if k not in m or m[k][0] < ts2:
+                m[k] = (ts2, v2)
+        return LWWKV(max(self.clock, other.clock), m)
+
+    def __eq__(self, other):
+        return (isinstance(other, LWWKV) and self.clock == other.clock
+                and self.m == other.m)
+
+
+class PersistentTSO:
+    """Monotonic timestamp oracle starting at 0
+    (reference `service.clj:116-122`)."""
+
+    def __init__(self, ts: int = 0):
+        self.ts = ts
+
+    def handle(self, message):
+        body = message.body
+        if body["type"] == "ts":
+            return PersistentTSO(self.ts + 1), {"type": "ts_ok",
+                                                "ts": self.ts}
+        return self, error_body(10, f"unsupported op {body['type']!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, PersistentTSO) and self.ts == other.ts
+
+
+def _key(k):
+    """JSON object keys are strings; normalize numeric keys the way JSON
+    round-tripping would, so `0` and `"0"` behave consistently."""
+    return k
+
+
+# --- Consistency adapters -------------------------------------------------
+
+class Linearizable:
+    """All ops act atomically on the latest state
+    (reference `service.clj:141-149`)."""
+
+    def __init__(self, state):
+        self.state = state
+        self.lock = threading.Lock()
+
+    def handle(self, message) -> dict:
+        with self.lock:
+            self.state, res = self.state.handle(message)
+            return res
+
+
+class Sequential:
+    """Ops may act on any past state consistent with each client's monotonic
+    view; state-changing ops jump to the newest state
+    (reference `service.clj:161-209`)."""
+
+    def __init__(self, state, buffer_size: int = 32, seed: int = 0):
+        self.buffer = deque([state], maxlen=buffer_size)
+        self.last_index = 0
+        self.clients: dict[str, int] = {}
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+
+    def handle(self, message) -> dict:
+        client = message.src
+        with self.lock:
+            client_index = self.clients.get(client, 0)
+            # States older than the ring buffer retains are unreachable;
+            # clamp lagging clients forward to the oldest retained state.
+            oldest = self.last_index - len(self.buffer) + 1
+            client_index = max(client_index, oldest)
+            span = self.last_index - client_index
+            index = client_index + (self.rng.randrange(span + 1)
+                                    if span > 0 else 0)
+            # negative offset into buffer: -1 is last_index
+            service = self.buffer[index - self.last_index - 1]
+            service2, res = service.handle(message)
+            if service2 == service:
+                # read-only on a past state: timeline safe
+                self.clients[client] = index
+                return res
+            # state-changing: execute on the newest state instead
+            service2, res = self.buffer[-1].handle(message)
+            self.last_index += 1
+            self.clients[client] = self.last_index
+            self.buffer.append(service2)
+            return res
+
+
+class Eventual:
+    """n independent replicas; each op first gossips one random replica into
+    another, then applies to a random replica
+    (reference `service.clj:213-242`)."""
+
+    def __init__(self, state, n: int = 2, seed: int = 0):
+        self.replicas = [state] * n
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+
+    def handle(self, message) -> dict:
+        with self.lock:
+            n = len(self.replicas)
+            src, dst = self.rng.randrange(n), self.rng.randrange(n)
+            self.replicas[dst] = self.replicas[src].merge(self.replicas[dst])
+            i = self.rng.randrange(n)
+            self.replicas[i], res = self.replicas[i].handle(message)
+            return res
+
+
+# --- Running services ------------------------------------------------------
+
+class ServiceRunner:
+    """Runs a map of node-id -> service. In host mode, spawns one handler
+    thread per service polling the network (reference `service.clj:244-287`);
+    in direct mode (TPU virtual-time runner), `deliver` is called
+    synchronously at message-delivery time."""
+
+    def __init__(self, net, services: dict):
+        self.net = net
+        self.services = services
+        self.running = False
+        self.threads: list[threading.Thread] = []
+
+    def start(self):
+        log.info("Starting services: %s", sorted(self.services))
+        self.running = True
+        for node_id, service in self.services.items():
+            self.net.add_node(node_id)
+            t = threading.Thread(target=self._loop,
+                                 args=(node_id, service),
+                                 name=f"maelstrom {node_id}", daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def _loop(self, node_id, service):
+        while self.running:
+            try:
+                msg = self.net.recv(node_id, 1000)
+                if msg is not None:
+                    self._respond(node_id, service, msg)
+            except Exception:
+                if self.running:
+                    log.exception("Error in service worker!")
+
+    def _respond(self, node_id, service, msg):
+        body = service.handle(msg)
+        body["in_reply_to"] = msg.body.get("msg_id")
+        self.net.send({"src": node_id, "dest": msg.src, "body": body})
+
+    def deliver(self, node_id: str, msg):
+        """Direct-mode delivery (virtual time): handle and reply now."""
+        self._respond(node_id, self.services[node_id], msg)
+
+    def stop(self):
+        self.running = False
+        for t in self.threads:
+            t.join(timeout=2)
+        for node_id in self.services:
+            self.net.remove_node(node_id)
+
+
+def default_services(n_eventual_replicas: int = 2, seed: int = 0) -> dict:
+    """lww-kv, seq-kv, lin-kv, lin-tso (reference `service.clj:289-295`)."""
+    return {
+        "lww-kv": Eventual(LWWKV(), n=n_eventual_replicas, seed=seed),
+        "seq-kv": Sequential(PersistentKV(), seed=seed),
+        "lin-kv": Linearizable(PersistentKV()),
+        "lin-tso": Linearizable(PersistentTSO()),
+    }

@@ -1,0 +1,65 @@
+"""The store directory: persistent test artifacts.
+
+Mirrors the reference's jepsen store layout (`doc/results.md:14-52`):
+
+    store/<test-name>/<timestamp>/
+        history.jsonl       the operation history
+        results.json        checker output (validity)
+        test.json           test parameters
+        net-journal/        journal events + batched chunks
+        node-logs/          per-node stderr
+        messages.svg        Lamport diagram
+        timeline.html       per-process op timeline
+        latency-raw.svg, latency-quantiles.svg, rate.svg
+
+`store/latest` and `store/<name>/latest` symlinks point at the newest run;
+`serve` (maelstrom_tpu.serve) browses past runs like jepsen's web server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from datetime import datetime
+
+
+def make_test_dir(root: str, test_name: str) -> str:
+    ts = datetime.now().strftime("%Y%m%dT%H%M%S.%f")[:-3]
+    d = os.path.join(root, test_name, ts)
+    os.makedirs(d, exist_ok=True)
+    _relink(os.path.join(root, test_name, "latest"), ts)
+    _relink(os.path.join(root, "latest"), os.path.join(test_name, ts))
+    return d
+
+
+def _relink(link: str, target: str):
+    try:
+        if os.path.islink(link):
+            os.unlink(link)
+        os.symlink(target, link)
+    except OSError:
+        pass
+
+
+def write_history(d: str, history):
+    with open(os.path.join(d, "history.jsonl"), "w") as f:
+        f.write(history.to_jsonl() + "\n")
+
+
+def write_results(d: str, results: dict):
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump(results, f, indent=2, default=str)
+
+
+def write_test(d: str, test: dict):
+    clean = {k: v for k, v in test.items()
+             if isinstance(v, (str, int, float, bool, list, dict,
+                               type(None)))}
+    with open(os.path.join(d, "test.json"), "w") as f:
+        json.dump(clean, f, indent=2, default=str)
+
+
+def load_results(d: str) -> dict:
+    with open(os.path.join(d, "results.json")) as f:
+        return json.load(f)

@@ -1,0 +1,4 @@
+"""Visualization: dependency-free SVG/HTML renderers for perf plots
+(latency/rate), Lamport spacetime diagrams, and op timelines — the
+counterparts of jepsen's perf charts, `net/viz.clj`'s messages.svg, and
+jepsen.checker.timeline's timeline.html."""

@@ -1,0 +1,93 @@
+"""Lamport spacetime diagrams: messages.svg.
+
+The counterpart of `src/maelstrom/net/viz.clj`: nodes as vertical lines
+(clients sorted first), time flowing downward, each message drawn as an
+arrow from its send event to its recv event, labeled with its body type.
+Client messages are blue, error messages pink (reference
+`net/viz.clj:113-120`); rendering truncates at 10,000 events
+(`net/viz.clj:13-16`)."""
+
+from __future__ import annotations
+
+from ..util import sort_clients, is_client
+
+MAX_EVENTS = 10_000
+NODE_W = 120
+ROW_H = 24
+TOP = 60
+
+
+def _color(e) -> str:
+    body = e.body or {}
+    if body.get("type") == "error":
+        return "#ff6fb3"        # pink
+    if is_client(e.src) or is_client(e.dest):
+        return "#6fa8ff"        # blue
+    return "#666666"
+
+
+def _label(e) -> str:
+    body = e.body or {}
+    t = body.get("type", "")
+    extra = ""
+    for k in ("key", "value", "delta", "message", "echo"):
+        if k in body:
+            extra = f" {body[k]!r}"
+            break
+    return f"{t}{extra}"[:28]
+
+
+def plot_lamport(journal, path: str | None = None) -> str:
+    """Renders the journal as an SVG spacetime diagram. Pairs send/recv by
+    message id (reference `net/viz.clj:27-56`)."""
+    events = journal.all_events()
+    truncated = len(events) > MAX_EVENTS
+    events = events[:MAX_EVENTS]
+
+    nodes = sort_clients({e.src for e in events} | {e.dest for e in events})
+    node_x = {n: NODE_W // 2 + i * NODE_W for i, n in enumerate(nodes)}
+
+    # Each event gets a row (its y position), in time order.
+    sends: dict = {}
+    arrows = []
+    for row, e in enumerate(events):
+        if e.type == "send":
+            sends[e.id] = (row, e)
+        else:
+            srow, se = sends.get(e.id, (row, e))
+            arrows.append((srow, row, se if se.body else e))
+
+    height = TOP + ROW_H * (len(events) + 1) + 40
+    width = NODE_W * max(len(nodes), 1) + 40
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="monospace" font-size="11">',
+           f'<rect width="{width}" height="{height}" fill="white"/>']
+    if truncated:
+        out.append(f'<text x="10" y="20" fill="#d62728">Truncated to '
+                   f'{MAX_EVENTS} events</text>')
+    for n in nodes:
+        x = node_x[n]
+        out.append(f'<text x="{x}" y="{TOP-20}" text-anchor="middle" '
+                   f'font-weight="bold">{n}</text>')
+        out.append(f'<line x1="{x}" y1="{TOP-10}" x2="{x}" '
+                   f'y2="{height-20}" stroke="#ccc"/>')
+    out.append('<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+               'refX="7" refY="3" orient="auto">'
+               '<path d="M0,0 L7,3 L0,6 z" fill="context-stroke"/>'
+               '</marker></defs>')
+    for srow, rrow, e in arrows:
+        x1, y1 = node_x.get(e.src, 0), TOP + srow * ROW_H
+        x2, y2 = node_x.get(e.dest, 0), TOP + rrow * ROW_H
+        c = _color(e)
+        out.append(f'<line x1="{x1}" y1="{y1}" x2="{x2}" y2="{y2}" '
+                   f'stroke="{c}" stroke-width="1.2" '
+                   'marker-end="url(#arr)"/>')
+        mx, my = (x1 + x2) / 2, (y1 + y2) / 2 - 3
+        out.append(f'<text x="{mx}" y="{my}" text-anchor="middle" '
+                   f'fill="{c}">{_label(e)}</text>')
+    out.append("</svg>")
+    svg = "\n".join(out)
+    if path:
+        with open(path, "w") as f:
+            f.write(svg)
+    return svg

@@ -1,0 +1,199 @@
+"""Minimal SVG chart rendering (no matplotlib dependency).
+
+Produces the perf artifacts the reference gets from jepsen's checker/perf
+(`core.clj:83-84`, `doc/results.md:36-46`): latency-raw (scatter of op
+latencies over time, colored by outcome), latency-quantiles (lines), and
+rate (ops/sec lines per f)."""
+
+from __future__ import annotations
+
+import math
+
+W, H = 900, 420
+ML, MR, MT, MB = 70, 130, 30, 50     # margins
+COLORS = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+          "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf"]
+OUTCOME_COLORS = {"ok": "#2ca02c", "info": "#ff7f0e", "fail": "#d62728"}
+
+
+def _nice_ticks(lo: float, hi: float, n: int = 6):
+    if hi <= lo:
+        hi = lo + 1
+    span = hi - lo
+    step = 10 ** math.floor(math.log10(span / max(n, 1)))
+    for mult in (1, 2, 5, 10):
+        if span / (step * mult) <= n:
+            step *= mult
+            break
+    t0 = math.ceil(lo / step) * step
+    ticks = []
+    t = t0
+    while t <= hi + 1e-12:
+        ticks.append(round(t, 10))
+        t += step
+    return ticks
+
+
+def _fmt(x: float) -> str:
+    if x == 0:
+        return "0"
+    if abs(x) >= 1000:
+        return f"{x:.0f}"
+    if abs(x) >= 1:
+        return f"{x:g}"
+    return f"{x:.3g}"
+
+
+def svg_chart(series: dict, title: str, xlabel: str, ylabel: str,
+              kind: str = "line", log_y: bool = False) -> str:
+    """series: name -> {"points": [(x, y), ...], "color": optional}."""
+    pts_all = [(x, y) for s in series.values() for x, y in s["points"]]
+    if not pts_all:
+        return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+                f'height="{H}"><text x="20" y="30">{title}: no data'
+                '</text></svg>')
+    xs = [p[0] for p in pts_all]
+    ys = [p[1] for p in pts_all]
+    x0, x1 = min(xs), max(xs)
+    if log_y:
+        ys_pos = [y for y in ys if y > 0] or [1e-3]
+        y0, y1 = math.log10(min(ys_pos)), math.log10(max(ys_pos))
+    else:
+        y0, y1 = 0 if min(ys) >= 0 else min(ys), max(ys)
+    if x1 <= x0:
+        x1 = x0 + 1
+    if y1 <= y0:
+        y1 = y0 + 1
+    pw, ph = W - ML - MR, H - MT - MB
+
+    def X(x):
+        return ML + (x - x0) / (x1 - x0) * pw
+
+    def Y(y):
+        if log_y:
+            y = math.log10(y) if y > 0 else y0
+        return MT + ph - (y - y0) / (y1 - y0) * ph
+
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+           f'height="{H}" font-family="sans-serif" font-size="12">',
+           f'<rect width="{W}" height="{H}" fill="white"/>',
+           f'<text x="{ML}" y="18" font-size="14" font-weight="bold">'
+           f'{title}</text>']
+    # axes + ticks
+    out.append(f'<line x1="{ML}" y1="{MT}" x2="{ML}" y2="{MT+ph}" '
+               'stroke="black"/>')
+    out.append(f'<line x1="{ML}" y1="{MT+ph}" x2="{ML+pw}" y2="{MT+ph}" '
+               'stroke="black"/>')
+    for t in _nice_ticks(x0, x1):
+        out.append(f'<line x1="{X(t):.1f}" y1="{MT+ph}" x2="{X(t):.1f}" '
+                   f'y2="{MT+ph+5}" stroke="black"/>'
+                   f'<text x="{X(t):.1f}" y="{MT+ph+18}" '
+                   f'text-anchor="middle">{_fmt(t)}</text>')
+    yticks = ([10 ** e for e in
+               range(math.floor(y0), math.ceil(y1) + 1)]
+              if log_y else _nice_ticks(y0, y1))
+    for t in yticks:
+        ty = Y(t)
+        out.append(f'<line x1="{ML-5}" y1="{ty:.1f}" x2="{ML}" '
+                   f'y2="{ty:.1f}" stroke="black"/>'
+                   f'<text x="{ML-8}" y="{ty+4:.1f}" text-anchor="end">'
+                   f'{_fmt(t)}</text>')
+        out.append(f'<line x1="{ML}" y1="{ty:.1f}" x2="{ML+pw}" '
+                   f'y2="{ty:.1f}" stroke="#eee"/>')
+    out.append(f'<text x="{ML+pw/2}" y="{H-8}" text-anchor="middle">'
+               f'{xlabel}</text>')
+    out.append(f'<text x="16" y="{MT+ph/2}" text-anchor="middle" '
+               f'transform="rotate(-90 16 {MT+ph/2})">{ylabel}</text>')
+
+    for i, (name, s) in enumerate(series.items()):
+        color = s.get("color") or COLORS[i % len(COLORS)]
+        pts = sorted(s["points"])
+        if kind == "line":
+            d = " ".join(f'{X(x):.1f},{Y(y):.1f}' for x, y in pts)
+            out.append(f'<polyline points="{d}" fill="none" '
+                       f'stroke="{color}" stroke-width="1.5"/>')
+        else:
+            for x, y in pts:
+                out.append(f'<circle cx="{X(x):.1f}" cy="{Y(y):.1f}" '
+                           f'r="2" fill="{color}" fill-opacity="0.6"/>')
+        ly = MT + 14 + 16 * i
+        out.append(f'<rect x="{W-MR+8}" y="{ly-9}" width="10" height="10" '
+                   f'fill="{color}"/>'
+                   f'<text x="{W-MR+22}" y="{ly}">{name}</text>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def perf_charts(history, out_dir: str):
+    """Writes latency-raw.svg, latency-quantiles.svg, rate.svg."""
+    import os
+    pairs = history.pairs()
+    # latency scatter: x = invoke time (s), y = latency (ms), by outcome
+    raw: dict = {}
+    lat_by_f: dict = {}
+    rate_by_f: dict = {}
+    for invoke, complete in pairs:
+        if invoke.process == "nemesis":
+            continue
+        t_s = invoke.time / 1e9
+        rate_by_f.setdefault(invoke.f, []).append(t_s)
+        if complete is None:
+            continue
+        lat_ms = max((complete.time - invoke.time) / 1e6, 1e-3)
+        raw.setdefault(complete.type, {"points": [],
+                                       "color": OUTCOME_COLORS.get(
+                                           complete.type)})[
+            "points"].append((t_s, lat_ms))
+        if complete.is_ok():
+            lat_by_f.setdefault(invoke.f, []).append((t_s, lat_ms))
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "latency-raw.svg"), "w") as f:
+        f.write(svg_chart(raw, "Latency (all ops)", "time (s)",
+                          "latency (ms)", kind="scatter", log_y=True))
+
+    # quantiles over windows
+    qseries: dict = {}
+    for fname, pts in lat_by_f.items():
+        pts.sort()
+        window = max((pts[-1][0] - pts[0][0]) / 20, 1e-9) if pts else 1
+        for q in (0.5, 0.95, 0.99):
+            qpts = []
+            i = 0
+            while i < len(pts):
+                j = i
+                lats = []
+                t_end = pts[i][0] + window
+                while j < len(pts) and pts[j][0] <= t_end:
+                    lats.append(pts[j][1])
+                    j += 1
+                lats.sort()
+                qpts.append((pts[i][0],
+                             lats[min(len(lats) - 1, int(q * len(lats)))]))
+                i = j
+            qseries[f"{fname} p{int(q*100)}"] = {"points": qpts}
+    with open(os.path.join(out_dir, "latency-quantiles.svg"), "w") as f:
+        f.write(svg_chart(qseries, "Latency quantiles", "time (s)",
+                          "latency (ms)", kind="line", log_y=True))
+
+    # rate: ops/sec per f over windows
+    rseries: dict = {}
+    for fname, times in rate_by_f.items():
+        times.sort()
+        if not times:
+            continue
+        window = max((times[-1] - times[0]) / 30, 1e-9)
+        pts = []
+        t = times[0]
+        i = 0
+        while i < len(times):
+            j = i
+            while j < len(times) and times[j] < t + window:
+                j += 1
+            pts.append((t, (j - i) / window))
+            i = j
+            t += window
+        rseries[str(fname)] = {"points": pts}
+    with open(os.path.join(out_dir, "rate.svg"), "w") as f:
+        f.write(svg_chart(rseries, "Request rate", "time (s)", "ops/sec",
+                          kind="line"))

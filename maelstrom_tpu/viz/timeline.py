@@ -1,0 +1,59 @@
+"""timeline.html: per-process operation tracks (jepsen.checker.timeline
+equivalent, reference `core.clj:84`)."""
+
+from __future__ import annotations
+
+import html
+
+COLORS = {"ok": "#a5d6a7", "info": "#ffcc80", "fail": "#ef9a9a"}
+
+
+def render_timeline(history, path: str | None = None) -> str:
+    pairs = history.pairs()
+    if pairs:
+        t_end = max((c.time for _, c in pairs if c is not None),
+                    default=0)
+    else:
+        t_end = 0
+    scale = 1000.0 / max(t_end, 1)      # px per ns across 1000px
+
+    by_process: dict = {}
+    for invoke, complete in pairs:
+        by_process.setdefault(invoke.process, []).append((invoke, complete))
+
+    rows = []
+    for process in sorted(by_process, key=str):
+        bars = []
+        for invoke, complete in by_process[process]:
+            x = invoke.time * scale
+            w = max(((complete.time if complete else t_end) - invoke.time)
+                    * scale, 2)
+            outcome = complete.type if complete else "info"
+            title = html.escape(
+                f"{invoke.f} {invoke.value!r} -> "
+                f"{outcome} {complete.value!r}" if complete
+                else f"{invoke.f} {invoke.value!r} -> ?")
+            bars.append(
+                f'<div class="op {outcome}" style="left:{x:.1f}px;'
+                f'width:{w:.1f}px" title="{title}">'
+                f'{html.escape(str(invoke.f))}</div>')
+        rows.append(f'<div class="row"><span class="proc">{process}'
+                    f'</span><div class="track">{"".join(bars)}</div></div>')
+
+    doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>timeline</title><style>
+body {{ font-family: sans-serif; font-size: 12px; }}
+.row {{ display: flex; align-items: center; margin: 2px 0; }}
+.proc {{ width: 70px; text-align: right; padding-right: 8px; }}
+.track {{ position: relative; height: 20px; width: 1010px;
+          background: #f5f5f5; }}
+.op {{ position: absolute; height: 18px; border: 1px solid #8886;
+       overflow: hidden; font-size: 10px; }}
+{"".join(f'.op.{k} {{ background: {v}; }}' for k, v in COLORS.items())}
+</style></head><body><h3>Operation timeline</h3>
+{"".join(rows)}
+</body></html>"""
+    if path:
+        with open(path, "w") as f:
+            f.write(doc)
+    return doc

@@ -1,0 +1,44 @@
+"""Workloads: each exposes `workload(opts) -> {"client": ..., "generator":
+..., "final_generator": ..., "checker": ...}` exactly like the reference
+(`workload/echo.clj:65-76` etc.). The registry mirrors `core.clj:30-38`."""
+
+from __future__ import annotations
+
+
+def registry() -> dict:
+    from . import (broadcast, echo, g_counter, g_set, lin_kv, pn_counter,
+                   txn_list_append)
+    return {
+        "broadcast": broadcast.workload,
+        "echo": echo.workload,
+        "g-set": g_set.workload,
+        "g-counter": g_counter.workload,
+        "pn-counter": pn_counter.workload,
+        "lin-kv": lin_kv.workload,
+        "txn-list-append": txn_list_append.workload,
+    }
+
+
+class BaseClient:
+    """Shared shape for workload clients (reference jepsen client/Client):
+    open(test, node) -> live client; setup(test); invoke(test, op) ->
+    completed op; close()."""
+
+    def __init__(self, net, conn=None, node=None):
+        self.net = net
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        from ..client import SyncClient
+        return type(self)(self.net, SyncClient(self.net), node)
+
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        raise NotImplementedError
+
+    def close(self):
+        if self.conn is not None:
+            self.conn.close()

@@ -1,0 +1,168 @@
+"""Broadcast workload: eventually-consistent set addition with an initial
+topology message (reference `src/maelstrom/workload/broadcast.clj`).
+
+Topology generators (grid/line/total/tree2/3/4, reference
+`broadcast.clj:39-177`) are produced both as node-id maps (the protocol
+surface) and, for the TPU path, as dense neighbor index arrays."""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..checkers.set_full import BroadcastChecker
+from . import BaseClient
+
+NodeId = str
+
+topology_rpc = defrpc(
+    "topology",
+    "A topology message is sent at the start of the test, after "
+    "initialization, and informs the node of an optional network topology "
+    "to use for broadcast. The topology consists of a map of node IDs to "
+    "lists of neighbor node IDs.",
+    {"type": S.Eq("topology"), "topology": {str: [S.Any]}},
+    {"type": S.Eq("topology_ok")},
+    ns="maelstrom_tpu.workloads.broadcast")
+
+broadcast_rpc = defrpc(
+    "broadcast",
+    "Sends a single message into the broadcast system, and requests that it "
+    "be broadcast to everyone. Nodes respond with a simple acknowledgement "
+    "message.",
+    {"type": S.Eq("broadcast"), "message": S.Any},
+    {"type": S.Eq("broadcast_ok")},
+    ns="maelstrom_tpu.workloads.broadcast")
+
+read_rpc = defrpc(
+    "read",
+    "Requests all messages present on a node.",
+    {"type": S.Eq("read")},
+    {"type": S.Eq("read_ok"), "messages": [S.Any]},
+    ns="maelstrom_tpu.workloads.broadcast")
+
+
+# --- Topologies (reference broadcast.clj:39-177) ---
+
+def grid_topology(nodes):
+    """Roughly-square grid; each node has at most 4 neighbors
+    (reference `broadcast.clj:39-64`)."""
+    nodes = list(nodes)
+    n = len(nodes)
+    side = math.ceil(math.sqrt(n))
+
+    def node(i, j):
+        if 0 <= i and 0 <= j < side:
+            idx = i * side + j
+            if idx < n:
+                return nodes[idx]
+        return None
+
+    topo = {}
+    for i in range(side):
+        for j in range(side):
+            me = node(i, j)
+            if me is None:
+                continue
+            topo[me] = [x for x in (node(i + 1, j), node(i - 1, j),
+                                    node(i, j + 1), node(i, j - 1))
+                        if x is not None]
+    return topo
+
+
+def line_topology(nodes):
+    """All nodes in a single line (reference `broadcast.clj:66-79`)."""
+    nodes = list(nodes)
+    n = len(nodes)
+    if n < 2:
+        return {nodes[0]: []} if nodes else {}
+    topo = {nodes[0]: [nodes[1]], nodes[-1]: [nodes[-2]]}
+    for i in range(1, n - 1):
+        topo[nodes[i]] = [nodes[i - 1], nodes[i + 1]]
+    return topo
+
+
+def total_topology(nodes):
+    """Every node connected to every other (reference
+    `broadcast.clj:81-88`)."""
+    nodes = list(nodes)
+    return {me: [x for x in nodes if x != me] for me in nodes}
+
+
+def tree_topology(b, nodes):
+    """A b-ary tree laid out breadth-first; neighbors = parent + children
+    (reference `broadcast.clj:90-166`)."""
+    nodes = list(nodes)
+    n = len(nodes)
+    if n == 0:
+        return {}
+    topo = {me: [] for me in nodes}
+    for i, me in enumerate(nodes):
+        if i > 0:
+            parent = nodes[(i - 1) // b]
+            topo[me].append(parent)
+        for c in range(b * i + 1, min(b * i + b + 1, n)):
+            topo[me].append(nodes[c])
+    return topo
+
+
+TOPOLOGIES = {
+    "line": line_topology,
+    "grid": grid_topology,
+    "tree": lambda ns: tree_topology(2, ns),
+    "tree2": lambda ns: tree_topology(2, ns),
+    "tree3": lambda ns: tree_topology(3, ns),
+    "tree4": lambda ns: tree_topology(4, ns),
+    "total": total_topology,
+}
+
+
+def topology(test) -> dict:
+    """Topology map for the test's nodes (reference
+    `broadcast.clj:179-184`)."""
+    return TOPOLOGIES[test.get("topology", "grid")](test["nodes"])
+
+
+def topology_indices(topo: dict, nodes: list[str], max_degree=None):
+    """Dense [n, max_degree] neighbor index array (padded with -1) for the
+    TPU path."""
+    import numpy as np
+    idx = {n: i for i, n in enumerate(nodes)}
+    deg = max((len(v) for v in topo.values()), default=0)
+    if max_degree is not None:
+        deg = max(deg, max_degree)
+    out = np.full((len(nodes), max(deg, 1)), -1, dtype=np.int32)
+    for n, neighbors in topo.items():
+        for j, m in enumerate(neighbors):
+            out[idx[n], j] = idx[m]
+    return out
+
+
+class BroadcastClient(BaseClient):
+    def setup(self, test):
+        topo = topology(test)
+        topology_rpc(self.conn, self.node,
+                     {"topology": {k: list(v) for k, v in topo.items()}})
+
+    def invoke(self, test, op):
+        def go():
+            if op["f"] == "broadcast":
+                broadcast_rpc(self.conn, self.node, {"message": op["value"]})
+                return {**op, "type": "ok"}
+            res = read_rpc(self.conn, self.node, {})
+            return {**op, "type": "ok", "value": res["messages"]}
+        return with_errors(op, {"read"}, go)
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": BroadcastClient(opts["net"]),
+        "generator": g.mix([
+            g.Seq({"f": "broadcast", "value": x} for x in itertools.count()),
+            g.Repeat({"f": "read"})]),
+        "final_generator": g.each_thread({"f": "read", "final": True}),
+        "checker": BroadcastChecker(),
+    }

@@ -1,0 +1,39 @@
+"""Echo workload: send a message, expect the same payload back
+(reference `src/maelstrom/workload/echo.clj`)."""
+
+from __future__ import annotations
+
+import random
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..checkers.echo import EchoChecker
+from . import BaseClient
+
+echo_rpc = defrpc(
+    "echo",
+    "Clients send `echo` messages to servers with an `echo` field containing "
+    "an arbitrary payload they'd like to have sent back. Servers should "
+    "respond with `echo_ok` messages containing that same payload.",
+    {"type": S.Eq("echo"), "echo": S.Any},
+    {"type": S.Eq("echo_ok"), "echo": S.Any},
+    ns="maelstrom_tpu.workloads.echo")
+
+
+class EchoClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            res = echo_rpc(self.conn, self.node, {"echo": op["value"]})
+            return {**op, "type": "ok", "value": res}
+        return with_errors(op, set(), go)
+
+
+def workload(opts: dict) -> dict:
+    rng = random.Random(opts.get("seed", 0))
+    return {
+        "client": EchoClient(opts["net"]),
+        "generator": g.Fn(lambda: {"f": "echo",
+                                   "value": f"Please echo {rng.randrange(128)}"}),
+        "checker": EchoChecker(),
+    }

@@ -1,0 +1,18 @@
+"""Grow-only counter workload: pn-counter restricted to non-negative deltas
+(reference `src/maelstrom/workload/g_counter.clj:30-40`).
+
+The add/read RPC docs are registered separately here (reference keeps
+doc-only copies, `g_counter.clj:13-28`); the live RPCs are pn-counter's."""
+
+from __future__ import annotations
+
+from .. import generators as g
+from . import pn_counter
+
+
+def workload(opts: dict) -> dict:
+    w = pn_counter.workload(opts)
+    w["generator"] = g.Filter(
+        lambda op: not (op.get("f") == "add" and op.get("value", 0) < 0),
+        w["generator"])
+    return w

@@ -1,0 +1,50 @@
+"""Grow-only set workload (reference `src/maelstrom/workload/g_set.clj`)."""
+
+from __future__ import annotations
+
+import itertools
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..checkers.set_full import SetFullChecker
+from . import BaseClient
+
+add_rpc = defrpc(
+    "add",
+    "Requests that a server add a single element to the set. Acknowledged "
+    "by an `add_ok` message.",
+    {"type": S.Eq("add"), "element": S.Any},
+    {"type": S.Eq("add_ok")},
+    ns="maelstrom_tpu.workloads.g_set")
+
+read_rpc = defrpc(
+    "read",
+    "Requests the current set of all elements. Servers respond with a "
+    "message containing an `elements` key, whose `value` is a JSON array of "
+    "added elements.",
+    {"type": S.Eq("read")},
+    {"type": S.Eq("read_ok"), "value": [S.Any]},
+    ns="maelstrom_tpu.workloads.g_set")
+
+
+class GSetClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            if op["f"] == "add":
+                add_rpc(self.conn, self.node, {"element": op["value"]})
+                return {**op, "type": "ok"}
+            res = read_rpc(self.conn, self.node, {})
+            return {**op, "type": "ok", "value": res["value"]}
+        return with_errors(op, {"read"}, go)
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": GSetClient(opts["net"]),
+        "generator": g.mix([
+            g.Seq({"f": "add", "value": x} for x in itertools.count()),
+            g.Repeat({"f": "read"})]),
+        "final_generator": g.each_thread({"f": "read", "final": True}),
+        "checker": SetFullChecker(),
+    }

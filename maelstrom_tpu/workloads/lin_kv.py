@@ -1,0 +1,107 @@
+"""Linearizable key-value workload
+(reference `src/maelstrom/workload/lin_kv.clj`)."""
+
+from __future__ import annotations
+
+import random
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..errors import deferror
+from ..checkers.linearizable import LinearizableRegisterChecker
+from . import BaseClient
+
+# KV errors are defined by this workload (reference lin_kv.clj:12-27)
+deferror(20, "key-does-not-exist",
+         "The client requested an operation on a key which does not exist "
+         "(assuming the operation should not automatically create missing "
+         "keys).",
+         definite=True, ns="maelstrom_tpu.workloads.lin_kv")
+deferror(21, "key-already-exists",
+         "The client requested the creation of a key which already exists, "
+         "and the server will not overwrite it.",
+         definite=True, ns="maelstrom_tpu.workloads.lin_kv")
+deferror(22, "precondition-failed",
+         "The requested operation expected some conditions to hold, and "
+         "those conditions were not met. For instance, a compare-and-set "
+         "operation might assert that the value of a key is currently 5; if "
+         "the value is 3, the server would return `precondition-failed`.",
+         definite=True, ns="maelstrom_tpu.workloads.lin_kv")
+
+read_rpc = defrpc(
+    "read",
+    "Reads the current value of a single key. Clients send a `read` request "
+    "with the key they'd like to observe, and expect a response with the "
+    "current `value` of that key.",
+    {"type": S.Eq("read"), "key": S.Any},
+    {"type": S.Eq("read_ok"), "value": S.Any},
+    ns="maelstrom_tpu.workloads.lin_kv")
+
+write_rpc = defrpc(
+    "write",
+    "Blindly overwrites the value of a key. Creates keys if they do not "
+    "presently exist. Servers should respond with a `read_ok` response once "
+    "the write is complete.",
+    {"type": S.Eq("write"), "key": S.Any, "value": S.Any},
+    {"type": S.Eq("write_ok")},
+    ns="maelstrom_tpu.workloads.lin_kv")
+
+cas_rpc = defrpc(
+    "cas",
+    "Atomically compare-and-sets a single key: if the value of `key` is "
+    "currently `from`, sets it to `to`. Returns error 20 if the key doesn't "
+    "exist, and 22 if the `from` value doesn't match.",
+    {"type": S.Eq("cas"), "key": S.Any, "from": S.Any, "to": S.Any},
+    {"type": S.Eq("cas_ok")},
+    ns="maelstrom_tpu.workloads.lin_kv")
+
+
+class LinKVClient(BaseClient):
+    def invoke(self, test, op):
+        k, v = op["value"]
+        # Timeout scaled to latency (reference lin_kv.clj:71)
+        timeout = max(10 * test.get("latency", {}).get("mean", 0), 1000)
+
+        def go():
+            if op["f"] == "read":
+                res = read_rpc(self.conn, self.node, {"key": k}, timeout)
+                return {**op, "type": "ok", "value": [k, res["value"]]}
+            if op["f"] == "write":
+                write_rpc(self.conn, self.node, {"key": k, "value": v},
+                          timeout)
+                return {**op, "type": "ok"}
+            frm, to = v
+            cas_rpc(self.conn, self.node,
+                    {"key": k, "from": frm, "to": to}, timeout)
+            return {**op, "type": "ok"}
+        return with_errors(op, {"read"}, go)
+
+
+def generator(opts):
+    """Independent per-key register ops, rotating through keys like
+    jepsen.independent/concurrent-generator: each key sees a bounded number
+    of ops, then a fresh key starts."""
+    rng = random.Random(opts.get("seed", 0))
+    ops_per_key = opts.get("ops_per_key", 40)
+    counter = {"n": 0}
+
+    def gen_op():
+        key = counter["n"] // ops_per_key
+        counter["n"] += 1
+        r = rng.random()
+        if r < 0.5:
+            return {"f": "read", "value": [key, None]}
+        if r < 0.8:
+            return {"f": "write", "value": [key, rng.randrange(5)]}
+        return {"f": "cas",
+                "value": [key, [rng.randrange(5), rng.randrange(5)]]}
+    return g.Fn(gen_op)
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": LinKVClient(opts["net"]),
+        "generator": generator(opts),
+        "checker": LinearizableRegisterChecker(),
+    }

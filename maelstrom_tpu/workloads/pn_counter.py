@@ -1,0 +1,52 @@
+"""PN-counter workload: eventually-consistent counter with increments and
+decrements (reference `src/maelstrom/workload/pn_counter.clj`)."""
+
+from __future__ import annotations
+
+import random
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..checkers.pn_counter import PNCounterChecker
+from . import BaseClient
+
+add_rpc = defrpc(
+    "add",
+    "Adds a (potentially negative) integer, called `delta`, to the counter. "
+    "Servers should respond with an `add_ok` message.",
+    {"type": S.Eq("add"), "delta": int},
+    {"type": S.Eq("add_ok")},
+    ns="maelstrom_tpu.workloads.pn_counter")
+
+read_rpc = defrpc(
+    "read",
+    "Reads the current value of the counter. Servers respond with a "
+    "`read_ok` message containing a `value`, which should be the sum of all "
+    "(known) added deltas.",
+    {"type": S.Eq("read")},
+    {"type": S.Eq("read_ok"), "value": int},
+    ns="maelstrom_tpu.workloads.pn_counter")
+
+
+class PNCounterClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            if op["f"] == "add":
+                add_rpc(self.conn, self.node, {"delta": op["value"]})
+                return {**op, "type": "ok"}
+            res = read_rpc(self.conn, self.node, {})
+            return {**op, "type": "ok", "value": int(res["value"])}
+        return with_errors(op, {"read"}, go)
+
+
+def workload(opts: dict) -> dict:
+    rng = random.Random(opts.get("seed", 0))
+    return {
+        "client": PNCounterClient(opts["net"]),
+        "generator": g.mix([
+            g.Fn(lambda: {"f": "add", "value": rng.randint(-5, 4)}),
+            g.Repeat({"f": "read"})]),
+        "final_generator": g.each_thread({"f": "read", "final": True}),
+        "checker": PNCounterChecker(),
+    }

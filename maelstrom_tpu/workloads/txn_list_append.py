@@ -1,0 +1,88 @@
+"""Transactional list-append workload
+(reference `src/maelstrom/workload/txn_list_append.clj`).
+
+Transactions are arrays of micro-ops `[f, k, v]` where f is "r" (read,
+submitted with v=null, completed with the observed list) or "append".
+Nonexistent keys read as null; lists are created implicitly on append.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc, with_errors
+from ..errors import deferror
+from ..checkers.elle import ElleListAppendChecker
+from . import BaseClient
+
+deferror(30, "txn-conflict",
+         "The requested transaction has been aborted because of a conflict "
+         "with another transaction. Servers need not return this error on "
+         "every conflict: they may choose to retry automatically instead.",
+         definite=True, ns="maelstrom_tpu.workloads.txn_list_append")
+
+ReadReq = S.Tup(S.Eq("r"), S.Any, S.Eq(None))
+ReadRes = S.Tup(S.Eq("r"), S.Any, [S.Any])
+Append = S.Tup(S.Eq("append"), S.Any, S.Any)
+
+txn_rpc = defrpc(
+    "txn",
+    "Requests that the node execute a single transaction. Servers respond "
+    "with a `txn_ok` message, and a completed version of the requested "
+    "transaction; e.g. with read values filled in. Keys and list elements "
+    "may be of any type.",
+    {"type": S.Eq("txn"), "txn": [S.Either(ReadReq, Append)]},
+    {"type": S.Eq("txn_ok"), "txn": [S.Either(ReadRes, Append)]},
+    ns="maelstrom_tpu.workloads.txn_list_append")
+
+
+class TxnClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            res = txn_rpc(self.conn, self.node,
+                          {"txn": [list(m) for m in op["value"]]})
+            return {**op, "type": "ok",
+                    "value": [list(m) for m in res["txn"]]}
+        return with_errors(op, set(), go)
+
+
+def generator(opts):
+    """Random transactions over a sliding window of keys, honoring
+    --key-count, --max-txn-length, --max-writes-per-key
+    (reference `txn_list_append.clj:112-124` via jepsen append/test)."""
+    rng = random.Random(opts.get("seed", 0))
+    key_count = opts.get("key_count") or 10
+    max_txn_length = opts.get("max_txn_length", 4)
+    min_txn_length = opts.get("min_txn_length", 1)
+    max_writes = opts.get("max_writes_per_key", 16)
+    state = {"base": 0, "appends": {}}
+
+    def next_value(k):
+        state["appends"][k] = state["appends"].get(k, 0) + 1
+        if state["appends"][k] >= max_writes:
+            # retire the oldest active key by advancing the window
+            state["base"] += 1
+        return state["appends"][k]
+
+    def gen_op():
+        length = rng.randint(min_txn_length, max_txn_length)
+        txn = []
+        for _ in range(length):
+            k = state["base"] + rng.randrange(key_count)
+            if rng.random() < 0.5:
+                txn.append(["r", k, None])
+            else:
+                txn.append(["append", k, next_value(k)])
+        return {"f": "txn", "value": txn}
+    return g.Fn(gen_op)
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": TxnClient(opts["net"]),
+        "generator": generator(opts),
+        "checker": ElleListAppendChecker(
+            opts.get("consistency_models", ["strict-serializable"])),
+    }

@@ -1,0 +1,175 @@
+"""Tests for the linearizability (WGL) and Elle-lite checkers against
+known-good and known-bad histories — the checker cross-validation the
+reference lacks (SURVEY.md section 4)."""
+
+from maelstrom_tpu.checkers.linearizable import (
+    LinearizableRegisterChecker, check_register_history)
+from maelstrom_tpu.checkers.elle import ElleListAppendChecker, analyze
+
+INF = float("inf")
+
+
+def op(f, value, inv, ret, ok=True):
+    return {"f": f, "value": value, "inv": inv, "ret": ret, "ok": ok}
+
+
+# --- register WGL ---
+
+def test_sequential_rw_ok():
+    ops = [op("write", 1, 0, 1),
+           op("read", 1, 2, 3),
+           op("write", 2, 4, 5),
+           op("read", 2, 6, 7)]
+    assert check_register_history(ops)["valid"] is True
+
+
+def test_stale_read_invalid():
+    # read of 1 strictly after write 2 completed: not linearizable
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, 3),
+           op("read", 1, 4, 5)]
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_concurrent_read_either_value_ok():
+    # read overlaps the write: may see old or new
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, 6),
+           op("read", 1, 3, 5)]
+    assert check_register_history(ops)["valid"] is True
+    ops[2] = op("read", 2, 3, 5)
+    assert check_register_history(ops)["valid"] is True
+
+
+def test_cas_semantics():
+    ops = [op("write", 1, 0, 1),
+           op("cas", [1, 5], 2, 3),
+           op("read", 5, 4, 5)]
+    assert check_register_history(ops)["valid"] is True
+    # cas claiming success from a wrong precondition
+    ops = [op("write", 1, 0, 1),
+           op("cas", [2, 5], 2, 3),
+           op("read", 5, 4, 5)]
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_indeterminate_write_may_or_may_not_happen():
+    # info write of 2: both later reads of 1 and of 2 are fine...
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, INF, ok=False),
+           op("read", 1, 3, 4)]
+    assert check_register_history(ops)["valid"] is True
+    ops[2] = op("read", 2, 3, 4)
+    assert check_register_history(ops)["valid"] is True
+    # ...but flip-flopping 1 -> 2 -> 1 is not (write 2 can't un-happen)
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, INF, ok=False),
+           op("read", 2, 3, 4),
+           op("read", 1, 5, 6)]
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_read_initial_none():
+    assert check_register_history([op("read", None, 0, 1)])["valid"] is True
+    assert check_register_history([op("read", 3, 0, 1)])["valid"] is False
+
+
+def test_per_key_checker():
+    MS = 1_000_000
+    h = []
+    t = 0
+
+    def add(f, value, typ="ok", proc=0):
+        nonlocal t
+        h.append({"type": "invoke", "f": f, "value": value, "process": proc,
+                  "time": t})
+        t += MS
+        h.append({"type": typ, "f": f, "value": value, "process": proc,
+                  "time": t})
+        t += MS
+    add("write", [0, 1])
+    add("read", [0, 1])
+    add("write", [1, 3])
+    add("read", [1, 2])     # wrong: key 1 should be 3
+    r = LinearizableRegisterChecker().check({}, h)
+    assert r["valid"] is False and r["failures"] == [1]
+
+
+# --- Elle-lite ---
+
+def _txn_pair(h, micro_in, micro_out, t0, t1, typ="ok", proc=0):
+    h.append({"type": "invoke", "f": "txn", "value": micro_in,
+              "process": proc, "time": t0})
+    h.append({"type": typ, "f": "txn",
+              "value": micro_out if typ == "ok" else micro_in,
+              "process": proc, "time": t1})
+
+
+def test_elle_clean_history():
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 2, 3)
+    _txn_pair(h, [["append", 1, 2]], [["append", 1, 2]], 4, 5)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 6, 7)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is True, r
+
+
+def test_elle_g1a_aborted_read():
+    h = []
+    _txn_pair(h, [["append", 1, 9]], None, 0, 1, typ="fail")
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [9]]], 2, 3)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is False and "G1a" in r["anomalies"]
+
+
+def test_elle_incompatible_order():
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 1)
+    _txn_pair(h, [["append", 1, 2]], [["append", 1, 2]], 2, 3)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 4, 5)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [2, 1]]], 6, 7)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is False and "incompatible-order" in r["anomalies"]
+
+
+def test_elle_g_single_cycle():
+    # T1 reads key 1 before T2's append (rw), but T1's own append to key 2
+    # is read... classic write-skew-ish: T1: r(1,[]) append(2,1);
+    # T2: r(2,[]) append(1,1). Each anti-depends on the other: G2.
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 2, 1]],
+              [["r", 1, []], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 2, None], ["append", 1, 1]],
+              [["r", 2, []], ["append", 1, 1]], 1, 11, proc=1)
+    # make the versions observable
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1]], ["r", 2, [1]]], 12, 13, proc=0)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is False
+    assert "G2" in r["anomalies"], r
+
+
+def test_elle_realtime_violation():
+    # T1 appends 1 and completes; T2 *then* starts, reads [] (missing T1's
+    # committed write) but observes nothing contradictory serializably...
+    # then T3 reads [1]. Serializable order: T2, T1, T3 — fine without
+    # realtime, violation with strict-serializable.
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 1, proc=0)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, []]], 5, 6, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 8, 9, proc=0)
+    strict = ElleListAppendChecker(["strict-serializable"]).check({}, h)
+    serial = ElleListAppendChecker(["serializable"]).check({}, h)
+    assert strict["valid"] is False, strict
+    assert serial["valid"] is True, serial
+
+
+def test_elle_g1b_intermediate_read():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2]],
+              [["append", 1, 1], ["append", 1, 2]], 0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 2, 3)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 4, 5)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is False and "G1b" in r["anomalies"]

@@ -1,0 +1,108 @@
+"""Generator combinator tests, driven by a tiny virtual-time interpreter."""
+
+import random
+
+from maelstrom_tpu import generators as g
+
+
+def interpret(gen, processes=("w0", "w1"), max_time_s=100.0,
+              complete_after_ns=1_000_000):
+    """A minimal virtual-time interpreter: ops complete after a fixed
+    latency; used to validate generator scheduling semantics."""
+    t = 0
+    free = list(processes)
+    busy = []           # (completion_time, process, op)
+    emitted = []
+    gen = g.to_gen(gen)
+    while t < max_time_s * 1e9:
+        ctx = {"time": t, "free": sorted(free), "processes": list(processes)}
+        res, gen = gen.op(ctx)
+        if res is None:
+            if not busy:
+                break
+        elif res == g.PENDING:
+            pass
+        else:
+            emitted.append(res)
+            free.remove(res["process"])
+            busy.append((t + complete_after_ns, res["process"], res))
+            continue    # try to fill remaining free workers at same time
+        # advance time to next completion or +1ms
+        if busy:
+            busy.sort()
+            t2, p, op = busy.pop(0)
+            t = max(t, t2)
+            free.append(p)
+            gen = gen.update(
+                {"time": t, "free": sorted(free),
+                 "processes": list(processes)},
+                {**op, "type": "ok", "time": t})
+        else:
+            t += 1_000_000
+    return emitted
+
+
+def test_seq_and_limit():
+    ops = interpret(g.time_limit(10, [{"f": "echo", "value": i}
+                                      for i in range(5)]))
+    assert [o["value"] for o in ops] == [0, 1, 2, 3, 4]
+    assert all(o["process"] in ("w0", "w1") for o in ops)
+
+
+def test_each_thread():
+    ops = interpret(g.each_thread({"f": "read", "final": True}))
+    assert len(ops) == 2
+    assert {o["process"] for o in ops} == {"w0", "w1"}
+
+
+def test_stagger_rate():
+    # rate 100/sec over 10s -> ~1000 ops (within statistical bounds)
+    ops = interpret(g.time_limit(10, g.stagger(1 / 100,
+                                               g.Repeat({"f": "read"}))))
+    assert 700 < len(ops) < 1300, len(ops)
+
+
+def test_mix():
+    adds = ({"f": "add", "value": x} for x in range(1000))
+    reads = g.Repeat({"f": "read"})
+    ops = interpret(g.time_limit(5, g.mix([adds, reads])))
+    fs = {o["f"] for o in ops}
+    assert fs == {"add", "read"}
+
+
+def test_filter():
+    rng = random.Random(0)
+    src = g.Fn(lambda: {"f": "add", "value": rng.randint(-5, 4)})
+    ops = interpret(g.time_limit(3, g.Filter(
+        lambda op: not (op["f"] == "add" and op["value"] < 0), src)))
+    assert ops and all(o["value"] >= 0 for o in ops)
+
+
+def test_phases_wait_for_quiescence():
+    ops = interpret(g.phases(
+        [{"f": "add", "value": 0}, {"f": "add", "value": 1}],
+        g.sleep(1),
+        g.each_thread({"f": "read", "final": True})))
+    assert [o["f"] for o in ops] == ["add", "add", "read", "read"]
+    # final reads must start after the sleep following both adds completing
+    add_done = max(o["time"] for o in ops if o["f"] == "add")
+    read_start = min(o["time"] for o in ops if o["f"] == "read")
+    assert read_start >= add_done + 1e9
+
+
+def test_nemesis_wrap_routing():
+    nem = g.Seq([{"f": "start-partition"}, {"f": "stop-partition"}])
+    cli = g.Repeat({"f": "read"})
+    ops = interpret(g.time_limit(1, g.nemesis_wrap(nem, cli)),
+                    processes=("w0", "w1", g.NEMESIS))
+    nem_ops = [o for o in ops if o["process"] == g.NEMESIS]
+    cli_ops = [o for o in ops if o["process"] != g.NEMESIS]
+    assert [o["f"] for o in nem_ops] == ["start-partition", "stop-partition"]
+    assert cli_ops and all(o["f"] == "read" for o in cli_ops)
+
+
+def test_fn_generator_values_differ():
+    rng = random.Random(42)
+    src = g.Fn(lambda: {"f": "echo", "value": f"Please echo {rng.randrange(128)}"})
+    ops = interpret(g.time_limit(1, src))
+    assert len({o["value"] for o in ops}) > 1

@@ -1,0 +1,68 @@
+"""End-to-end tests of the host compatibility path: real subprocess nodes
+speaking stdio JSON, driven through the full stack (network, db, init
+handshake, generator interpreter, history, checkers, store artifacts) —
+the counterpart of the reference's `demo` self-test suite
+(`core.clj:93-111`)."""
+
+import os
+
+import pytest
+
+from maelstrom_tpu import core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "demo", "python")
+
+
+def run(tmp_path, **opts):
+    opts.setdefault("store_root", str(tmp_path / "store"))
+    opts.setdefault("node_count", 3)
+    opts.setdefault("rate", 10)
+    opts.setdefault("time_limit", 2)
+    opts.setdefault("recovery_s", 0.5)
+    return core.run(opts)
+
+
+def test_echo_e2e(tmp_path):
+    r = run(tmp_path, workload="echo", bin=os.path.join(DEMO, "echo.py"))
+    assert r["valid"] is True, r.get("workload")
+    assert r["stats"]["ok-count"] > 0
+    assert r["net"]["all"]["send-count"] > 0
+    # one request + one reply per op, clients only
+    assert r["net"]["all"]["msgs-per-op"] == pytest.approx(2.0, abs=0.3)
+    # store artifacts
+    store_root = str(tmp_path / "store")
+    latest = os.path.join(store_root, "latest")
+    for f in ("history.jsonl", "results.json", "messages.svg",
+              "timeline.html", "latency-raw.svg", "rate.svg"):
+        assert os.path.exists(os.path.join(latest, f)), f
+    assert os.path.exists(os.path.join(latest, "node-logs", "n0.log"))
+
+
+def test_broadcast_e2e(tmp_path):
+    r = run(tmp_path, workload="broadcast",
+            bin=os.path.join(DEMO, "broadcast.py"), topology="grid")
+    assert r["valid"] is True, r.get("workload")
+    w = r["workload"]
+    assert w["stable-count"] > 0 and w["lost-count"] == 0
+
+
+def test_g_set_e2e(tmp_path):
+    r = run(tmp_path, workload="g-set", bin=os.path.join(DEMO, "g_set.py"),
+            time_limit=3, recovery_s=2.5)
+    assert r["valid"] is True, r.get("workload")
+
+
+def test_pn_counter_e2e(tmp_path):
+    r = run(tmp_path, workload="pn-counter",
+            bin=os.path.join(DEMO, "pn_counter.py"), time_limit=3,
+            recovery_s=2.5)
+    assert r["valid"] is True, r.get("workload")
+
+
+def test_crashed_node_fails_test(tmp_path):
+    crasher = tmp_path / "crasher.py"
+    crasher.write_text("#!/usr/bin/env python3\nimport sys; sys.exit(2)\n")
+    crasher.chmod(0o755)
+    with pytest.raises(Exception):
+        run(tmp_path, workload="echo", bin=str(crasher), time_limit=1)

@@ -1,0 +1,258 @@
+"""Host network, client, and services tests (reference semantics:
+net.clj, client.clj, service.clj)."""
+
+import threading
+import time
+
+import pytest
+
+from maelstrom_tpu.client import SyncClient, with_errors, defrpc
+from maelstrom_tpu.errors import RPCError, Timeout
+from maelstrom_tpu.message import message
+from maelstrom_tpu.net.host import HostNet, LatencyDist
+from maelstrom_tpu.net.journal import Journal
+from maelstrom_tpu import schema as S
+from maelstrom_tpu.services import (Eventual, LWWKV, Linearizable,
+                                    PersistentKV, PersistentTSO,
+                                    Sequential, ServiceRunner)
+
+
+def test_send_recv_roundtrip():
+    net = HostNet()
+    net.add_node("n0").add_node("n1")
+    net.send({"src": "n0", "dest": "n1", "body": {"type": "hi"}})
+    msg = net.recv("n1", 100)
+    assert msg.body == {"type": "hi"} and msg.src == "n0" and msg.id == 0
+    assert net.recv("n1", 10) is None
+
+
+def test_send_to_missing_node_raises_error_1():
+    net = HostNet()
+    net.add_node("n0")
+    with pytest.raises(RPCError) as ei:
+        net.send({"src": "n0", "dest": "nope", "body": {"type": "hi"}})
+    assert ei.value.code == 1 and ei.value.definite
+
+
+def test_partition_drops_at_delivery():
+    net = HostNet()
+    net.add_node("n0").add_node("n1")
+    net.drop_link("n0", "n1")     # n1 blocks packets from n0
+    net.send({"src": "n0", "dest": "n1", "body": {"type": "hi"}})
+    assert net.recv("n1", 50) is None     # consumed and dropped
+    # Asymmetric: n1 -> n0 still works
+    net.send({"src": "n1", "dest": "n0", "body": {"type": "yo"}})
+    assert net.recv("n0", 50).body == {"type": "yo"}
+    net.heal()
+    net.send({"src": "n0", "dest": "n1", "body": {"type": "hi2"}})
+    assert net.recv("n1", 50).body == {"type": "hi2"}
+
+
+def test_loss():
+    net = HostNet(seed=1)
+    net.add_node("n0").add_node("n1")
+    net.flaky(1.0)      # lose everything
+    for _ in range(10):
+        net.send({"src": "n0", "dest": "n1", "body": {"type": "x"}})
+    assert net.recv("n1", 20) is None
+    net.p_loss = 0.0
+    net.send({"src": "n0", "dest": "n1", "body": {"type": "y"}})
+    assert net.recv("n1", 50) is not None
+
+
+def test_latency_ordering_and_client_zero_latency():
+    # Two messages: the second sent has a shorter deadline and should be
+    # delivered first (priority by deadline, not FIFO).
+    net = HostNet(latency={"mean": 40, "dist": "constant"})
+    net.add_node("n0").add_node("n1")
+    net.send({"src": "n0", "dest": "n1", "body": {"v": 1}})
+    net.slow(0.001)   # subsequent messages ~0 latency
+    net.send({"src": "n0", "dest": "n1", "body": {"v": 2}})
+    # poll after both are queued
+    time.sleep(0.005)
+    assert net.recv("n1", 100).body == {"v": 2}
+    assert net.recv("n1", 200).body == {"v": 1}
+    # clients always get zero latency (net.clj:177-186)
+    net.fast()
+    net.add_node("c9")
+    t0 = time.monotonic()
+    net.send({"src": "c9", "dest": "n1", "body": {"v": 3}})
+    assert net.recv("n1", 1000).body == {"v": 3}
+    assert time.monotonic() - t0 < 0.03
+
+
+def test_journal_stats():
+    net = HostNet()
+    net.journal = Journal()
+    net.add_node("n0").add_node("n1").add_node("c0")
+    net.send({"src": "n0", "dest": "n1", "body": {"type": "x"}})
+    net.recv("n1", 50)
+    net.send({"src": "c0", "dest": "n0", "body": {"type": "r"}})
+    net.recv("n0", 50)
+    s = net.journal.stats(op_count=1)
+    assert s["all"]["send-count"] == 2 and s["all"]["recv-count"] == 2
+    assert s["servers"]["msg-count"] == 1
+    assert s["clients"]["msg-count"] == 1
+    assert s["all"]["msgs-per-op"] == 2.0
+
+
+def test_sync_client_rpc_and_stale_replies():
+    net = HostNet()
+    net.add_node("n0")
+    client = SyncClient(net)
+
+    def server():
+        # ignore the first request (client times out), answer the second
+        m1 = net.recv("n0", 1000)
+        m2 = net.recv("n0", 2000)
+        if m2 is not None:
+            # reply late to m1 (stale), then to m2
+            net.send({"src": "n0", "dest": m1.src,
+                      "body": {"type": "echo_ok",
+                               "in_reply_to": m1.body["msg_id"],
+                               "echo": "stale"}})
+            net.send({"src": "n0", "dest": m2.src,
+                      "body": {"type": "echo_ok",
+                               "in_reply_to": m2.body["msg_id"],
+                               "echo": "fresh"}})
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    with pytest.raises(Timeout):
+        client.rpc("n0", {"type": "echo", "echo": "a"}, timeout_ms=150)
+    res = client.rpc("n0", {"type": "echo", "echo": "b"}, timeout_ms=2000)
+    assert res["echo"] == "fresh"   # stale reply to msg 1 was discarded
+    client.close()
+
+
+def test_with_errors_mapping():
+    op = {"f": "write", "value": 1, "type": "invoke"}
+
+    def boom_definite():
+        raise RPCError(14, {"text": "nope"})
+
+    def boom_indef():
+        raise RPCError(13, {"text": "hm"})
+
+    def boom_timeout():
+        raise Timeout()
+
+    assert with_errors(op, set(), boom_definite)["type"] == "fail"
+    assert with_errors(op, set(), boom_indef)["type"] == "info"
+    assert with_errors(op, set(), boom_timeout)["type"] == "info"
+    # idempotent fs fail fast even on timeouts (client.clj:221-225)
+    rop = {"f": "read", "type": "invoke"}
+    assert with_errors(rop, {"read"}, boom_timeout)["type"] == "fail"
+
+
+def test_defrpc_validation():
+    echo = defrpc("echo", "test echo",
+                  {"type": S.Eq("echo"), "echo": S.Any},
+                  {"type": S.Eq("echo_ok"), "echo": S.Any},
+                  ns="test")
+    net = HostNet()
+    net.add_node("n0")
+    client = SyncClient(net)
+
+    def server():
+        m = net.recv("n0", 2000)
+        net.send({"src": "n0", "dest": m.src,
+                  "body": {"type": "echo_ok", "echo": m.body["echo"],
+                           "in_reply_to": m.body["msg_id"]}})
+    threading.Thread(target=server, daemon=True).start()
+    res = echo(client, "n0", {"echo": "hello"})
+    assert res["echo"] == "hello"
+    client.close()
+
+
+# --- services (reference service.clj) ---
+
+def _msg(src, body):
+    return message(src, "svc", body)
+
+
+def test_persistent_kv():
+    kv = PersistentKV()
+    kv, r = kv.handle(_msg("c0", {"type": "read", "key": "x"}))
+    assert r["code"] == 20
+    kv, r = kv.handle(_msg("c0", {"type": "write", "key": "x", "value": 5}))
+    assert r == {"type": "write_ok"}
+    kv, r = kv.handle(_msg("c0", {"type": "cas", "key": "x", "from": 5,
+                                  "to": 6}))
+    assert r == {"type": "cas_ok"}
+    kv, r = kv.handle(_msg("c0", {"type": "cas", "key": "x", "from": 5,
+                                  "to": 7}))
+    assert r["code"] == 22
+    kv, r = kv.handle(_msg("c0", {"type": "cas", "key": "y", "from": 1,
+                                  "to": 2}))
+    assert r["code"] == 20
+    kv, r = kv.handle(_msg("c0", {"type": "cas", "key": "y", "from": None,
+                                  "to": 2, "create_if_not_exists": True}))
+    assert r == {"type": "cas_ok"}
+    kv, r = kv.handle(_msg("c0", {"type": "read", "key": "y"}))
+    assert r == {"type": "read_ok", "value": 2}
+
+
+def test_lww_kv_merge():
+    a = LWWKV()
+    a, _ = a.handle(_msg("c0", {"type": "write", "key": "k", "value": "a"}))
+    b = LWWKV()
+    b, _ = b.handle(_msg("c0", {"type": "write", "key": "k", "value": "b"}))
+    b, _ = b.handle(_msg("c0", {"type": "write", "key": "k", "value": "b2"}))
+    m = a.merge(b)
+    _, r = m.handle(_msg("c0", {"type": "read", "key": "k"}))
+    assert r["value"] == "b2"     # higher lamport ts wins
+    assert m.clock == 2
+
+
+def test_tso_monotonic():
+    tso = Linearizable(PersistentTSO())
+    ts = [tso.handle(_msg("c0", {"type": "ts"}))["ts"] for _ in range(5)]
+    assert ts == [0, 1, 2, 3, 4]
+
+
+def test_sequential_client_monotonicity():
+    svc = Sequential(PersistentKV(), seed=3)
+    for i in range(5):
+        svc.handle(_msg("c0", {"type": "write", "key": "x", "value": i}))
+    # c0 wrote 4 last; its reads must observe monotonically advancing states,
+    # and since its last write forced the newest state, reads must return 4.
+    for _ in range(10):
+        r = svc.handle(_msg("c0", {"type": "read", "key": "x"}))
+        assert r["value"] == 4
+    # A fresh client may observe older states, but never older than a state
+    # it has already seen.
+    seen = []
+    for _ in range(20):
+        r = svc.handle(_msg("c1", {"type": "read", "key": "x"}))
+        seen.append(r["value"])
+    assert all(b >= a for a, b in zip(seen, seen[1:])), seen
+
+
+def test_eventual_converges():
+    svc = Eventual(LWWKV(), n=3, seed=7)
+    svc.handle(_msg("c0", {"type": "write", "key": "k", "value": 9}))
+    ok = 0
+    for _ in range(200):
+        r = svc.handle(_msg("c0", {"type": "read", "key": "k"}))
+        if r.get("value") == 9:
+            ok += 1
+    assert ok > 100     # gossip merges propagate the write
+
+
+def test_service_runner_over_net():
+    net = HostNet()
+    from maelstrom_tpu.services import default_services
+    runner = ServiceRunner(net, default_services())
+    runner.start()
+    try:
+        client = SyncClient(net)
+        res = client.rpc("lin-kv", {"type": "write", "key": "a", "value": 1})
+        assert res["type"] == "write_ok"
+        res = client.rpc("lin-kv", {"type": "read", "key": "a"})
+        assert res == {"type": "read_ok", "value": 1,
+                       "in_reply_to": res["in_reply_to"]}
+        res = client.rpc("lin-tso", {"type": "ts"})
+        assert res["type"] == "ts_ok"
+        client.close()
+    finally:
+        runner.stop()
