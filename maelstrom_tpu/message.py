@@ -3,8 +3,9 @@
 Messages always have a `src`, `dest`, and `body`; an `id` is assigned
 internally by the network (reference `net.clj:26-32`, `message.clj:8-25`).
 Bodies are arbitrary JSON objects at this (host) layer; the TPU network core
-uses a fixed-width integer encoding (`maelstrom_tpu.net.tpu.BodyCodec`) and
-converts at the host boundary.
+(`maelstrom_tpu.net.tpu.Msgs`) uses a fixed-width integer encoding — a type
+code plus payload words — and each TPU node program defines the JSON<->words
+codec applied at the host boundary.
 """
 
 from __future__ import annotations

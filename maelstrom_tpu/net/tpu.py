@@ -1,0 +1,314 @@
+"""The TPU-path simulated network: message arrays, scatter/gather, masks.
+
+This replaces the reference's thread/queue network (`src/maelstrom/net.clj`)
+with a batched discrete-event design. All in-flight messages live in a
+fixed-capacity *flight pool* of device arrays; time is an integer round
+counter; per-message latency draws map to delivery rounds. One call to
+`deliver` + node step + `send` advances the whole N-node network one round
+inside a single jitted dispatch.
+
+Semantic mapping to the reference:
+  - per-node PriorityBlockingQueue ordered by deadline (`net.clj:143-144`)
+      -> flight pool sorted by (dest, due) at delivery; earliest-due messages
+         win inbox slots; the rest stay pooled (backpressure, never dropped)
+  - probabilistic loss applied at send (`net.clj:213-214`)
+      -> Bernoulli mask over new messages
+  - directional partitions applied at receive (`net.clj:233`), which
+    *consume* the blocked message
+      -> component labels per node: a message is blocked iff its endpoints
+         are in different components and neither endpoint is a client
+         (the partition nemesis only severs node-node links)
+  - clients get zero latency (`net.clj:177-186`)
+      -> client-involved messages get a 0-round latency draw
+  - message ids assigned at send, before the loss roll (`net.clj:205-214`)
+      -> ids = next_mid + rank over *all* attempted sends
+  - journal hooks on every send/recv (`net.clj:207,243`)
+      -> on-device counters (NetStats); the interactive runner additionally
+         materializes per-message journal rows on host for small tests
+
+Everything here is pure and jit/scan/shard_map-friendly: static shapes,
+no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+I32 = jnp.int32
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+@struct.dataclass
+class Msgs:
+    """A struct-of-arrays batch of messages. Fields may have any common
+    leading shape (pool `[P]`, inbox `[N, K]`, outbox `[N, O]`).
+
+    Bodies are fixed-width: a type code and three payload words. Workload
+    programs define their own type codes and word layouts; arbitrary JSON
+    bodies exist only at the host boundary (`net/host.py`)."""
+    valid: jnp.ndarray      # bool
+    src: jnp.ndarray        # i32 node index; clients are indices >= n_nodes
+    dest: jnp.ndarray       # i32
+    due: jnp.ndarray        # i32 delivery round
+    mid: jnp.ndarray        # i32 global message id
+    reply_to: jnp.ndarray   # i32 in_reply_to message id, or -1
+    type: jnp.ndarray       # i32 body type code (workload-defined)
+    a: jnp.ndarray          # i32 payload word
+    b: jnp.ndarray          # i32 payload word
+    c: jnp.ndarray          # i32 payload word
+
+    @classmethod
+    def empty(cls, shape) -> "Msgs":
+        if isinstance(shape, int):
+            shape = (shape,)
+        z = jnp.zeros(shape, I32)
+        return cls(valid=jnp.zeros(shape, bool), src=z, dest=z, due=z,
+                   mid=z, reply_to=jnp.full(shape, -1, I32), type=z,
+                   a=z, b=z, c=z)
+
+    def at_rows(self, idx) -> "Msgs":
+        return jax.tree.map(lambda f: f[idx], self)
+
+    def count(self):
+        return jnp.sum(self.valid)
+
+
+@struct.dataclass
+class NetStats:
+    """On-device journal counters, the TPU analogue of the Fressian journal
+    folds (`net/journal.clj:339-347`). "servers" = not client-involved, as in
+    `util.clj:12-16`."""
+    sent_all: jnp.ndarray
+    sent_servers: jnp.ndarray
+    recv_all: jnp.ndarray
+    recv_servers: jnp.ndarray
+    lost: jnp.ndarray
+    dropped_partition: jnp.ndarray
+    dropped_overflow: jnp.ndarray   # pool-full drops: MUST be 0 for a valid run
+
+    @classmethod
+    def zeros(cls) -> "NetStats":
+        z = jnp.zeros((), I32)
+        return cls(z, z, z, z, z, z, z)
+
+
+@struct.dataclass
+class NetState:
+    pool: Msgs                  # [P] flight pool
+    next_mid: jnp.ndarray       # i32 scalar
+    round: jnp.ndarray          # i32 scalar
+    component: jnp.ndarray      # i32 [n_nodes + n_clients] partition labels
+    p_loss: jnp.ndarray         # f32 scalar
+    latency_scale: jnp.ndarray  # f32 scalar (slow! = x10, fast! = x1)
+    stats: NetStats
+
+
+@dataclass(frozen=True)
+class NetConfig:
+    """Static network shape/latency configuration (hashable, jit-static)."""
+    n_nodes: int
+    n_clients: int = 0
+    pool_cap: int = 4096          # max in-flight messages
+    inbox_cap: int = 8            # max deliveries per node per round
+    client_cap: int = 64          # max client deliveries per round (0 = count only)
+    latency_mean_rounds: float = 0.0
+    latency_dist: str = "constant"
+    ms_per_round: float = 1.0     # rounds -> wall-ms mapping for histories
+
+    @property
+    def n_total(self) -> int:
+        return self.n_nodes + self.n_clients
+
+
+def make_net(cfg: NetConfig) -> NetState:
+    return NetState(
+        pool=Msgs.empty(cfg.pool_cap),
+        next_mid=jnp.zeros((), I32),
+        round=jnp.zeros((), I32),
+        component=jnp.zeros(cfg.n_total, I32),
+        p_loss=jnp.zeros((), jnp.float32),
+        latency_scale=jnp.ones((), jnp.float32),
+        stats=NetStats.zeros())
+
+
+def involves_client(cfg: NetConfig, src, dest):
+    """Client on either end (reference `util.clj:12-16`)."""
+    return (src >= cfg.n_nodes) | (dest >= cfg.n_nodes)
+
+
+def draw_latency_rounds(cfg: NetConfig, key, scale, shape):
+    """Vectorized latency draw in rounds (reference `net.clj:64-76`):
+    constant(mean), uniform over [0, 2*mean], exponential with mean."""
+    mean = jnp.float32(cfg.latency_mean_rounds) * scale
+    if cfg.latency_dist == "constant":
+        base = jnp.broadcast_to(mean, shape)
+    elif cfg.latency_dist == "uniform":
+        base = jax.random.uniform(key, shape) * (2.0 * mean)
+    elif cfg.latency_dist == "exponential":
+        base = jax.random.exponential(key, shape) * mean
+    else:  # pragma: no cover
+        raise ValueError(f"unknown latency dist {cfg.latency_dist!r}")
+    return jnp.round(base).astype(I32)
+
+
+def _send(cfg: NetConfig, net: NetState, out: Msgs, key) -> NetState:
+    """Enqueue a flat batch of outgoing messages `out` (`[M]`) into the
+    flight pool: assign ids, draw latencies, roll loss, scatter into free
+    slots (reference `net.clj:188-220`).
+
+    Messages that find no free pool slot are dropped and counted in
+    `stats.dropped_overflow` — a correct run sizes `pool_cap` so this stays
+    zero (a silent drop would corrupt set-full checker results)."""
+    pool, M = net.pool, out.valid.shape[0]
+    k_lat, k_loss = jax.random.split(key)
+
+    new = out.valid
+    rank = jnp.cumsum(new.astype(I32)) - 1
+    mid = net.next_mid + rank                      # ids precede the loss roll
+    client = involves_client(cfg, out.src, out.dest)
+    lat = jnp.where(client, 0,
+                    draw_latency_rounds(cfg, k_lat, net.latency_scale, (M,)))
+    due = net.round + 1 + lat
+
+    lost = new & (jax.random.uniform(k_loss, (M,)) < net.p_loss)
+    keep = new & ~lost
+
+    free = ~pool.valid
+    n_free = jnp.sum(free.astype(I32))
+    free_order = jnp.argsort(~free, stable=True)   # free slots first
+    k_rank = jnp.cumsum(keep.astype(I32)) - 1
+    ok = keep & (k_rank < n_free)
+    slot = free_order[jnp.clip(k_rank, 0, cfg.pool_cap - 1)]
+    # out-of-bounds index => dropped by scatter mode='drop'
+    tgt = jnp.where(ok, slot, cfg.pool_cap)
+
+    incoming = out.replace(valid=ok, mid=mid, due=due)
+    pool = jax.tree.map(
+        lambda pf, nf: pf.at[tgt].set(nf, mode="drop"), pool, incoming)
+
+    st = net.stats
+    st = st.replace(
+        sent_all=st.sent_all + jnp.sum(new.astype(I32)),
+        sent_servers=st.sent_servers + jnp.sum((new & ~client).astype(I32)),
+        lost=st.lost + jnp.sum(lost.astype(I32)),
+        dropped_overflow=st.dropped_overflow
+        + jnp.sum((keep & ~ok).astype(I32)))
+    return net.replace(pool=pool, stats=st,
+                       next_mid=net.next_mid + jnp.sum(new.astype(I32)))
+
+
+def _deliver(cfg: NetConfig, net: NetState):
+    """Deliver all due messages for the current round.
+
+    Returns `(net', inbox, client_msgs)` where `inbox` is a `[N, K]` Msgs
+    batch (per-node, earliest-due first) and `client_msgs` is a flat
+    `[client_cap]` Msgs batch of messages addressed to clients. Node messages
+    that lose the K-slot race stay pooled for the next round; partitioned
+    messages are consumed and dropped, mirroring the reference's recv
+    (`net.clj:222-246`)."""
+    pool, P, N, K = net.pool, cfg.pool_cap, cfg.n_nodes, cfg.inbox_cap
+
+    due = pool.valid & (pool.due <= net.round)
+    client_msg = involves_client(cfg, pool.src, pool.dest)
+    blocked = (net.component[jnp.clip(pool.src, 0, cfg.n_total - 1)]
+               != net.component[jnp.clip(pool.dest, 0, cfg.n_total - 1)])
+    blocked = blocked & ~client_msg
+    to_client = due & ~blocked & (pool.dest >= N)
+    to_node = due & ~blocked & (pool.dest < N)
+    dropped = due & blocked
+
+    # --- node delivery: stable two-pass sort => (dest, due) order ---
+    perm1 = jnp.argsort(jnp.where(to_node, pool.due, INT32_MAX), stable=True)
+    dest_key = jnp.where(to_node, pool.dest, N)[perm1]
+    perm2 = jnp.argsort(dest_key, stable=True)
+    order = perm1[perm2]
+    sdest = dest_key[perm2]
+    first = jnp.searchsorted(sdest, sdest, side="left")
+    slot = jnp.arange(P, dtype=I32) - first.astype(I32)
+    take = to_node[order] & (slot < K)
+
+    tgt_dest = jnp.where(take, sdest, N)           # N => dropped scatter
+    tgt_slot = jnp.clip(slot, 0, K - 1)
+    sorted_msgs = pool.at_rows(order)
+    inbox = jax.tree.map(
+        lambda z, f: z.at[tgt_dest, tgt_slot].set(f, mode="drop"),
+        Msgs.empty((N, K)), sorted_msgs.replace(valid=take))
+
+    taken = jnp.zeros(P, bool).at[order].set(take)
+
+    # --- client delivery: due-ordered, first client_cap extracted ---
+    CC = min(cfg.client_cap, P)
+    if CC > 0:
+        corder = jnp.argsort(jnp.where(to_client, pool.due, INT32_MAX),
+                             stable=True)[:CC]
+        client_msgs = pool.at_rows(corder).replace(valid=to_client[corder])
+        c_taken = jnp.zeros(P, bool).at[corder].set(client_msgs.valid)
+    else:
+        # count-only mode: consume client messages without materializing
+        client_msgs = Msgs.empty(0)
+        c_taken = to_client
+
+    consumed = taken | dropped | c_taken
+    pool = pool.replace(valid=pool.valid & ~consumed)
+
+    n_node_recv = jnp.sum(taken.astype(I32))
+    n_client_recv = jnp.sum(c_taken.astype(I32))
+    server_recv = jnp.sum((taken & ~client_msg).astype(I32))
+    st = net.stats
+    st = st.replace(
+        recv_all=st.recv_all + n_node_recv + n_client_recv,
+        recv_servers=st.recv_servers + server_recv,
+        dropped_partition=st.dropped_partition
+        + jnp.sum(dropped.astype(I32)))
+    return net.replace(pool=pool, stats=st), inbox, client_msgs
+
+
+# Jitted entry points: cfg is static (hashable frozen dataclass), so each
+# (cfg, shapes) signature compiles exactly once. In this environment every
+# XLA compile costs ~1 s, so eager op-by-op execution is unusable; these
+# wrappers also compose freely under an outer jit/scan (inlined, no cost).
+send = jax.jit(_send, static_argnums=0)
+deliver = jax.jit(_deliver, static_argnums=0)
+
+
+def advance(net: NetState) -> NetState:
+    return net.replace(round=net.round + 1)
+
+
+# --- fault API (host-side state surgery; reference net.clj:104-121) ---
+
+def partition_components(net: NetState, labels) -> NetState:
+    """Install partition component labels (i32 per node; clients exempt).
+    The nemesis computes labels host-side (e.g. majority/minority split)."""
+    labels = jnp.asarray(labels, I32)
+    comp = net.component.at[: labels.shape[0]].set(labels)
+    return net.replace(component=comp)
+
+
+def heal(net: NetState) -> NetState:
+    return net.replace(component=jnp.zeros_like(net.component))
+
+
+def slow(net: NetState, factor: float = 10.0) -> NetState:
+    return net.replace(latency_scale=net.latency_scale * factor)
+
+
+def fast(net: NetState) -> NetState:
+    return net.replace(latency_scale=jnp.ones_like(net.latency_scale))
+
+
+def flaky(net: NetState, p: float = 0.5) -> NetState:
+    return net.replace(p_loss=jnp.full_like(net.p_loss, p))
+
+
+def stats_dict(net: NetState) -> dict:
+    """Pull the on-device counters to host, in the shape the net-stats
+    checker reports (`net/checker.clj:43-70`)."""
+    import dataclasses
+    st = jax.device_get(net.stats)
+    return {f.name: int(getattr(st, f.name))
+            for f in dataclasses.fields(st)}

@@ -1,0 +1,249 @@
+"""Semantics tests for the TPU flight-pool network (`maelstrom_tpu.net.tpu`),
+mirroring the reference network behaviors in `src/maelstrom/net.clj`:
+deadline-ordered delivery, loss at send, partitions consumed at receive,
+client zero latency, backpressure instead of silent drops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu.net import tpu as T
+
+
+def mk(cfg, msgs):
+    """Builds a flat Msgs batch from (src, dest, type, a) tuples."""
+    M = len(msgs)
+    out = T.Msgs.empty(max(M, 1))
+    if not msgs:
+        return out
+    src, dest, typ, a = map(jnp.array, zip(*msgs))
+    return out.replace(valid=jnp.ones(M, bool), src=src.astype(T.I32),
+                       dest=dest.astype(T.I32), type=typ.astype(T.I32),
+                       a=a.astype(T.I32))
+
+
+def pump(cfg, net, key=None, rounds=1):
+    """Advance `rounds` rounds with no node logic, collecting deliveries."""
+    inboxes, client_batches = [], []
+    for _ in range(rounds):
+        net, inbox, cmsgs = T.deliver(cfg, net)
+        inboxes.append(jax.device_get(inbox))
+        client_batches.append(jax.device_get(cmsgs))
+        net = T.advance(net)
+    return net, inboxes, client_batches
+
+
+def test_send_deliver_roundtrip():
+    cfg = T.NetConfig(n_nodes=3, n_clients=1, pool_cap=32, inbox_cap=4)
+    net = T.make_net(cfg)
+    key = jax.random.PRNGKey(0)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 7, 42), (2, 1, 7, 43)]), key)
+    assert int(net.pool.count()) == 2
+    net, inboxes, _ = pump(cfg, net, rounds=2)
+    # zero latency config: due = round+1, delivered on round 1
+    ib = inboxes[1]
+    assert ib.valid[1].sum() == 2
+    got = sorted(ib.a[1][ib.valid[1]].tolist())
+    assert got == [42, 43]
+    assert ib.valid[0].sum() == 0 and ib.valid[2].sum() == 0
+    assert int(net.pool.count()) == 0
+    st = T.stats_dict(net)
+    assert st["sent_all"] == 2 and st["recv_all"] == 2
+    assert st["sent_servers"] == 2 and st["recv_servers"] == 2
+
+
+def test_message_ids_unique_and_monotonic():
+    cfg = T.NetConfig(n_nodes=2, pool_cap=16)
+    net = T.make_net(cfg)
+    k = jax.random.PRNGKey(0)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0), (1, 0, 1, 0)]), k)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0)]), k)
+    pool = jax.device_get(net.pool)
+    mids = sorted(pool.mid[pool.valid].tolist())
+    assert mids == [0, 1, 2]
+    assert int(net.next_mid) == 3
+
+
+def test_latency_rounds_delay_delivery():
+    cfg = T.NetConfig(n_nodes=2, pool_cap=16, latency_mean_rounds=3,
+                      latency_dist="constant")
+    net = T.make_net(cfg)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 9)]), jax.random.PRNGKey(0))
+    net, inboxes, _ = pump(cfg, net, rounds=5)
+    per_round = [ib.valid.sum() for ib in inboxes]
+    # due = 0 + 1 + 3 = 4 -> delivered in round 4
+    assert per_round == [0, 0, 0, 0, 1]
+
+
+def test_client_zero_latency_and_extraction():
+    cfg = T.NetConfig(n_nodes=2, n_clients=1, pool_cap=16,
+                      latency_mean_rounds=50, latency_dist="constant")
+    net = T.make_net(cfg)
+    k = jax.random.PRNGKey(1)
+    # client (index 2) -> node 0, and node 0 -> client: both bypass latency
+    net = T.send(cfg, net, mk(cfg, [(2, 0, 1, 1), (0, 2, 2, 2)]), k)
+    net, inboxes, cmsgs = pump(cfg, net, rounds=2)
+    assert inboxes[1].valid.sum() == 1          # client -> node arrived
+    cb = cmsgs[1]
+    assert cb.valid.sum() == 1 and cb.a[cb.valid].tolist() == [2]
+    st = T.stats_dict(net)
+    assert st["sent_servers"] == 0 and st["recv_servers"] == 0
+    assert st["recv_all"] == 2
+
+
+def test_earliest_due_wins_inbox_slots_backpressure():
+    # 6 messages due the same round to one node with inbox_cap=2: the two
+    # earliest-due arrive first; the rest stay pooled (no drops).
+    cfg = T.NetConfig(n_nodes=2, pool_cap=32, inbox_cap=2)
+    net = T.make_net(cfg)
+    out = T.Msgs.empty(6)
+    out = out.replace(valid=jnp.ones(6, bool),
+                      src=jnp.zeros(6, T.I32),
+                      dest=jnp.ones(6, T.I32),
+                      type=jnp.ones(6, T.I32),
+                      a=jnp.arange(6, dtype=T.I32))
+    net = T.send(cfg, net, out, jax.random.PRNGKey(0))
+    # hand-tweak due rounds: msgs 4,5 due earliest
+    pool = net.pool
+    due = jnp.where(pool.valid & (pool.a >= 4), 1, 2)
+    net = net.replace(pool=pool.replace(due=jnp.where(pool.valid, due,
+                                                      pool.due)))
+    net, inboxes, _ = pump(cfg, net, rounds=4)
+    r1 = inboxes[1]
+    assert sorted(r1.a[1][r1.valid[1]].tolist()) == [4, 5]
+    r2 = inboxes[2]
+    assert r2.valid[1].sum() == 2
+    r3 = inboxes[3]
+    assert r3.valid[1].sum() == 2
+    st = T.stats_dict(net)
+    assert st["dropped_overflow"] == 0 and st["recv_all"] == 6
+
+
+def test_loss_at_send():
+    cfg = T.NetConfig(n_nodes=2, pool_cap=2048)
+    net = T.make_net(cfg)
+    net = T.flaky(net, 0.5)
+    M = 1000
+    out = T.Msgs.empty(M).replace(
+        valid=jnp.ones(M, bool), src=jnp.zeros(M, T.I32),
+        dest=jnp.ones(M, T.I32), type=jnp.ones(M, T.I32),
+        a=jnp.arange(M, dtype=T.I32))
+    net = T.send(cfg, net, out, jax.random.PRNGKey(7))
+    st = T.stats_dict(net)
+    assert st["sent_all"] == M                  # journal logs before loss
+    assert 350 < st["lost"] < 650
+    assert int(net.pool.count()) == M - st["lost"]
+    assert int(net.next_mid) == M               # lost msgs still consume ids
+
+
+def test_partition_consumes_messages():
+    cfg = T.NetConfig(n_nodes=4, n_clients=1, pool_cap=32)
+    net = T.make_net(cfg)
+    k = jax.random.PRNGKey(0)
+    net = T.partition_components(net, [0, 0, 1, 1])
+    msgs = [(0, 2, 1, 1),    # cross-partition: consumed + dropped
+            (0, 1, 1, 2),    # same side: delivered
+            (2, 3, 1, 3),    # same side: delivered
+            (4, 2, 1, 4),    # client -> node: partitions never block clients
+            (2, 4, 2, 5)]    # node -> client: same
+    net = T.send(cfg, net, mk(cfg, msgs), k)
+    net, inboxes, cmsgs = pump(cfg, net, rounds=2)
+    ib = inboxes[1]
+    assert ib.a[1][ib.valid[1]].tolist() == [2]
+    got2 = sorted(ib.a[2][ib.valid[2]].tolist())
+    assert got2 == [4]                          # msg 1 blocked
+    assert ib.a[3][ib.valid[3]].tolist() == [3]
+    assert cmsgs[1].a[cmsgs[1].valid].tolist() == [5]
+    st = T.stats_dict(net)
+    assert st["dropped_partition"] == 1
+    assert int(net.pool.count()) == 0           # blocked msg was consumed
+    # heal clears components
+    net = T.heal(net)
+    assert jax.device_get(net.component).tolist() == [0] * 5
+
+
+def test_pool_overflow_counted():
+    cfg = T.NetConfig(n_nodes=2, pool_cap=4)
+    net = T.make_net(cfg)
+    out = mk(cfg, [(0, 1, 1, i) for i in range(6)])
+    net = T.send(cfg, net, out, jax.random.PRNGKey(0))
+    st = T.stats_dict(net)
+    assert st["dropped_overflow"] == 2
+    assert int(net.pool.count()) == 4
+
+
+def test_client_cap_zero_counts_without_materializing():
+    cfg = T.NetConfig(n_nodes=2, n_clients=1, pool_cap=16, client_cap=0)
+    net = T.make_net(cfg)
+    net = T.send(cfg, net, mk(cfg, [(0, 2, 1, 1), (0, 1, 1, 2)]),
+                 jax.random.PRNGKey(0))
+    net, inboxes, cmsgs = pump(cfg, net, rounds=2)
+    assert cmsgs[1].valid.shape == (0,)
+    assert inboxes[1].valid.sum() == 1
+    st = T.stats_dict(net)
+    assert st["recv_all"] == 2          # client msg consumed and counted
+    assert int(net.pool.count()) == 0
+
+
+def test_slow_fast_latency_scale():
+    cfg = T.NetConfig(n_nodes=2, pool_cap=16, latency_mean_rounds=2,
+                      latency_dist="constant")
+    net = T.make_net(cfg)
+    net = T.slow(net, 3.0)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 1)]), jax.random.PRNGKey(0))
+    pool = jax.device_get(net.pool)
+    assert pool.due[pool.valid].tolist() == [7]     # 0 + 1 + 2*3
+    net = T.fast(net)
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 2)]), jax.random.PRNGKey(1))
+    pool = jax.device_get(net.pool)
+    assert sorted(pool.due[pool.valid].tolist()) == [3, 7]
+
+
+def test_uniform_and_exponential_latency_distributions():
+    for dist, lo, hi in [("uniform", 0, 20), ("exponential", 0, 200)]:
+        cfg = T.NetConfig(n_nodes=2, pool_cap=4096, latency_mean_rounds=10,
+                          latency_dist=dist)
+        net = T.make_net(cfg)
+        M = 2000
+        out = T.Msgs.empty(M).replace(
+            valid=jnp.ones(M, bool), src=jnp.zeros(M, T.I32),
+            dest=jnp.ones(M, T.I32), type=jnp.ones(M, T.I32),
+            a=jnp.arange(M, dtype=T.I32))
+        net = T.send(cfg, net, out, jax.random.PRNGKey(3))
+        pool = jax.device_get(net.pool)
+        lat = pool.due[pool.valid] - 1
+        assert lat.min() >= lo
+        assert abs(float(lat.mean()) - 10) < 1.5, dist
+        if dist == "uniform":
+            assert lat.max() <= hi
+
+
+def test_deliver_under_jit_and_scan():
+    """The whole round loop must compile: deliver + send under lax.scan."""
+    cfg = T.NetConfig(n_nodes=4, pool_cap=64, inbox_cap=4)
+    net = T.make_net(cfg)
+    # each node sends to (i+1) % 4 every round; run 10 rounds in one scan
+    def body(carry, _):
+        net, key = carry
+        key, k = jax.random.split(key)
+        net, inbox, _ = T.deliver(cfg, net)
+        # forward every received message to the next node
+        out = jax.tree.map(lambda f: f.reshape((-1,) + f.shape[2:]), inbox)
+        out = out.replace(src=out.dest,
+                          dest=(out.dest + 1) % cfg.n_nodes)
+        net = T.send(cfg, net, out, k)
+        net = T.advance(net)
+        return (net, key), inbox.count()
+
+    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 5)]), jax.random.PRNGKey(0))
+
+    @jax.jit
+    def run(net, key):
+        (net, _), counts = jax.lax.scan(body, (net, key), None, length=10)
+        return net, counts
+
+    net, counts = run(net, jax.random.PRNGKey(1))
+    assert int(counts.sum()) == 9       # delivered once per round from r1
+    st = T.stats_dict(net)
+    assert st["recv_all"] == 9 and st["dropped_overflow"] == 0
