@@ -155,10 +155,11 @@ def draw_latency_rounds(cfg: NetConfig, key, scale, shape):
     return jnp.round(base).astype(I32)
 
 
-def _send(cfg: NetConfig, net: NetState, out: Msgs, key) -> NetState:
+def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     """Enqueue a flat batch of outgoing messages `out` (`[M]`) into the
     flight pool: assign ids, draw latencies, roll loss, scatter into free
-    slots (reference `net.clj:188-220`).
+    slots (reference `net.clj:188-220`). Returns (net', sent_view) where
+    sent_view is the id-stamped batch for journaling.
 
     Messages that find no free pool slot are dropped and counted in
     `stats.dropped_overflow` — a correct run sizes `pool_cap` so this stays
@@ -189,6 +190,10 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key) -> NetState:
     incoming = out.replace(valid=ok, mid=mid, due=due)
     pool = jax.tree.map(
         lambda pf, nf: pf.at[tgt].set(nf, mode="drop"), pool, incoming)
+    # journal view: every attempted send with its assigned id, including
+    # messages the loss roll ate (the reference journals before the loss
+    # check, net.clj:207,213)
+    sent_view = out.replace(valid=new, mid=mid, due=due)
 
     st = net.stats
     st = st.replace(
@@ -197,8 +202,9 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key) -> NetState:
         lost=st.lost + jnp.sum(lost.astype(I32)),
         dropped_overflow=st.dropped_overflow
         + jnp.sum((keep & ~ok).astype(I32)))
-    return net.replace(pool=pool, stats=st,
-                       next_mid=net.next_mid + jnp.sum(new.astype(I32)))
+    net = net.replace(pool=pool, stats=st,
+                      next_mid=net.next_mid + jnp.sum(new.astype(I32)))
+    return net, sent_view
 
 
 def _deliver(cfg: NetConfig, net: NetState):

@@ -1,0 +1,131 @@
+"""Built-in TPU node programs.
+
+Where the reference runs one OS process per node speaking JSON over stdio
+(`src/maelstrom/process.clj`, `demo/**`), a *node program* here is a pure,
+batched JAX state machine: per-node state is a pytree of arrays with a
+leading node axis, and one `step(state, inbox, ctx) -> (state', outbox)`
+advances every node one round inside the jitted simulation loop
+(`maelstrom_tpu.sim`).
+
+Each program also defines the host-boundary contract that keeps the JSON
+protocol (`doc/protocol.md` parity) as the compatibility surface:
+
+  - `request_for_op(op)`: generator op -> protocol JSON body (or HOST when
+    the op is answered host-side from device state)
+  - `encode_body(body, intern)` / `decode_body(t, a, b, c, intern)`:
+    JSON body <-> fixed-width words (type code + 3 payload words). Opaque
+    payloads (e.g. echo strings) go through the run's interning table.
+  - `completion(op, body, read_state, intern)`: reply body -> completed history op.
+    `read_state()` returns the destination node's state row, pulled at
+    completion time — reads whose values don't fit in a message body (e.g.
+    a broadcast node's whole set) reply with a bare ack on the wire and
+    materialize the value here, which keeps message accounting faithful and
+    places the read's linearization point inside its op window.
+
+Type code 0 is reserved (invalid slot), 1 is the shared RPC error reply;
+programs define their own codes from 10 up.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+T_INVALID = 0
+T_ERROR = 1     # error reply: a = error code, b = interned text
+
+HOST = "host"   # sentinel: op handled host-side, no message injected
+
+
+class Intern:
+    """Bidirectional value <-> int32 table for opaque payloads crossing the
+    host/device boundary (SURVEY.md section 7 'hard parts')."""
+
+    def __init__(self):
+        self._fwd: dict[str, int] = {}
+        self._rev: list[Any] = []
+
+    def id(self, value) -> int:
+        key = json.dumps(value, sort_keys=True, default=str)
+        i = self._fwd.get(key)
+        if i is None:
+            i = len(self._rev)
+            self._fwd[key] = i
+            self._rev.append(value)
+        return i
+
+    def value(self, i: int):
+        return self._rev[i]
+
+
+class NodeProgram:
+    """Base class for built-in batched node programs."""
+
+    name = "abstract"
+    inbox_cap = 8
+    outbox_cap = 8
+    needs_state_reads = False   # runner pulls node state rows for reads
+
+    def __init__(self, opts: dict, nodes: list[str]):
+        self.opts = opts
+        self.nodes = nodes
+        self.n_nodes = len(nodes)
+
+    # --- device side ---
+
+    def init_state(self):
+        """Per-node state pytree, leading axis n_nodes."""
+        raise NotImplementedError
+
+    def step(self, state, inbox, ctx):
+        """Batched step: inbox is a Msgs batch [N, K]; returns
+        (state', outbox Msgs [N, O]). ctx: {"round": i32, "key": PRNGKey}.
+        The outbox's src/mid/due fields are overwritten by the network."""
+        raise NotImplementedError
+
+    # --- host boundary ---
+
+    def request_for_op(self, op: dict):
+        """Generator op -> protocol body dict, or HOST."""
+        raise NotImplementedError
+
+    def encode_body(self, body: dict, intern: Intern):
+        """Protocol body -> (type, a, b, c) words."""
+        raise NotImplementedError
+
+    def decode_body(self, t: int, a: int, b: int, c: int, intern: Intern):
+        """Words -> protocol body dict."""
+        if t == T_ERROR:
+            return {"type": "error", "code": int(a),
+                    "text": intern.value(b) if 0 <= b < len(intern._rev)
+                    else ""}
+        raise ValueError(f"{self.name}: unknown reply type code {t}")
+
+    def completion(self, op: dict, body: dict,
+                   read_state: Callable[[], Any],
+                   intern: Intern) -> dict:
+        """Reply body -> completed op (type ok). Error bodies are mapped by
+        the runner before this is called."""
+        return {**op, "type": "ok"}
+
+    def host_op(self, op: dict, read_state: Callable[[], Any],
+                intern: Intern) -> dict:
+        """Completes a HOST-routed op from device state."""
+        raise NotImplementedError
+
+
+PROGRAMS: dict[str, Callable] = {}
+
+
+def register(cls):
+    PROGRAMS[cls.name] = cls
+    return cls
+
+
+def get_program(name: str, opts: dict, nodes: list[str]) -> NodeProgram:
+    # import for side effect: program registration
+    from . import echo, broadcast  # noqa: F401
+    if name not in PROGRAMS:
+        raise ValueError(f"no built-in TPU node program {name!r}; "
+                         f"have {sorted(PROGRAMS)}")
+    return PROGRAMS[name](opts, nodes)

@@ -1,0 +1,192 @@
+"""Batched broadcast node: partition-tolerant gossip over a topology.
+
+The TPU-native analogue of the reference's retrying broadcast demo
+(`demo/ruby/broadcast.rb` serving `workload/broadcast.clj`): each node keeps
+a `seen` set; new values are forwarded to every neighbor except the sender
+(the skip-sender optimization, reference `doc/03-broadcast/02-performance.md:73-76`),
+acknowledged on receipt, and retransmitted until acknowledged so values
+survive partitions and message loss.
+
+All N nodes' sets live in three bit-plane arrays:
+
+  seen     [N, V]     value v is in node n's set
+  pending  [N, D, V]  v must be sent to neighbor d (not yet sent / requeued)
+  inflight [N, D, V]  v was sent to d, awaiting gossip_ok
+
+One step is a handful of masked scatters over these planes plus a top_k
+per (node, neighbor) to pick the next gossip batch — no per-node control
+flow, so the whole cluster advances in one XLA dispatch.
+
+Reads reply with a bare `read_ok` on the wire; the set itself (unbounded,
+doesn't fit a fixed body) is materialized host-side from the `seen` row at
+completion time (see `maelstrom_tpu.nodes` docstring)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..net.tpu import I32, Msgs
+from ..workloads.broadcast import TOPOLOGIES, topology_indices
+from . import NodeProgram, register
+
+T_BCAST = 10      # client -> node: a = value index
+T_BCAST_OK = 11
+T_READ = 12
+T_READ_OK = 13    # bare ack; value materialized host-side
+T_GOSSIP = 14     # node -> node: a = value index
+T_GOSSIP_OK = 15  # ack: a = value index
+
+
+@register
+class BroadcastProgram(NodeProgram):
+    name = "broadcast"
+    needs_state_reads = True
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        topo = TOPOLOGIES[opts.get("topology", "grid")](nodes)
+        self.neighbors = jnp.asarray(
+            topology_indices(topo, nodes))            # [N, D]
+        self.D = self.neighbors.shape[1]
+        self.V = int(opts.get("max_values", 1024))
+        self.per_nb = int(opts.get("gossip_per_neighbor", 4))
+        lat = (opts.get("latency") or {}).get("mean", 0)
+        ms_per_round = opts.get("ms_per_round", 1.0)
+        # retransmit after a round-trip (2 hops) plus slack
+        self.retry_rounds = max(int(4 * lat / ms_per_round), 10)
+        self.inbox_cap = int(opts.get("inbox_cap", 2 * self.D + 4))
+        self.outbox_cap = self.inbox_cap + self.D * self.per_nb
+
+    def init_state(self):
+        N, D, V = self.n_nodes, self.D, self.V
+        return {"seen": jnp.zeros((N, V), bool),
+                "pending": jnp.zeros((N, D, V), bool),
+                "inflight": jnp.zeros((N, D, V), bool),
+                "next_retry": jnp.zeros((N, D), I32)}
+
+    def step(self, state, inbox, ctx):
+        N, K, D, V = self.n_nodes, self.inbox_cap, self.D, self.V
+        nb = self.neighbors
+        seen, pending = state["seen"], state["pending"]
+        inflight, next_retry = state["inflight"], state["next_retry"]
+
+        rows = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None], (N, K))
+        v = jnp.clip(inbox.a, 0, V - 1)
+        is_gossip = inbox.valid & (inbox.type == T_GOSSIP)
+        is_cb = inbox.valid & (inbox.type == T_BCAST)
+        is_ack = inbox.valid & (inbox.type == T_GOSSIP_OK)
+        is_read = inbox.valid & (inbox.type == T_READ)
+        carrier = is_gossip | is_cb
+
+        new = carrier & ~seen[rows, v]
+        seen = seen.at[jnp.where(carrier, rows, N), v].set(True, mode="drop")
+
+        # [N, K, D] slot-neighbor masks
+        nb_valid = nb >= 0
+        src_is_nb = nb[:, None, :] == inbox.src[:, :, None]
+        n3 = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None, None],
+                              (N, K, D))
+        d3 = jnp.broadcast_to(jnp.arange(D, dtype=I32)[None, None, :],
+                              (N, K, D))
+        v3 = jnp.broadcast_to(v[:, :, None], (N, K, D))
+
+        # forward new values to all neighbors except the sender
+        add = new[:, :, None] & nb_valid[:, None, :] & ~src_is_nb
+        pend_add = jnp.zeros((N, D, V), bool).at[
+            jnp.where(add, n3, N), d3, v3].set(True, mode="drop")
+        # the sender evidently has the value: stop sending it to them
+        clear = (is_gossip | is_ack)[:, :, None] & src_is_nb
+        pend_clear = jnp.zeros((N, D, V), bool).at[
+            jnp.where(clear, n3, N), d3, v3].set(True, mode="drop")
+
+        pending = (pending | pend_add) & ~pend_clear
+        inflight = inflight & ~pend_clear
+
+        # retransmit timer: requeue unacked sends. The timer tracks the
+        # OLDEST outstanding send (armed only when inflight was empty), so
+        # a steady stream of new sends can't starve a lost message of its
+        # retransmission.
+        requeue = ctx["round"] >= next_retry
+        pending = pending | (inflight & requeue[:, :, None])
+        inflight = inflight & ~requeue[:, :, None]
+        had_inflight = inflight.any(axis=2)             # [N, D]
+
+        # pick up to per_nb lowest-index pending values per neighbor
+        prio = jnp.where(pending,
+                         V - jnp.arange(V, dtype=I32)[None, None, :], 0)
+        topv, topi = jax.lax.top_k(prio, self.per_nb)   # [N, D, per_nb]
+        sel = topv > 0
+        ns = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None, None],
+                              sel.shape)
+        ds = jnp.broadcast_to(jnp.arange(D, dtype=I32)[None, :, None],
+                              sel.shape)
+        sent = jnp.zeros((N, D, V), bool).at[
+            jnp.where(sel, ns, N), ds, topi].set(True, mode="drop")
+        pending = pending & ~sent
+        inflight = inflight | sent
+        arm = sel.any(axis=2) & ~had_inflight
+        next_retry = jnp.where(arm, ctx["round"] + self.retry_rounds,
+                               next_retry)
+
+        # outbox: replies to this round's inbox + gossip batch
+        reply_type = jnp.where(
+            is_gossip, T_GOSSIP_OK,
+            jnp.where(is_cb, T_BCAST_OK,
+                      jnp.where(is_read, T_READ_OK, 0)))
+        replies = inbox.replace(
+            valid=is_gossip | is_cb | is_read,
+            dest=inbox.src, reply_to=inbox.mid, type=reply_type,
+            a=jnp.where(is_gossip, inbox.a, 0))
+
+        G = D * self.per_nb
+        gossip = Msgs.empty((N, G)).replace(
+            valid=sel.reshape(N, G) & (jnp.repeat(nb, self.per_nb, axis=1)
+                                       >= 0),
+            dest=jnp.repeat(nb, self.per_nb, axis=1),
+            type=jnp.full((N, G), T_GOSSIP, I32),
+            a=topi.reshape(N, G))
+
+        outbox = jax.tree.map(
+            lambda r, g: jnp.concatenate([r, g], axis=1), replies, gossip)
+        state = {"seen": seen, "pending": pending, "inflight": inflight,
+                 "next_retry": next_retry}
+        return state, outbox
+
+    def quiescent(self, state):
+        """True when no gossip or retransmission is outstanding — lets the
+        runner fast-forward idle virtual time."""
+        return ~(state["pending"].any() | state["inflight"].any())
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        if op["f"] == "broadcast":
+            return {"type": "broadcast", "message": op["value"]}
+        return {"type": "read"}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "broadcast":
+            i = intern.id(body["message"])
+            if i >= self.V:
+                raise ValueError(
+                    f"broadcast value table full ({self.V}); raise "
+                    f"--max-values")
+            return (T_BCAST, i, 0, 0)
+        return (T_READ, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_BCAST_OK:
+            return {"type": "broadcast_ok"}
+        if t == T_READ_OK:
+            return {"type": "read_ok"}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] == "read_ok":
+            seen_row = np.asarray(read_state()["seen"])
+            return {**op, "type": "ok",
+                    "value": [intern.value(int(i))
+                              for i in np.nonzero(seen_row)[0]]}
+        return {**op, "type": "ok"}

@@ -1,0 +1,52 @@
+"""Batched echo node: reply to every `echo` with an `echo_ok` carrying the
+same payload (the TPU-native analogue of `demo/python/echo.py` and the
+reference's `demo/ruby/echo.rb`, serving `workload/echo.clj`).
+
+Stateless: the whole step is a masked relabeling of the inbox — dest/src
+swapped, type rewritten, payload word passed through. No per-node Python,
+no loops; one fused XLA kernel for all N nodes."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..net.tpu import I32
+from . import NodeProgram, register
+
+T_ECHO = 10
+T_ECHO_OK = 11
+
+
+@register
+class EchoProgram(NodeProgram):
+    name = "echo"
+
+    def init_state(self):
+        # no per-node state; a placeholder row keeps the pytree non-empty
+        return {"rounds": jnp.zeros((self.n_nodes,), I32)}
+
+    def step(self, state, inbox, ctx):
+        out = inbox.replace(
+            valid=inbox.valid & (inbox.type == T_ECHO),
+            dest=inbox.src,
+            reply_to=inbox.mid,
+            type=jnp.full_like(inbox.type, T_ECHO_OK))
+        return {"rounds": state["rounds"] + 1}, out
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        return {"type": "echo", "echo": op["value"]}
+
+    def encode_body(self, body, intern):
+        assert body["type"] == "echo"
+        return (T_ECHO, intern.id(body["echo"]), 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_ECHO_OK:
+            return {"type": "echo_ok", "echo": intern.value(a)}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        return {**op, "type": "ok",
+                "value": {k: v for k, v in body.items() if k != "type"}}

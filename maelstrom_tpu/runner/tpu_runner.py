@@ -1,0 +1,405 @@
+"""The TPU-path test runner: virtual-time lockstep generator interpreter.
+
+Replaces the host path's thread-per-client real-time loop
+(`runner/host_runner.py`) with a synchronous round loop over the jitted
+simulation (`maelstrom_tpu.sim`): each iteration polls the (pure, virtual-
+time) generators for client ops, encodes them into the injection batch, runs
+one compiled network+nodes round, decodes client replies into history
+completions, applies timeouts, and lets the nemesis rewrite fault masks at
+round boundaries.
+
+Time is virtual: 1 round = `ms_per_round` milliseconds (default 1), so the
+same generator combinators (stagger/time-limit/sleep) and the same checkers
+(perf quantiles, stable-latency) read it exactly like the host path's
+wall-clock nanoseconds. Quiescent stretches — empty flight pool, quiescent
+node program, no outstanding RPCs — are fast-forwarded without dispatching
+rounds, so a 10-virtual-second test with rate 5 costs ~hundreds of
+dispatches, not 10,000.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import generators as g
+from .. import store
+from ..checkers import Checker
+from ..errors import ERROR_REGISTRY
+from ..history import History, Op
+from ..nemesis import GRUDGES
+from ..net import tpu as T
+from ..nodes import HOST, Intern, get_program
+from ..sim import SimState, make_round_fn, make_sim
+
+log = logging.getLogger("maelstrom.tpu")
+
+
+def _labels_from_grudge(nodes, grudge) -> list[int]:
+    """Converts a dest->blocked-srcs grudge map into partition component
+    labels (the TPU fault representation). Components = connected groups of
+    the *allowed* graph."""
+    idx = {n: i for i, n in enumerate(nodes)}
+    n = len(nodes)
+    allowed = np.ones((n, n), bool)
+    for dest, srcs in grudge.items():
+        for src in srcs:
+            allowed[idx[dest], idx[src]] = False
+            allowed[idx[src], idx[dest]] = False
+    labels = [-1] * n
+    c = 0
+    for i in range(n):
+        if labels[i] >= 0:
+            continue
+        stack = [i]
+        labels[i] = c
+        while stack:
+            u = stack.pop()
+            for v2 in range(n):
+                if labels[v2] < 0 and allowed[u, v2]:
+                    labels[v2] = c
+                    stack.append(v2)
+        c += 1
+    # The component representation can only express grudges that separate
+    # nodes into disconnected groups; a grudge that cuts a<->b while both
+    # reach c would be silently coarsened away. Refuse rather than run a
+    # vacuous nemesis.
+    for dest, srcs in grudge.items():
+        for src in srcs:
+            if labels[idx[dest]] == labels[idx[src]]:
+                raise ValueError(
+                    f"grudge cuts {src}<->{dest} but both remain connected "
+                    f"via third parties; not expressible as components")
+    return labels
+
+
+class TpuPartitionNemesis:
+    """Applies partition ops to the TPU network's component labels
+    (the mask analogue of `net.clj:108-112`)."""
+
+    def __init__(self, runner, nodes, seed=0):
+        import random
+        self.runner = runner
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+
+    def invoke(self, op):
+        f = op["f"]
+        if f == "start-partition":
+            name, grudge = self.rng.choice(GRUDGES)(self.nodes, self.rng)
+            labels = _labels_from_grudge(self.nodes, grudge)
+            self.runner.sim = self.runner.sim.replace(
+                net=T.partition_components(self.runner.sim.net, labels))
+            return {**op, "type": "info", "value": name}
+        if f == "stop-partition":
+            self.runner.sim = self.runner.sim.replace(
+                net=T.heal(self.runner.sim.net))
+            return {**op, "type": "info", "value": "healed"}
+        raise ValueError(f"unknown nemesis op {f!r}")
+
+
+class TpuNetStats(Checker):
+    """Net statistics from the on-device counters, shaped like the journal
+    fold output (`net/checker.clj:28-41`). Unique msg-count equals the send
+    count because the TPU network assigns globally unique ids."""
+
+    name = "net"
+
+    def __init__(self, runner):
+        self.runner = runner
+
+    def check(self, test, history, opts=None):
+        c = T.stats_dict(self.runner.sim.net)
+        op_count = sum(1 for o in history
+                       if o.type == "invoke" and o.process != "nemesis")
+        groups = {
+            "all": {"send-count": c["sent_all"], "recv-count": c["recv_all"],
+                    "msg-count": c["sent_all"]},
+            "servers": {"send-count": c["sent_servers"],
+                        "recv-count": c["recv_servers"],
+                        "msg-count": c["sent_servers"]},
+            "clients": {
+                "send-count": c["sent_all"] - c["sent_servers"],
+                "recv-count": c["recv_all"] - c["recv_servers"],
+                "msg-count": c["sent_all"] - c["sent_servers"]},
+        }
+        if op_count:
+            groups["all"]["msgs-per-op"] = (
+                groups["all"]["msg-count"] / op_count)
+            groups["servers"]["msgs-per-op"] = (
+                groups["servers"]["msg-count"] / op_count)
+        out = dict(groups)
+        out["lost"] = c["lost"]
+        out["dropped-partition"] = c["dropped_partition"]
+        out["dropped-overflow"] = c["dropped_overflow"]
+        journal = self.runner.journal
+        store_dir = test.get("store_dir")
+        if journal is not None and store_dir:
+            try:
+                import os
+                from ..viz.lamport import plot_lamport
+                plot_lamport(journal, os.path.join(store_dir,
+                                                   "messages.svg"))
+            except Exception as e:  # viz must never fail the test
+                out["viz-error"] = repr(e)
+        # a pool overflow silently destroys messages: invalidate the run
+        out["valid"] = True if c["dropped_overflow"] == 0 else False
+        return out
+
+
+class TpuRunner:
+    def __init__(self, test: dict):
+        self.test = test
+        nodes = test["nodes"]
+        self.nodes = nodes
+        spec = str(test["node"]).split(":", 1)[1]   # "tpu:<program>"
+        self.concurrency = int(test.get("concurrency") or len(nodes))
+        self.ms_per_round = float(test.get("ms_per_round", 1.0))
+        test.setdefault("ms_per_round", self.ms_per_round)
+        self.program = get_program(spec, test, nodes)
+        lat = test.get("latency") or {}
+        mean_rounds = float(lat.get("mean", 0)) / self.ms_per_round
+        n = len(nodes)
+        pool_cap = int(test.get("pool_cap") or max(
+            4096, 4 * n * self.program.outbox_cap))
+        self.cfg = T.NetConfig(
+            n_nodes=n, n_clients=self.concurrency, pool_cap=pool_cap,
+            inbox_cap=self.program.inbox_cap,
+            client_cap=max(2 * self.concurrency, 8),
+            latency_mean_rounds=mean_rounds,
+            latency_dist=lat.get("dist", "constant"),
+            ms_per_round=self.ms_per_round)
+        self.sim = make_sim(self.program, self.cfg, seed=test.get("seed", 0))
+        if test.get("p_loss"):
+            self.sim = self.sim.replace(
+                net=T.flaky(self.sim.net, float(test["p_loss"])))
+        self.round_fn = make_round_fn(self.program, self.cfg)
+        self.intern = Intern()
+        self.timeout_rounds = max(
+            int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
+        # per-message journal rows: on by default for small clusters, where
+        # Lamport diagrams are readable and the per-round device pull is
+        # cheap; large runs keep only the on-device counters
+        self.journal = None
+        if test.get("journal_rows", n <= 64):
+            journal = getattr(test.get("net"), "journal", None)
+            self.journal = journal
+        self.node_names = list(nodes) + [f"c{i}"
+                                         for i in range(self.concurrency)]
+        self._state_cache = None
+        self._bump = jax.jit(
+            lambda sim, k: sim.replace(net=sim.net.replace(
+                round=sim.net.round + k)))
+
+    # --- helpers ---
+
+    def _time_ns(self, r: int) -> int:
+        return int(r * self.ms_per_round * 1e6)
+
+    def _read_state(self, node_idx: int):
+        """Pulls one node's state row at the current round (cached per
+        round)."""
+        if self._state_cache is None:
+            self._state_cache = jax.device_get(self.sim.nodes)
+        return jax.tree.map(lambda a: a[node_idx], self._state_cache)
+
+    def _complete(self, history, gen, ctx, process, completed, free):
+        o = Op(type=completed.get("type", "info"), f=completed.get("f"),
+               value=completed.get("value"), process=process,
+               time=ctx["time"], error=completed.get("error"),
+               final=completed.get("final", False))
+        history.append(o)
+        free.add(process)
+        return gen.update(ctx, completed)
+
+    # --- main loop ---
+
+    def run(self) -> History:
+        test, cfg, program = self.test, self.cfg, self.program
+        N, C = cfg.n_nodes, self.concurrency
+        gen = g.to_gen(test["generator"])
+        nemesis = (TpuPartitionNemesis(self, self.nodes, test.get("seed", 0))
+                   if test.get("nemesis_pkg", {}).get("generator") is not None
+                   or test.get("nemesis") else None)
+        processes = list(range(C)) + ([g.NEMESIS] if nemesis else [])
+        free = set(processes)
+        pending: dict[int, tuple] = {}   # mid -> (process, op, node_idx, deadline_round)
+        history = History()
+        max_rounds = int(test.get("max_rounds", 2_000_000))
+        skip_chunk = max(int(10.0 / self.ms_per_round), 1)
+
+        r = 0
+        exhausted = False
+        while r < max_rounds:
+            ctx = {"time": self._time_ns(r), "free": sorted(free, key=str),
+                   "processes": processes}
+            inject_rows = []
+            while True:
+                res, gen = gen.op(ctx)
+                if res is None:
+                    exhausted = True
+                    break
+                exhausted = False
+                if res == g.PENDING:
+                    break
+                process = res["process"]
+                free.discard(process)
+                op = {k: v for k, v in res.items() if k != "time"}
+                history.append(Op(type="invoke", f=op.get("f"),
+                                  value=op.get("value"), process=process,
+                                  time=ctx["time"],
+                                  final=op.get("final", False)))
+                if process == g.NEMESIS:
+                    completed = nemesis.invoke(op)
+                    gen = self._complete(history, gen, ctx, process,
+                                         completed, free)
+                else:
+                    node_idx = process % N
+                    body = program.request_for_op(op)
+                    if body is HOST:
+                        completed = program.host_op(
+                            op, lambda i=node_idx: self._read_state(i),
+                            self.intern)
+                        gen = self._complete(history, gen, ctx, process,
+                                             completed, free)
+                    else:
+                        t, a, b, c = program.encode_body(body, self.intern)
+                        inject_rows.append((process, op, node_idx, t, a, b,
+                                            c))
+                ctx = {"time": self._time_ns(r),
+                       "free": sorted(free, key=str),
+                       "processes": processes}
+
+            if exhausted and not pending and free == set(processes):
+                break
+
+            # fast-forward quiescent stretches (nothing in flight, nothing
+            # to inject, program idle)
+            if (not inject_rows and not pending
+                    and self._pool_empty() and self._program_quiescent()):
+                self.sim = self._bump(self.sim, jnp.int32(skip_chunk))
+                r += skip_chunk
+                continue
+
+            inject = T.Msgs.empty(max(C, 1))
+            if inject_rows:
+                M = len(inject_rows)
+                proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
+                inject = inject.replace(
+                    valid=jnp.arange(max(C, 1)) < M,
+                    src=jnp.asarray(
+                        list(np.array(proc) + N) + [0] * (max(C, 1) - M),
+                        T.I32),
+                    dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
+                                     T.I32),
+                    type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
+                                     T.I32),
+                    a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M), T.I32),
+                    b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M), T.I32),
+                    c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M), T.I32))
+                base_mid = int(self.sim.net.next_mid)
+                for j, (p, o, ni, *_rest) in enumerate(inject_rows):
+                    pending[base_mid + j] = (p, o, ni,
+                                             r + self.timeout_rounds)
+
+            self.sim, client_msgs, io = self.round_fn(self.sim, inject)
+            self._state_cache = None
+            if self.journal is not None:
+                self._journal_round(io, client_msgs, r)
+            r += 1
+            ctx = {"time": self._time_ns(r), "free": sorted(free, key=str),
+                   "processes": processes}
+
+            cm = jax.device_get(client_msgs)
+            for i in np.nonzero(cm.valid)[0]:
+                rt = int(cm.reply_to[i])
+                entry = pending.pop(rt, None)
+                if entry is None:
+                    continue        # stale reply (client.clj:167-168)
+                process, op, node_idx, _dl = entry
+                body = program.decode_body(int(cm.type[i]), int(cm.a[i]),
+                                           int(cm.b[i]), int(cm.c[i]),
+                                           self.intern)
+                if body.get("type") == "error":
+                    err = ERROR_REGISTRY.get(body.get("code"))
+                    definite = err.definite if err else False
+                    completed = {**op,
+                                 "type": "fail" if definite else "info",
+                                 "error": [err.name if err
+                                           else body.get("code"),
+                                           body.get("text")]}
+                else:
+                    completed = program.completion(
+                        op, body, lambda i2=node_idx: self._read_state(i2),
+                        self.intern)
+                gen = self._complete(history, gen, ctx, process, completed,
+                                     free)
+
+            # timeouts -> indefinite :info (client.clj:214-233)
+            expired = [m for m, (_, _, _, dl) in pending.items() if dl <= r]
+            for m in expired:
+                process, op, _ni, _dl = pending.pop(m)
+                completed = {**op, "type": "info", "error": "net-timeout"}
+                gen = self._complete(history, gen, ctx, process, completed,
+                                     free)
+
+        if r >= max_rounds:
+            log.warning("TPU runner hit max_rounds=%d", max_rounds)
+        log.info("TPU run finished at virtual round %d (%.1f virtual s), "
+                 "%d history ops", r, r * self.ms_per_round / 1e3,
+                 len(history))
+        return history
+
+    def _journal_round(self, io, client_msgs, r: int):
+        """Materializes this round's device messages as journal rows
+        (the interactive-mode analogue of the send!/recv! hooks,
+        reference `net.clj:207,243`)."""
+        import numpy as np
+        inject_sent, outbox_sent, inbox = jax.device_get(io)
+        cm = jax.device_get(client_msgs)
+        t_ns = self._time_ns(r)
+        for batch, typ in ((inject_sent, "send"), (outbox_sent, "send"),
+                           (inbox, "recv"), (cm, "recv")):
+            valid = np.asarray(batch.valid).reshape(-1)
+            if not valid.any():
+                continue
+            mid = np.asarray(batch.mid).reshape(-1)[valid]
+            src = np.asarray(batch.src).reshape(-1)[valid]
+            dest = np.asarray(batch.dest).reshape(-1)[valid]
+            self.journal.log_batch(typ, mid, np.full(mid.shape, t_ns),
+                                   src, dest, node_names=self.node_names)
+
+    def _pool_empty(self) -> bool:
+        return not bool(self.sim.net.pool.valid.any())
+
+    def _program_quiescent(self) -> bool:
+        q = getattr(self.program, "quiescent", None)
+        if q is None:
+            return True
+        return bool(q(self.sim.nodes))
+
+
+def run_tpu_test(test: dict, test_dir: str) -> dict:
+    """Executes a full TPU-path test: run, check, store. The drop-in
+    equivalent of the bin path in `core.run` (reference jepsen.core/run!)."""
+    runner = TpuRunner(test)
+    test["store_dir"] = test_dir
+    # swap the host-net stats checker for the device-counter one
+    test["checker"].checkers["net"] = TpuNetStats(runner)
+    test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
+        else None
+
+    history = runner.run()
+    results = test["checker"].check(test, history, {})
+    if runner.journal is not None:
+        runner.journal.close()
+    store.write_history(test_dir, history)
+    store.write_results(test_dir, results)
+    from ..core import DEFAULTS
+    store.write_test(test_dir, {k: str(test[k]) for k in DEFAULTS
+                                if k in test})
+    log.info("Results valid? %s (store: %s)", results["valid"], test_dir)
+    return results

@@ -1,0 +1,80 @@
+"""Simulation composition: network + node program = one jitted round.
+
+The hot loop the reference spreads across OS processes, stdio pumps, and
+priority queues (SURVEY.md section 3.4) collapses here into a single
+compiled function:
+
+    inject client msgs -> deliver due msgs -> step all nodes -> send outboxes
+
+`make_round_fn` builds that function for interactive (round-per-dispatch,
+host clients in the loop) use; `make_run_fn` wraps it in `lax.scan` with a
+pre-scheduled injection plan so thousands of rounds run in one dispatch —
+the benchmark path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .net import tpu as T
+from .net.tpu import I32, Msgs, NetConfig, NetState
+
+
+@struct.dataclass
+class SimState:
+    net: NetState
+    nodes: object        # program state pytree, leading axis N
+    key: jnp.ndarray
+
+
+def make_sim(program, cfg: NetConfig, seed: int = 0) -> SimState:
+    return SimState(net=T.make_net(cfg), nodes=program.init_state(),
+                    key=jax.random.PRNGKey(seed))
+
+
+def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
+    """One simulation round. `inject` is a flat Msgs batch of client
+    requests (src = client index >= n_nodes). Returns
+    (sim', client_msgs, io) where io = (inject_sent, outbox_sent, inbox) —
+    id-stamped send views plus this round's deliveries, for journaling."""
+    N, O = cfg.n_nodes, program.outbox_cap
+    key, k1, k2, k3 = jax.random.split(sim.key, 4)
+    net, inject_sent = T._send(cfg, sim.net, inject, k1)
+    net, inbox, client_msgs = T._deliver(cfg, net)
+    nodes, outbox = program.step(sim.nodes, inbox,
+                                 {"round": net.round, "key": k2})
+    flat = jax.tree.map(lambda f: f.reshape((N * O,) + f.shape[2:]), outbox)
+    flat = flat.replace(src=jnp.repeat(jnp.arange(N, dtype=I32), O))
+    net, outbox_sent = T._send(cfg, net, flat, k3)
+    net = T.advance(net)
+    return (SimState(net=net, nodes=nodes, key=key), client_msgs,
+            (inject_sent, outbox_sent, inbox))
+
+
+def make_round_fn(program, cfg: NetConfig):
+    """Jitted interactive round: one XLA dispatch per simulated round."""
+    return jax.jit(partial(_round, program, cfg))
+
+
+def make_run_fn(program, cfg: NetConfig, collect_client_msgs: bool = False):
+    """Jitted multi-round run under lax.scan.
+
+    run_fn(sim, plan) -> (sim', per_round_client_counts [R] or Msgs [R, CC])
+    where `plan` is a Msgs batch [R, M] of pre-scheduled client injections
+    (the compiled-mode analogue of the generator: the whole workload is
+    scheduled up front, so R rounds execute without touching the host)."""
+
+    def body(sim, inject):
+        sim, client_msgs, _ = _round(program, cfg, sim, inject)
+        out = client_msgs if collect_client_msgs else client_msgs.count()
+        return sim, out
+
+    @jax.jit
+    def run_fn(sim: SimState, plan: Msgs):
+        return jax.lax.scan(body, sim, plan)
+
+    return run_fn

@@ -20,3 +20,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                                    ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+# This image's sitecustomize registers the tunneled TPU backend and
+# programmatically sets jax_platforms — the env var alone cannot win.
+# jax.config.update after import does: force genuinely-local CPU devices
+# (remote-TPU dispatch has ~100 ms round-trip latency, which would make
+# the lockstep runner unusably slow under pytest).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

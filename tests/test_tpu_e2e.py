@@ -1,0 +1,66 @@
+"""End-to-end tests for the TPU execution path: full tests (generators ->
+jitted simulation rounds -> history -> stock checkers) with built-in batched
+node programs, the analogue of the reference's `demo` self-test
+(`core.clj:93-111`)."""
+
+import pytest
+
+from maelstrom_tpu import core
+
+
+def run(opts):
+    base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=7,
+                rate=20.0, time_limit=2.0)
+    return core.run({**base, **opts})
+
+
+def test_echo_tpu_e2e():
+    res = run({"workload": "echo", "node": "tpu:echo", "node_count": 5})
+    assert res["valid"] is True
+    assert res["workload"]["valid"] is True
+    # every echo got a reply: client sends == client recvs, no server msgs
+    assert res["net"]["servers"]["send-count"] == 0
+    assert res["net"]["all"]["send-count"] > 0
+    assert res["stats"]["count"] > 10
+
+
+def test_broadcast_tpu_e2e_grid():
+    res = run({"workload": "broadcast", "node": "tpu:broadcast",
+               "node_count": 5, "topology": "grid"})
+    assert res["valid"] is True, res["workload"]
+    w = res["workload"]
+    assert w["valid"] is True
+    assert w["stable-count"] > 0 and w["lost-count"] == 0
+    # gossip happened between servers
+    assert res["net"]["servers"]["send-count"] > 0
+
+
+def test_broadcast_tpu_e2e_line_with_latency():
+    res = run({"workload": "broadcast", "node": "tpu:broadcast",
+               "node_count": 8, "topology": "line",
+               "latency": {"mean": 5, "dist": "constant"}})
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["lost-count"] == 0
+
+
+def test_broadcast_tpu_partition_recovery():
+    """Values broadcast during a partition must still become stable after
+    healing (retransmission), like the reference's retrying demo."""
+    res = run({"workload": "broadcast", "node": "tpu:broadcast",
+               "node_count": 5, "topology": "grid",
+               "nemesis": {"partition"}, "nemesis_interval": 0.5,
+               "time_limit": 3.0, "recovery_s": 2})
+    assert res["valid"] is True, res["workload"]
+    w = res["workload"]
+    assert w["lost-count"] == 0
+    assert w["stable-count"] > 0
+
+
+def test_broadcast_tpu_with_loss_is_lossless_to_checker():
+    """5% message loss: acks + retransmission keep the workload valid."""
+    res = run({"workload": "broadcast", "node": "tpu:broadcast",
+               "node_count": 5, "topology": "total", "p_loss": 0.05,
+               "time_limit": 2.0})
+    # p_loss wiring goes through the test opts
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["lost-count"] == 0

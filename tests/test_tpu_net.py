@@ -38,7 +38,7 @@ def test_send_deliver_roundtrip():
     cfg = T.NetConfig(n_nodes=3, n_clients=1, pool_cap=32, inbox_cap=4)
     net = T.make_net(cfg)
     key = jax.random.PRNGKey(0)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 7, 42), (2, 1, 7, 43)]), key)
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 7, 42), (2, 1, 7, 43)]), key)
     assert int(net.pool.count()) == 2
     net, inboxes, _ = pump(cfg, net, rounds=2)
     # zero latency config: due = round+1, delivered on round 1
@@ -57,8 +57,8 @@ def test_message_ids_unique_and_monotonic():
     cfg = T.NetConfig(n_nodes=2, pool_cap=16)
     net = T.make_net(cfg)
     k = jax.random.PRNGKey(0)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0), (1, 0, 1, 0)]), k)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0)]), k)
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0), (1, 0, 1, 0)]), k)
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 0)]), k)
     pool = jax.device_get(net.pool)
     mids = sorted(pool.mid[pool.valid].tolist())
     assert mids == [0, 1, 2]
@@ -69,7 +69,7 @@ def test_latency_rounds_delay_delivery():
     cfg = T.NetConfig(n_nodes=2, pool_cap=16, latency_mean_rounds=3,
                       latency_dist="constant")
     net = T.make_net(cfg)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 9)]), jax.random.PRNGKey(0))
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 9)]), jax.random.PRNGKey(0))
     net, inboxes, _ = pump(cfg, net, rounds=5)
     per_round = [ib.valid.sum() for ib in inboxes]
     # due = 0 + 1 + 3 = 4 -> delivered in round 4
@@ -82,7 +82,7 @@ def test_client_zero_latency_and_extraction():
     net = T.make_net(cfg)
     k = jax.random.PRNGKey(1)
     # client (index 2) -> node 0, and node 0 -> client: both bypass latency
-    net = T.send(cfg, net, mk(cfg, [(2, 0, 1, 1), (0, 2, 2, 2)]), k)
+    net, _ = T.send(cfg, net, mk(cfg, [(2, 0, 1, 1), (0, 2, 2, 2)]), k)
     net, inboxes, cmsgs = pump(cfg, net, rounds=2)
     assert inboxes[1].valid.sum() == 1          # client -> node arrived
     cb = cmsgs[1]
@@ -103,7 +103,7 @@ def test_earliest_due_wins_inbox_slots_backpressure():
                       dest=jnp.ones(6, T.I32),
                       type=jnp.ones(6, T.I32),
                       a=jnp.arange(6, dtype=T.I32))
-    net = T.send(cfg, net, out, jax.random.PRNGKey(0))
+    net, _ = T.send(cfg, net, out, jax.random.PRNGKey(0))
     # hand-tweak due rounds: msgs 4,5 due earliest
     pool = net.pool
     due = jnp.where(pool.valid & (pool.a >= 4), 1, 2)
@@ -129,7 +129,7 @@ def test_loss_at_send():
         valid=jnp.ones(M, bool), src=jnp.zeros(M, T.I32),
         dest=jnp.ones(M, T.I32), type=jnp.ones(M, T.I32),
         a=jnp.arange(M, dtype=T.I32))
-    net = T.send(cfg, net, out, jax.random.PRNGKey(7))
+    net, _ = T.send(cfg, net, out, jax.random.PRNGKey(7))
     st = T.stats_dict(net)
     assert st["sent_all"] == M                  # journal logs before loss
     assert 350 < st["lost"] < 650
@@ -147,7 +147,7 @@ def test_partition_consumes_messages():
             (2, 3, 1, 3),    # same side: delivered
             (4, 2, 1, 4),    # client -> node: partitions never block clients
             (2, 4, 2, 5)]    # node -> client: same
-    net = T.send(cfg, net, mk(cfg, msgs), k)
+    net, _ = T.send(cfg, net, mk(cfg, msgs), k)
     net, inboxes, cmsgs = pump(cfg, net, rounds=2)
     ib = inboxes[1]
     assert ib.a[1][ib.valid[1]].tolist() == [2]
@@ -167,7 +167,7 @@ def test_pool_overflow_counted():
     cfg = T.NetConfig(n_nodes=2, pool_cap=4)
     net = T.make_net(cfg)
     out = mk(cfg, [(0, 1, 1, i) for i in range(6)])
-    net = T.send(cfg, net, out, jax.random.PRNGKey(0))
+    net, _ = T.send(cfg, net, out, jax.random.PRNGKey(0))
     st = T.stats_dict(net)
     assert st["dropped_overflow"] == 2
     assert int(net.pool.count()) == 4
@@ -176,7 +176,7 @@ def test_pool_overflow_counted():
 def test_client_cap_zero_counts_without_materializing():
     cfg = T.NetConfig(n_nodes=2, n_clients=1, pool_cap=16, client_cap=0)
     net = T.make_net(cfg)
-    net = T.send(cfg, net, mk(cfg, [(0, 2, 1, 1), (0, 1, 1, 2)]),
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 2, 1, 1), (0, 1, 1, 2)]),
                  jax.random.PRNGKey(0))
     net, inboxes, cmsgs = pump(cfg, net, rounds=2)
     assert cmsgs[1].valid.shape == (0,)
@@ -191,11 +191,11 @@ def test_slow_fast_latency_scale():
                       latency_dist="constant")
     net = T.make_net(cfg)
     net = T.slow(net, 3.0)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 1)]), jax.random.PRNGKey(0))
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 1)]), jax.random.PRNGKey(0))
     pool = jax.device_get(net.pool)
     assert pool.due[pool.valid].tolist() == [7]     # 0 + 1 + 2*3
     net = T.fast(net)
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 2)]), jax.random.PRNGKey(1))
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 2)]), jax.random.PRNGKey(1))
     pool = jax.device_get(net.pool)
     assert sorted(pool.due[pool.valid].tolist()) == [3, 7]
 
@@ -210,7 +210,7 @@ def test_uniform_and_exponential_latency_distributions():
             valid=jnp.ones(M, bool), src=jnp.zeros(M, T.I32),
             dest=jnp.ones(M, T.I32), type=jnp.ones(M, T.I32),
             a=jnp.arange(M, dtype=T.I32))
-        net = T.send(cfg, net, out, jax.random.PRNGKey(3))
+        net, _ = T.send(cfg, net, out, jax.random.PRNGKey(3))
         pool = jax.device_get(net.pool)
         lat = pool.due[pool.valid] - 1
         assert lat.min() >= lo
@@ -232,11 +232,11 @@ def test_deliver_under_jit_and_scan():
         out = jax.tree.map(lambda f: f.reshape((-1,) + f.shape[2:]), inbox)
         out = out.replace(src=out.dest,
                           dest=(out.dest + 1) % cfg.n_nodes)
-        net = T.send(cfg, net, out, k)
+        net, _ = T.send(cfg, net, out, k)
         net = T.advance(net)
         return (net, key), inbox.count()
 
-    net = T.send(cfg, net, mk(cfg, [(0, 1, 1, 5)]), jax.random.PRNGKey(0))
+    net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 5)]), jax.random.PRNGKey(0))
 
     @jax.jit
     def run(net, key):
