@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Headline benchmark: simulated message throughput for the broadcast
+workload at 100k nodes on one chip (BASELINE.json north star: >= 1M
+simulated msgs/sec, converged under the broadcast semantics).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "msgs/sec", "vs_baseline": N/1e6, ...}
+
+Config via env: BENCH_NODES, BENCH_VALUES, BENCH_ROUNDS, BENCH_POOL.
+Runs on whatever JAX's default backend is (the real TPU under the driver);
+the whole R-round simulation executes as one lax.scan dispatch, so host
+latency does not pollute the measurement. The first call compiles (excluded
+from timing); the timed call reuses the cached executable on fresh state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# persist compiled executables across bench invocations
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.nodes.broadcast import T_BCAST
+    from maelstrom_tpu.sim import make_run_fn, make_sim
+
+    N = int(os.environ.get("BENCH_NODES", 100_000))
+    V = int(os.environ.get("BENCH_VALUES", 64))
+    R = int(os.environ.get("BENCH_ROUNDS", 1100))
+    # rounds per scan dispatch: long single dispatches (>~60 s device time)
+    # are killed by the remote-TPU tunnel, so the scan is chunked
+    chunk = int(os.environ.get("BENCH_CHUNK", 100))
+    pool_cap = int(os.environ.get("BENCH_POOL", 8192))
+    R = (R // chunk) * chunk
+
+    nodes = [f"n{i}" for i in range(N)]
+    program = get_program("broadcast",
+                          {"topology": "grid", "max_values": V,
+                           "gossip_per_neighbor": 4, "latency": {"mean": 0}},
+                          nodes)
+    cfg = T.NetConfig(n_nodes=N, n_clients=1, pool_cap=pool_cap,
+                      inbox_cap=program.inbox_cap, client_cap=0)
+    run_fn = make_run_fn(program, cfg)
+
+    # Injection plan: V broadcast values, one every other round, spread
+    # across the grid by a Fibonacci-hash stride.
+    rr = np.arange(R)
+    inj_round = (rr % 2 == 0) & (rr // 2 < V)
+    value = (rr // 2) % V
+    dest = (value.astype(np.int64) * 2654435761) % N
+    plan = T.Msgs.empty((R, 1)).replace(
+        valid=jnp.asarray(inj_round[:, None]),
+        src=jnp.full((R, 1), N, T.I32),
+        dest=jnp.asarray(dest.astype(np.int32)[:, None]),
+        type=jnp.full((R, 1), T_BCAST, T.I32),
+        a=jnp.asarray(value.astype(np.int32)[:, None]))
+    chunks = jax.tree.map(
+        lambda f: f.reshape((R // chunk, chunk) + f.shape[1:]), plan)
+
+    dev = jax.devices()[0]
+    print(f"bench: {N} nodes, {V} values, {R} rounds ({chunk}/dispatch), "
+          f"pool {pool_cap}, device {dev.device_kind}", file=sys.stderr)
+
+    def run(seed):
+        sim = make_sim(program, cfg, seed=seed)
+        for i in range(R // chunk):
+            sim, _counts = run_fn(
+                sim, jax.tree.map(lambda f: f[i], chunks))
+        # device_get forces actual remote completion; block_until_ready
+        # alone does not synchronize through the axon tunnel
+        assert int(jax.device_get(sim.net.round)) == R
+        return sim
+
+    t0 = time.perf_counter()
+    run(seed=0)
+    print(f"bench: compile+first run {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    sim2 = run(seed=1)
+    dt = time.perf_counter() - t0
+
+    st = T.stats_dict(sim2.net)
+    seen = np.asarray(jax.device_get(sim2.nodes["seen"][:, :V]))
+    converged = bool(seen.all())
+    msgs = st["recv_all"]
+    rate = msgs / dt
+
+    print(json.dumps({
+        "metric": "broadcast_sim_msgs_per_sec_100k_nodes"
+        if N == 100_000 else f"broadcast_sim_msgs_per_sec_{N}_nodes",
+        "value": round(rate, 1),
+        "unit": "msgs/sec",
+        "vs_baseline": round(rate / 1e6, 4),
+        "nodes": N, "values": V, "rounds": R,
+        "wall_s": round(dt, 3),
+        "messages_delivered": int(msgs),
+        "converged": converged,
+        "dropped_overflow": st["dropped_overflow"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

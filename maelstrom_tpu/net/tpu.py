@@ -178,18 +178,26 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     lost = new & (jax.random.uniform(k_loss, (M,)) < net.p_loss)
     keep = new & ~lost
 
+    # Free-slot allocation without a sort: rank free slots by prefix sum,
+    # build rank -> slot via a unique-index scatter, then each kept message
+    # takes the slot matching its own rank. O(P) instead of O(P log^2 P).
     free = ~pool.valid
     n_free = jnp.sum(free.astype(I32))
-    free_order = jnp.argsort(~free, stable=True)   # free slots first
+    free_rank = jnp.cumsum(free.astype(I32)) - 1     # rank of each free slot
+    P = cfg.pool_cap
+    slot_by_rank = jnp.zeros(P, I32).at[
+        jnp.where(free, free_rank, P)].set(
+            jnp.arange(P, dtype=I32), mode="drop", unique_indices=True)
     k_rank = jnp.cumsum(keep.astype(I32)) - 1
     ok = keep & (k_rank < n_free)
-    slot = free_order[jnp.clip(k_rank, 0, cfg.pool_cap - 1)]
+    slot = slot_by_rank[jnp.clip(k_rank, 0, P - 1)]
     # out-of-bounds index => dropped by scatter mode='drop'
-    tgt = jnp.where(ok, slot, cfg.pool_cap)
+    tgt = jnp.where(ok, slot, P)
 
     incoming = out.replace(valid=ok, mid=mid, due=due)
     pool = jax.tree.map(
-        lambda pf, nf: pf.at[tgt].set(nf, mode="drop"), pool, incoming)
+        lambda pf, nf: pf.at[tgt].set(nf, mode="drop", unique_indices=True),
+        pool, incoming)
     # journal view: every attempted send with its assigned id, including
     # messages the loss roll ate (the reference journals before the loss
     # check, net.clj:207,213)
@@ -227,12 +235,16 @@ def _deliver(cfg: NetConfig, net: NetState):
     to_node = due & ~blocked & (pool.dest < N)
     dropped = due & blocked
 
-    # --- node delivery: stable two-pass sort => (dest, due) order ---
-    perm1 = jnp.argsort(jnp.where(to_node, pool.due, INT32_MAX), stable=True)
-    dest_key = jnp.where(to_node, pool.dest, N)[perm1]
-    perm2 = jnp.argsort(dest_key, stable=True)
-    order = perm1[perm2]
-    sdest = dest_key[perm2]
+    # --- node delivery: one sort on a composite (dest, due-age) key ---
+    # due-age = how overdue a message is, clipped to 14 bits; earlier-due
+    # messages rank first within a dest. dest * 2^14 stays within int32 for
+    # n_nodes up to ~128k; larger clusters fall back to dest-only order.
+    age_bits = 14 if N < (1 << 17) else 0
+    age = jnp.clip(pool.due - net.round + (1 << (age_bits - 1))
+                   if age_bits else 0, 0, (1 << age_bits) - 1)
+    key = jnp.where(to_node, (pool.dest << age_bits) | age, INT32_MAX)
+    order = jnp.argsort(key)
+    sdest = jnp.where(to_node, pool.dest, N)[order]
     first = jnp.searchsorted(sdest, sdest, side="left")
     slot = jnp.arange(P, dtype=I32) - first.astype(I32)
     take = to_node[order] & (slot < K)
@@ -241,10 +253,11 @@ def _deliver(cfg: NetConfig, net: NetState):
     tgt_slot = jnp.clip(slot, 0, K - 1)
     sorted_msgs = pool.at_rows(order)
     inbox = jax.tree.map(
-        lambda z, f: z.at[tgt_dest, tgt_slot].set(f, mode="drop"),
+        lambda z, f: z.at[tgt_dest, tgt_slot].set(f, mode="drop",
+                                                  unique_indices=True),
         Msgs.empty((N, K)), sorted_msgs.replace(valid=take))
 
-    taken = jnp.zeros(P, bool).at[order].set(take)
+    taken = jnp.zeros(P, bool).at[order].set(take, unique_indices=True)
 
     # --- client delivery: due-ordered, first client_cap extracted ---
     CC = min(cfg.client_cap, P)

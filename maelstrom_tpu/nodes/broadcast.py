@@ -1,25 +1,36 @@
 """Batched broadcast node: partition-tolerant gossip over a topology.
 
 The TPU-native analogue of the reference's retrying broadcast demo
-(`demo/ruby/broadcast.rb` serving `workload/broadcast.clj`): each node keeps
-a `seen` set; new values are forwarded to every neighbor except the sender
-(the skip-sender optimization, reference `doc/03-broadcast/02-performance.md:73-76`),
-acknowledged on receipt, and retransmitted until acknowledged so values
-survive partitions and message loss.
+(`demo/ruby/broadcast.rb` serving `workload/broadcast.clj`), built on the
+static edge-channel fast path (`net/static.py`): gossip and acknowledgements
+move over fixed neighbor edges as pure gathers; only client RPCs touch the
+general flight pool.
 
-All N nodes' sets live in three bit-plane arrays:
+Protocol (per round, all N nodes at once):
+  - new values (from clients or arriving gossip) are marked `seen` and
+    queued `pending` toward every neighbor except the edge they arrived on
+    (the skip-sender optimization,
+    reference `doc/03-broadcast/02-performance.md:73-76`)
+  - each edge sends up to `gossip_per_neighbor` pending values per round,
+    rotating the selection window so a slow acknowledgement round-trip
+    cannot starve newer values
+  - acknowledgement is a *seen-digest*: a 64-bit window of the sender's
+    `seen` bitmap, owed on an edge whenever gossip arrives on it (one owed
+    window is paid per edge per round). Receiving a digest clears
+    `pending`/`inflight` for every covered value the neighbor already has.
+    Digests are idempotent, so loss and partitions only delay convergence:
+    unacknowledged values are requeued by a two-generation retry tick and
+    retransmitted, which re-triggers the digest owing — the gossip
+    analogue of the reference demo's retry-until-ack loop, with no
+    per-message timer state.
 
-  seen     [N, V]     value v is in node n's set
-  pending  [N, D, V]  v must be sent to neighbor d (not yet sent / requeued)
-  inflight [N, D, V]  v was sent to d, awaiting gossip_ok
+State per node: `seen` [V] and per-edge `pending` [D, V] bit-planes; one
+step is elementwise mask algebra plus a per-edge top_k — no scatters, no
+sorts (XLA:TPU serializes colliding scatters; see net/static.py).
 
-One step is a handful of masked scatters over these planes plus a top_k
-per (node, neighbor) to pick the next gossip batch — no per-node control
-flow, so the whole cluster advances in one XLA dispatch.
-
-Reads reply with a bare `read_ok` on the wire; the set itself (unbounded,
-doesn't fit a fixed body) is materialized host-side from the `seen` row at
-completion time (see `maelstrom_tpu.nodes` docstring)."""
+Reads reply with a bare `read_ok` on the wire; the set itself is
+materialized host-side from the `seen` row at completion time (see
+`maelstrom_tpu.nodes` docstring)."""
 
 from __future__ import annotations
 
@@ -27,7 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..net.tpu import I32, Msgs
+from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
+from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
 from . import NodeProgram, register
 
@@ -35,129 +47,202 @@ T_BCAST = 10      # client -> node: a = value index
 T_BCAST_OK = 11
 T_READ = 12
 T_READ_OK = 13    # bare ack; value materialized host-side
-T_GOSSIP = 14     # node -> node: a = value index
-T_GOSSIP_OK = 15  # ack: a = value index
+T_GOSSIP = 14     # edge: a = value index
+T_DIGEST = 15     # edge: a = window, b|c = 64-bit seen bits of that window
 
 
 @register
 class BroadcastProgram(NodeProgram):
     name = "broadcast"
     needs_state_reads = True
+    is_edge = True
+    # ring overwrites under randomized latency are tolerated: every value
+    # retransmits until a digest proves delivery
+    tolerates_channel_overwrites = True
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
         topo = TOPOLOGIES[opts.get("topology", "grid")](nodes)
-        self.neighbors = jnp.asarray(
-            topology_indices(topo, nodes))            # [N, D]
-        self.D = self.neighbors.shape[1]
+        nb = topology_indices(topo, nodes)
+        self.neighbors = jnp.asarray(nb)              # [N, D]
+        self.rev = jnp.asarray(reverse_index(nb))
+        self.D = int(self.neighbors.shape[1])
         self.V = int(opts.get("max_values", 1024))
+        self.n_windows = (self.V + 63) // 64
+        self.Vp = self.n_windows * 64                 # padded bitmap width
         self.per_nb = int(opts.get("gossip_per_neighbor", 4))
+        self.lanes = self.per_nb + 1                  # +1 digest lane
         lat = (opts.get("latency") or {}).get("mean", 0)
         ms_per_round = opts.get("ms_per_round", 1.0)
-        # retransmit after a round-trip (2 hops) plus slack
-        self.retry_rounds = max(int(4 * lat / ms_per_round), 10)
-        self.inbox_cap = int(opts.get("inbox_cap", 2 * self.D + 4))
-        self.outbox_cap = self.inbox_cap + self.D * self.per_nb
+        lat_rounds = int(np.ceil(lat / ms_per_round))
+        dist = (opts.get("latency") or {}).get("dist", "constant")
+        slack = 1 if dist == "constant" else 8        # randomized draws
+        # headroom for the slow! fault (x10 latency): affordable for
+        # interactive cluster sizes; huge clusters cap the ring and clipped
+        # draws are counted (EdgeChannels.lat_clipped) instead
+        scale_headroom = int(opts.get("max_latency_scale",
+                                      10 if len(nodes) <= 4096 else 1))
+        self.ring = max(2, lat_rounds * slack * scale_headroom + 2)
+        # requeue period: a digest for any window returns within the
+        # round-trip plus one full window rotation
+        self.retry_rounds = max(2 * (lat_rounds + 1) + self.n_windows + 4,
+                                10)
+        self.inbox_cap = int(opts.get("inbox_cap", 4))   # client RPCs only
+        self.outbox_cap = self.inbox_cap
+        self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
+                                   lanes=self.lanes, ring=self.ring)
 
     def init_state(self):
         N, D, V = self.n_nodes, self.D, self.V
         return {"seen": jnp.zeros((N, V), bool),
                 "pending": jnp.zeros((N, D, V), bool),
+                # two inflight generations: young -> old -> requeued at
+                # successive retry ticks, so no value is retransmitted
+                # before a digest has had a full period to arrive
                 "inflight": jnp.zeros((N, D, V), bool),
-                "next_retry": jnp.zeros((N, D), I32)}
+                "inflight_old": jnp.zeros((N, D, V), bool),
+                # digest windows owed per edge (set by gossip arrivals)
+                "owed": jnp.zeros((N, D, self.n_windows), bool)}
 
-    def step(self, state, inbox, ctx):
-        N, K, D, V = self.n_nodes, self.inbox_cap, self.D, self.V
-        nb = self.neighbors
+    def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        """(state, edge_in [N,D,L], client_in Msgs [N,K]) ->
+        (state', edge_out [N,D,L], client_out Msgs [N,K])."""
+        N, D, V, L = self.n_nodes, self.D, self.V, self.lanes
         seen, pending = state["seen"], state["pending"]
-        inflight, next_retry = state["inflight"], state["next_retry"]
+        inflight = state["inflight"]
+        vee = jnp.arange(V, dtype=I32)
+        edge_ok = self.neighbors >= 0                       # [N, D]
 
-        rows = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None], (N, K))
-        v = jnp.clip(inbox.a, 0, V - 1)
-        is_gossip = inbox.valid & (inbox.type == T_GOSSIP)
-        is_cb = inbox.valid & (inbox.type == T_BCAST)
-        is_ack = inbox.valid & (inbox.type == T_GOSSIP_OK)
-        is_read = inbox.valid & (inbox.type == T_READ)
-        carrier = is_gossip | is_cb
+        # --- gossip arrivals -> arrived[n, d, v] ---
+        g_in = edge_in.valid & (edge_in.type == T_GOSSIP)   # [N, D, L]
+        gv = jnp.clip(edge_in.a, 0, V - 1)
+        arrived = jnp.zeros((N, D, V), bool)
+        for l in range(L):
+            arrived |= (g_in[:, :, l, None]
+                        & (gv[:, :, l, None] == vee))
 
-        new = carrier & ~seen[rows, v]
-        seen = seen.at[jnp.where(carrier, rows, N), v].set(True, mode="drop")
+        # --- client broadcasts -> cb[n, v] ---
+        K = client_in.valid.shape[1]
+        is_cb = client_in.valid & (client_in.type == T_BCAST)
+        is_read = client_in.valid & (client_in.type == T_READ)
+        cv = jnp.clip(client_in.a, 0, V - 1)
+        cb = jnp.zeros((N, V), bool)
+        for k in range(K):
+            cb |= is_cb[:, k, None] & (cv[:, k, None] == vee)
 
-        # [N, K, D] slot-neighbor masks
-        nb_valid = nb >= 0
-        src_is_nb = nb[:, None, :] == inbox.src[:, :, None]
-        n3 = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None, None],
-                              (N, K, D))
-        d3 = jnp.broadcast_to(jnp.arange(D, dtype=I32)[None, None, :],
-                              (N, K, D))
-        v3 = jnp.broadcast_to(v[:, :, None], (N, K, D))
+        new = (arrived.any(axis=1) | cb) & ~seen            # [N, V]
+        seen = seen | arrived.any(axis=1) | cb
 
-        # forward new values to all neighbors except the sender
-        add = new[:, :, None] & nb_valid[:, None, :] & ~src_is_nb
-        pend_add = jnp.zeros((N, D, V), bool).at[
-            jnp.where(add, n3, N), d3, v3].set(True, mode="drop")
-        # the sender evidently has the value: stop sending it to them
-        clear = (is_gossip | is_ack)[:, :, None] & src_is_nb
-        pend_clear = jnp.zeros((N, D, V), bool).at[
-            jnp.where(clear, n3, N), d3, v3].set(True, mode="drop")
+        # --- digests clear pending for values the neighbor has ---
+        d_in = edge_in.valid & (edge_in.type == T_DIGEST)
+        has_digest = d_in.any(axis=2)                       # [N, D]
+        # lane content reduced over lanes (digest occupies one lane)
+        def lane_pick(field):
+            out = jnp.zeros((N, D), I32)
+            for l in range(L):
+                out = jnp.where(d_in[:, :, l], field[:, :, l], out)
+            return out
+        w_in = lane_pick(edge_in.a)
+        b_in, c_in = lane_pick(edge_in.b), lane_pick(edge_in.c)
+        j = vee - w_in[:, :, None] * 64                     # [N, D, V]
+        in_window = (j >= 0) & (j < 64)
+        bit = jnp.where(
+            j < 32,
+            (b_in[:, :, None] >> jnp.clip(j, 0, 31)) & 1,
+            (c_in[:, :, None] >> jnp.clip(j - 32, 0, 31)) & 1)
+        neighbor_has = (has_digest[:, :, None] & in_window & (bit == 1))
 
-        pending = (pending | pend_add) & ~pend_clear
-        inflight = inflight & ~pend_clear
+        # queue new values everywhere except their arrival edge; drop
+        # pending/inflight the moment we know the neighbor has the value.
+        # A value is sent once (pending -> inflight) and retransmitted by
+        # the periodic global requeue below until a digest proves delivery
+        # — send-once-plus-retry, like the reference demo's retry loop,
+        # with digest idempotence instead of per-message timers.
+        known = arrived | neighbor_has
+        inflight_old = state["inflight_old"]
+        requeue = (ctx["round"] % self.retry_rounds) == 0
+        pending = ((pending | (new[:, None, :] & edge_ok[:, :, None])
+                    | (inflight_old & requeue))
+                   & ~known)
+        inflight_old = jnp.where(requeue, inflight, inflight_old) & ~known
+        inflight = inflight & ~known & ~requeue
 
-        # retransmit timer: requeue unacked sends. The timer tracks the
-        # OLDEST outstanding send (armed only when inflight was empty), so
-        # a steady stream of new sends can't starve a lost message of its
-        # retransmission.
-        requeue = ctx["round"] >= next_retry
-        pending = pending | (inflight & requeue[:, :, None])
-        inflight = inflight & ~requeue[:, :, None]
-        had_inflight = inflight.any(axis=2)             # [N, D]
-
-        # pick up to per_nb lowest-index pending values per neighbor
-        prio = jnp.where(pending,
-                         V - jnp.arange(V, dtype=I32)[None, None, :], 0)
-        topv, topi = jax.lax.top_k(prio, self.per_nb)   # [N, D, per_nb]
+        # --- pick gossip to send: rotating top_k per edge ---
+        rot = (vee - ctx["round"] * self.per_nb) % V
+        prio = jnp.where(pending, V - rot, 0)
+        topv, topi = jax.lax.top_k(prio, self.per_nb)       # [N, D, per_nb]
         sel = topv > 0
-        ns = jnp.broadcast_to(jnp.arange(N, dtype=I32)[:, None, None],
-                              sel.shape)
-        ds = jnp.broadcast_to(jnp.arange(D, dtype=I32)[None, :, None],
-                              sel.shape)
-        sent = jnp.zeros((N, D, V), bool).at[
-            jnp.where(sel, ns, N), ds, topi].set(True, mode="drop")
+        sent = jnp.zeros((N, D, V), bool)
+        for j in range(self.per_nb):
+            sent |= sel[:, :, j, None] & (topi[:, :, j, None]
+                                          == jnp.arange(V, dtype=I32))
         pending = pending & ~sent
         inflight = inflight | sent
-        arm = sel.any(axis=2) & ~had_inflight
-        next_retry = jnp.where(arm, ctx["round"] + self.retry_rounds,
-                               next_retry)
 
-        # outbox: replies to this round's inbox + gossip batch
-        reply_type = jnp.where(
-            is_gossip, T_GOSSIP_OK,
-            jnp.where(is_cb, T_BCAST_OK,
-                      jnp.where(is_read, T_READ_OK, 0)))
-        replies = inbox.replace(
-            valid=is_gossip | is_cb | is_read,
-            dest=inbox.src, reply_to=inbox.mid, type=reply_type,
-            a=jnp.where(is_gossip, inbox.a, 0))
+        # --- digest scheduling: ack exactly the windows gossip arrived in,
+        # one owed window per edge per round ---
+        W = self.n_windows
+        owed = state["owed"]
+        arrived_pad = jnp.pad(arrived, ((0, 0), (0, 0), (0, self.Vp - V)))
+        owed = owed | arrived_pad.reshape(N, D, W, 64).any(axis=3)
+        have_owed = owed.any(axis=2)                        # [N, D]
+        www = jnp.arange(W, dtype=I32)
+        w_send = jnp.argmax(owed.astype(I32) * (W - www), axis=2)  # [N, D]
+        owed = owed & ~(have_owed[:, :, None] & (w_send[:, :, None] == www))
 
-        G = D * self.per_nb
-        gossip = Msgs.empty((N, G)).replace(
-            valid=sel.reshape(N, G) & (jnp.repeat(nb, self.per_nb, axis=1)
-                                       >= 0),
-            dest=jnp.repeat(nb, self.per_nb, axis=1),
-            type=jnp.full((N, G), T_GOSSIP, I32),
-            a=topi.reshape(N, G))
+        # digest payload: 64 seen-bits of each edge's owed window. Words
+        # are packed once per node per window, then selected per edge with
+        # an unrolled compare — a dynamic [N, D, 64] gather here serializes
+        # on TPU (~300 ms/round at 100k nodes).
+        seen_pad = jnp.pad(seen, ((0, 0), (0, self.Vp - V)))
+        wins = seen_pad.reshape(N, W, 64)
+        words_b = jnp.zeros((N, W), I32)
+        words_c = jnp.zeros((N, W), I32)
+        for jj in range(32):
+            words_b |= wins[:, :, jj].astype(I32) << jj
+            words_c |= wins[:, :, 32 + jj].astype(I32) << jj
+        b_out = jnp.zeros((N, D), I32)
+        c_out = jnp.zeros((N, D), I32)
+        for w in range(W):
+            m = w_send == w
+            b_out = jnp.where(m, words_b[:, w][:, None], b_out)
+            c_out = jnp.where(m, words_c[:, w][:, None], c_out)
 
-        outbox = jax.tree.map(
-            lambda r, g: jnp.concatenate([r, g], axis=1), replies, gossip)
-        state = {"seen": seen, "pending": pending, "inflight": inflight,
-                 "next_retry": next_retry}
-        return state, outbox
+        # --- assemble edge output: digest lane 0, gossip lanes 1.. ---
+        send_digest = have_owed & edge_ok
+        e_valid = jnp.concatenate(
+            [send_digest[:, :, None], sel & edge_ok[:, :, None]], axis=2)
+        e_type = jnp.concatenate(
+            [jnp.full((N, D, 1), T_DIGEST, I32),
+             jnp.full((N, D, self.per_nb), T_GOSSIP, I32)], axis=2)
+        e_a = jnp.concatenate([w_send[:, :, None], topi.astype(I32)],
+                              axis=2)
+        e_b = jnp.concatenate(
+            [b_out[:, :, None], jnp.zeros((N, D, self.per_nb), I32)],
+            axis=2)
+        e_c = jnp.concatenate(
+            [c_out[:, :, None], jnp.zeros((N, D, self.per_nb), I32)],
+            axis=2)
+        edge_out = EdgeMsgs(valid=e_valid, type=e_type, a=e_a, b=e_b,
+                            c=e_c)
+
+        # --- client replies ---
+        reply_type = jnp.where(is_cb, T_BCAST_OK,
+                               jnp.where(is_read, T_READ_OK, 0))
+        client_out = client_in.replace(
+            valid=is_cb | is_read, dest=client_in.src,
+            reply_to=client_in.mid, type=reply_type,
+            a=jnp.zeros_like(client_in.a))
+
+        return ({"seen": seen, "pending": pending, "inflight": inflight,
+                 "inflight_old": inflight_old, "owed": owed},
+                edge_out, client_out)
 
     def quiescent(self, state):
-        """True when no gossip or retransmission is outstanding — lets the
-        runner fast-forward idle virtual time."""
-        return ~(state["pending"].any() | state["inflight"].any())
+        """True when no value is awaiting digest confirmation (edge
+        channels are checked separately by the runner)."""
+        return ~(state["pending"].any() | state["inflight"].any()
+                 | state["inflight_old"].any())
 
     # --- host boundary ---
 

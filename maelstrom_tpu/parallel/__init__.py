@@ -1,0 +1,91 @@
+"""Scale-out: cluster-axis vmap and device-mesh sharding.
+
+The reference runs one JVM simulation at a time (SURVEY.md section 2.4);
+the TPU framework scales along two axes instead:
+
+  - **cluster axis (dp)**: many independent simulated clusters advance in
+    lockstep under `vmap` — the "10k independent 5-node raft clusters"
+    configuration in BASELINE.json. Pure data parallelism: no cross-cluster
+    communication ever.
+  - **node axis (sp)**: one big cluster's node/pool arrays sharded across
+    chips, the sequence-parallel analogue (SURVEY.md section 5.7-5.8).
+    Cross-shard message delivery rides XLA-inserted collectives (GSPMD):
+    the round function is jitted with NamedShardings and the compiler
+    partitions the scatter/sort/gather plumbing over ICI.
+
+`mesh_for` builds the ("dp", "sp") mesh; `sim_shardings` annotates a
+(batched) SimState pytree; `make_cluster_*` build the vmapped entry points.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..net import tpu as T
+from ..sim import SimState, _round, make_sim
+
+
+def mesh_for(n_devices: int | None = None, dp: int | None = None) -> Mesh:
+    """A ("dp", "sp") mesh over the first n_devices. dp defaults to the
+    largest power-of-two divisor <= sqrt(n)."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devs)
+    if dp is None:
+        dp = 1
+        while dp * 2 * dp * 2 <= n and n % (dp * 2) == 0:
+            dp *= 2
+    sp = n // dp
+    assert dp * sp == n, (dp, sp, n)
+    return Mesh(np.asarray(devs).reshape(dp, sp), ("dp", "sp"))
+
+
+def _spec_for(arr, mesh: Mesh, batched: bool) -> P:
+    """Shard the cluster axis over dp and the first big per-cluster axis
+    over sp (when divisible); everything else replicated."""
+    sp = mesh.shape["sp"]
+    dims: list = []
+    start = 0
+    if batched:
+        dims.append("dp")
+        start = 1
+    if (arr.ndim > start and arr.shape[start] >= sp
+            and arr.shape[start] % sp == 0):
+        dims.append("sp")
+    return P(*dims)
+
+
+def sim_shardings(mesh: Mesh, tree, batched: bool = True):
+    """NamedSharding pytree for a (cluster-batched) SimState / Msgs tree."""
+    return jax.tree.map(
+        lambda a: NamedSharding(mesh, _spec_for(a, mesh, batched)), tree)
+
+
+def make_cluster_sims(program, cfg: T.NetConfig, n_clusters: int,
+                      seed: int = 0) -> SimState:
+    """A batch of independent cluster simulations: every array gains a
+    leading cluster axis; PRNG keys differ per cluster."""
+    base = make_sim(program, cfg, seed=seed)
+    batched = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_clusters,) + a.shape), base)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_clusters)
+    return batched.replace(key=keys)
+
+
+def make_cluster_round_fn(program, cfg: T.NetConfig, mesh: Mesh | None = None,
+                          example: SimState | None = None,
+                          example_inject=None):
+    """Jitted vmapped round over the cluster axis; with a mesh, the inputs
+    and outputs are sharded (dp = clusters, sp = node/pool axis) and GSPMD
+    partitions the round body across chips."""
+    f = jax.vmap(partial(_round, program, cfg))
+    if mesh is None:
+        return jax.jit(f)
+    assert example is not None and example_inject is not None
+    in_sh = (sim_shardings(mesh, example), sim_shardings(mesh,
+                                                         example_inject))
+    return jax.jit(f, in_shardings=in_sh)

@@ -135,6 +135,12 @@ class TpuNetStats(Checker):
         out["lost"] = c["lost"]
         out["dropped-partition"] = c["dropped_partition"]
         out["dropped-overflow"] = c["dropped_overflow"]
+        ch = self.runner.sim.channels
+        overwrites = 0
+        if ch is not None:
+            overwrites = int(jax.device_get(ch.overwrites))
+            out["channel-overwrites"] = overwrites
+            out["latency-clipped"] = int(jax.device_get(ch.lat_clipped))
         journal = self.runner.journal
         store_dir = test.get("store_dir")
         if journal is not None and store_dir:
@@ -145,8 +151,15 @@ class TpuNetStats(Checker):
                                                    "messages.svg"))
             except Exception as e:  # viz must never fail the test
                 out["viz-error"] = repr(e)
-        # a pool overflow silently destroys messages: invalidate the run
-        out["valid"] = True if c["dropped_overflow"] == 0 else False
+        # silently destroyed messages invalidate the run: pool overflow
+        # always; ring overwrites are a bounded-channel drop of the same
+        # class (legal only if a workload opts in)
+        tolerated = (test.get("allow_channel_overwrites")
+                     or getattr(self.runner.program,
+                                "tolerates_channel_overwrites", False))
+        ok = (c["dropped_overflow"] == 0
+              and (overwrites == 0 or tolerated))
+        out["valid"] = bool(ok)
         return out
 
 
@@ -279,7 +292,8 @@ class TpuRunner:
             # fast-forward quiescent stretches (nothing in flight, nothing
             # to inject, program idle)
             if (not inject_rows and not pending
-                    and self._pool_empty() and self._program_quiescent()):
+                    and self._pool_empty() and self._channels_empty()
+                    and self._program_quiescent()):
                 self.sim = self._bump(self.sim, jnp.int32(skip_chunk))
                 r += skip_chunk
                 continue
@@ -374,6 +388,12 @@ class TpuRunner:
 
     def _pool_empty(self) -> bool:
         return not bool(self.sim.net.pool.valid.any())
+
+    def _channels_empty(self) -> bool:
+        """Edge rings must drain before virtual time may skip ahead
+        (ring cells are addressed by round % ring)."""
+        ch = self.sim.channels
+        return ch is None or not bool(ch.valid.any())
 
     def _program_quiescent(self) -> bool:
         q = getattr(self.program, "quiescent", None)
