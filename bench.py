@@ -45,7 +45,7 @@ def main():
     # are killed by the remote-TPU tunnel, so the scan is chunked
     chunk = int(os.environ.get("BENCH_CHUNK", 100))
     pool_cap = int(os.environ.get("BENCH_POOL", 8192))
-    R = (R // chunk) * chunk
+    R = max(chunk, (R // chunk) * chunk)   # at least one chunk
 
     nodes = [f"n{i}" for i in range(N)]
     program = get_program("broadcast",

@@ -114,6 +114,26 @@ class NodeProgram:
         raise NotImplementedError
 
 
+def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
+    """Shared edge-channel sizing: (ring, retry_rounds, lat_rounds).
+
+    The ring must cover the worst latency draw (randomized dists get 8x
+    slack, clipped draws are counted) plus headroom for the slow! fault
+    (x10) on clusters small enough to afford the memory; the retry tick
+    must exceed a full acknowledgement round trip."""
+    import math
+    lat = (opts.get("latency") or {}).get("mean", 0)
+    ms_per_round = opts.get("ms_per_round", 1.0)
+    lat_rounds = int(math.ceil(lat / ms_per_round))
+    dist = (opts.get("latency") or {}).get("dist", "constant")
+    slack = 1 if dist == "constant" else 8
+    scale_headroom = int(opts.get("max_latency_scale",
+                                  10 if n_nodes <= 4096 else 1))
+    ring = max(2, lat_rounds * slack * scale_headroom + 2)
+    retry_rounds = max(2 * (lat_rounds + 1) + 4, 10)
+    return ring, retry_rounds, lat_rounds
+
+
 PROGRAMS: dict[str, Callable] = {}
 
 
@@ -124,7 +144,7 @@ def register(cls):
 
 def get_program(name: str, opts: dict, nodes: list[str]) -> NodeProgram:
     # import for side effect: program registration
-    from . import echo, broadcast  # noqa: F401
+    from . import echo, broadcast, gset, pn_counter  # noqa: F401
     if name not in PROGRAMS:
         raise ValueError(f"no built-in TPU node program {name!r}; "
                          f"have {sorted(PROGRAMS)}")

@@ -41,7 +41,7 @@ import numpy as np
 from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
 from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from . import NodeProgram, register
+from . import NodeProgram, edge_timing, register
 
 T_BCAST = 10      # client -> node: a = value index
 T_BCAST_OK = 11
@@ -62,7 +62,8 @@ class BroadcastProgram(NodeProgram):
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
-        topo = TOPOLOGIES[opts.get("topology", "grid")](nodes)
+        topo = (opts.get("topology_map")
+                or TOPOLOGIES[opts.get("topology", "grid")](nodes))
         nb = topology_indices(topo, nodes)
         self.neighbors = jnp.asarray(nb)              # [N, D]
         self.rev = jnp.asarray(reverse_index(nb))
@@ -70,23 +71,12 @@ class BroadcastProgram(NodeProgram):
         self.V = int(opts.get("max_values", 1024))
         self.n_windows = (self.V + 63) // 64
         self.Vp = self.n_windows * 64                 # padded bitmap width
-        self.per_nb = int(opts.get("gossip_per_neighbor", 4))
+        self.per_nb = min(int(opts.get("gossip_per_neighbor", 4)), self.V)
         self.lanes = self.per_nb + 1                  # +1 digest lane
-        lat = (opts.get("latency") or {}).get("mean", 0)
-        ms_per_round = opts.get("ms_per_round", 1.0)
-        lat_rounds = int(np.ceil(lat / ms_per_round))
-        dist = (opts.get("latency") or {}).get("dist", "constant")
-        slack = 1 if dist == "constant" else 8        # randomized draws
-        # headroom for the slow! fault (x10 latency): affordable for
-        # interactive cluster sizes; huge clusters cap the ring and clipped
-        # draws are counted (EdgeChannels.lat_clipped) instead
-        scale_headroom = int(opts.get("max_latency_scale",
-                                      10 if len(nodes) <= 4096 else 1))
-        self.ring = max(2, lat_rounds * slack * scale_headroom + 2)
-        # requeue period: a digest for any window returns within the
-        # round-trip plus one full window rotation
-        self.retry_rounds = max(2 * (lat_rounds + 1) + self.n_windows + 4,
-                                10)
+        self.ring, retry, _lat = edge_timing(opts, len(nodes))
+        # a digest for any window returns within the round-trip plus one
+        # full window rotation
+        self.retry_rounds = retry + self.n_windows
         self.inbox_cap = int(opts.get("inbox_cap", 4))   # client RPCs only
         self.outbox_cap = self.inbox_cap
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,

@@ -1,0 +1,83 @@
+"""Batched grow-only set node (serving `workload/g_set.clj`): the broadcast
+gossip machine with the g-set RPC surface.
+
+A g-set IS broadcast state — a monotone set replicated by gossip — so this
+reuses `BroadcastProgram`'s edge-channel protocol (pending/digest/retry)
+wholesale, like the reference's generic CRDT server serves g-set
+(`demo/ruby/crdt.rb`). Differences are at the boundaries:
+
+  - RPCs are `add`/`add_ok` and `read`/`read_ok` with an `elements` set
+  - default gossip graph: fully connected for small clusters (the
+    reference demo gossips to all peers, `demo/ruby/crdt.rb`), or a fixed
+    random `gossip_fanout`-regular graph for large ones (the BASELINE
+    "1k nodes, gossip fanout 3" configuration) — static topology keeps
+    delivery a precomputed gather."""
+
+from __future__ import annotations
+
+import random
+
+from .broadcast import (BroadcastProgram, T_BCAST, T_BCAST_OK, T_READ,
+                        T_READ_OK)
+from . import register
+
+
+def fanout_topology(nodes, k: int, seed: int = 0):
+    """A fixed random symmetric graph with ~k links per node (degree in
+    [k, 2k] after symmetrization); connected via a Hamiltonian backbone."""
+    rng = random.Random(seed)
+    n = len(nodes)
+    k = min(k, n - 1)           # a node has at most n-1 distinct neighbors
+    order = list(range(n))
+    rng.shuffle(order)
+    adj = {i: set() for i in range(n)}
+    for i in range(n):                       # ring backbone: connectivity
+        a, b = order[i], order[(i + 1) % n]
+        if a != b:
+            adj[a].add(b)
+            adj[b].add(a)
+    for i in range(n):
+        while len(adj[i]) < k and n > 1:
+            j = rng.randrange(n)
+            if j != i:
+                adj[i].add(j)
+                adj[j].add(i)
+    return {nodes[i]: [nodes[j] for j in sorted(adj[i])] for i in range(n)}
+
+
+@register
+class GSetProgram(BroadcastProgram):
+    name = "g-set"
+
+    def __init__(self, opts, nodes):
+        opts = dict(opts)
+        fan = opts.get("gossip_fanout")
+        if fan:
+            opts["topology_map"] = fanout_topology(nodes, int(fan),
+                                                   opts.get("seed", 0))
+        else:
+            opts.setdefault("topology", "total")
+        super().__init__(opts, nodes)
+
+    # --- host boundary (RPC surface per workload/g_set.clj) ---
+
+    def request_for_op(self, op):
+        if op["f"] == "add":
+            return {"type": "add", "element": op["value"]}
+        return {"type": "read"}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "add":
+            i = intern.id(body["element"])
+            if i >= self.V:
+                raise ValueError(f"g-set value table full ({self.V}); "
+                                 f"raise --max-values")
+            return (T_BCAST, i, 0, 0)
+        return (T_READ, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_BCAST_OK:
+            return {"type": "add_ok"}
+        if t == T_READ_OK:
+            return {"type": "read_ok"}
+        return super(BroadcastProgram, self).decode_body(t, a, b, c, intern)

@@ -1,0 +1,213 @@
+"""Batched PN-counter node (serving `workload/pn_counter.clj` and, through
+the non-negative generator, `workload/g_counter.clj`).
+
+CRDT design, like the reference's gossip counter demo
+(`demo/ruby/pn_counter.rb`): each node is an *origin*; state is a pair of
+per-origin contribution vectors `pos`/`neg` `[N, M]` (M = n_nodes origins)
+merged by elementwise max — a PN-counter as two G-counters. The counter's
+value at a node is `sum(pos_row) - sum(neg_row)`.
+
+Replication rides the static edge channels with the same shape as
+broadcast's machinery, adapted to monotone *values* instead of set bits:
+
+  - a local add or a merge that raises an origin's entry marks it changed
+    and queues it `pending` toward every edge (queueing back toward the
+    teaching edge is the acknowledgement: the neighbor observes our merged
+    entry equals theirs and marks the edge `synced`)
+  - an arriving entry >= our merged entry proves the neighbor is up to
+    date: `synced[n, d, o]` is set; changes clear it
+  - a periodic tick requeues unsynced nonzero origins (`pending |=
+    ~synced`), so lost messages are repaired by retransmission — gossip
+    repeats until both ends provably agree, then the edge falls silent
+    (unlike the reference demo's every-5s-forever gossip, this converges
+    to zero traffic, which also lets the runner fast-forward idle time)
+
+Reads are answered host-side from the state row (`read_ok` ack on the
+wire), like broadcast reads."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..net.static import EdgeConfig, EdgeMsgs
+from ..net.tpu import I32
+from ..net.static import reverse_index
+from ..workloads.broadcast import TOPOLOGIES, topology_indices
+from .gset import fanout_topology
+from . import NodeProgram, edge_timing, register
+
+T_ADD = 10        # client -> node: a = delta
+T_ADD_OK = 11
+T_READ = 12
+T_READ_OK = 13    # bare ack; value materialized host-side
+T_ENTRY = 14      # edge: a = origin, b = pos count, c = neg count
+
+
+@register
+class PnCounterProgram(NodeProgram):
+    name = "pn-counter"
+    needs_state_reads = True
+    is_edge = True
+    tolerates_channel_overwrites = True   # entries retransmit until synced
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        opts = dict(opts)
+        fan = opts.get("gossip_fanout")
+        if fan:
+            topo = fanout_topology(nodes, int(fan), opts.get("seed", 0))
+        else:
+            topo = (opts.get("topology_map")
+                    or TOPOLOGIES["total"](nodes))
+        nb = topology_indices(topo, nodes)
+        self.neighbors = jnp.asarray(nb)
+        self.rev = jnp.asarray(reverse_index(nb))
+        self.D = int(self.neighbors.shape[1])
+        self.M = self.n_nodes                 # one origin per node
+        self.per_nb = min(int(opts.get("gossip_per_neighbor", 4)), self.M)
+        self.lanes = self.per_nb
+        self.ring, self.retry_rounds, _lat = edge_timing(opts, len(nodes))
+        self.inbox_cap = int(opts.get("inbox_cap", 4))
+        self.outbox_cap = self.inbox_cap
+        self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
+                                   lanes=self.lanes, ring=self.ring)
+
+    def init_state(self):
+        N, D, M = self.n_nodes, self.D, self.M
+        return {"pos": jnp.zeros((N, M), I32),
+                "neg": jnp.zeros((N, M), I32),
+                "pending": jnp.zeros((N, D, M), bool),
+                "synced": jnp.zeros((N, D, M), bool)}
+
+    def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        N, D, M, L = self.n_nodes, self.D, self.M, self.lanes
+        pos, neg = state["pos"], state["neg"]
+        pending, synced = state["pending"], state["synced"]
+        origins = jnp.arange(M, dtype=I32)
+        edge_ok = self.neighbors >= 0
+
+        # --- client adds: own-origin contributions ---
+        K = client_in.valid.shape[1]
+        is_add = client_in.valid & (client_in.type == T_ADD)
+        is_read = client_in.valid & (client_in.type == T_READ)
+        deltas = jnp.where(is_add, client_in.a, 0)
+        dpos = jnp.sum(jnp.maximum(deltas, 0), axis=1)        # [N]
+        dneg = jnp.sum(jnp.maximum(-deltas, 0), axis=1)
+        eye = jnp.eye(N, M, dtype=bool)
+        pos = pos + jnp.where(eye, dpos[:, None], 0)
+        neg = neg + jnp.where(eye, dneg[:, None], 0)
+        local_changed = eye & ((dpos > 0) | (dneg > 0))[:, None]
+
+        # --- merge arriving entries (elementwise max per origin) ---
+        e_in = edge_in.valid & (edge_in.type == T_ENTRY)
+        p_in = jnp.full((N, D, M), -1, I32)     # -1 = no entry seen
+        n_in = jnp.full((N, D, M), -1, I32)
+        for l in range(L):
+            oh = (jnp.clip(edge_in.a[:, :, l, None], 0, M - 1) == origins)
+            m = e_in[:, :, l, None] & oh
+            p_in = jnp.maximum(p_in, jnp.where(m, edge_in.b[:, :, l, None],
+                                               -1))
+            n_in = jnp.maximum(n_in, jnp.where(m, edge_in.c[:, :, l, None],
+                                               -1))
+        pos2 = jnp.maximum(pos, p_in.max(axis=1))
+        neg2 = jnp.maximum(neg, n_in.max(axis=1))
+        changed = (pos2 > pos) | (neg2 > neg) | local_changed
+
+        # an entry >= our merged value proves this neighbor is current
+        entry_arrived = p_in >= 0
+        nb_ge = (entry_arrived & (p_in >= pos2[:, None, :])
+                 & (n_in >= neg2[:, None, :]))
+        synced_prev = synced
+        synced = (synced & ~changed[:, None, :]) | nb_ge
+
+        # Queueing rules:
+        #  - teach: changed origins go to every edge not already proven
+        #    current this round
+        #  - echo: an arriving entry from a not-yet-synced edge is answered
+        #    with our merged entry — it both acknowledges (the sender
+        #    observes >= and sets its sync bit) and teaches if we know more.
+        #    Without the echo, senders are never acknowledged and the retry
+        #    tick retransmits forever.
+        #  - retry: unsynced nonzero origins requeue periodically, repairing
+        #    any loss; sync bits end the cycle.
+        pend_teach = changed[:, None, :] & edge_ok[:, :, None] & ~nb_ge
+        pend_echo = entry_arrived & ~synced_prev & edge_ok[:, :, None]
+        nonzero = (pos2 > 0) | (neg2 > 0)
+        requeue = (ctx["round"] % self.retry_rounds) == 0
+        pend_retry = (requeue & (~synced & nonzero[:, None, :]
+                                 & edge_ok[:, :, None]))
+        pending = (pending & ~nb_ge) | pend_teach | pend_echo | pend_retry
+
+        # --- pick entries to send: rotating top_k per edge ---
+        rot = (origins - ctx["round"] * self.per_nb) % M
+        prio = jnp.where(pending, M - rot, 0)
+        topv, topi = jax.lax.top_k(prio, self.per_nb)   # [N, D, per_nb]
+        sel = topv > 0
+        sent = jnp.zeros((N, D, M), bool)
+        for j in range(self.per_nb):
+            sent |= sel[:, :, j, None] & (topi[:, :, j, None] == origins)
+        pending = pending & ~sent
+
+        p_sel = jnp.take_along_axis(
+            jnp.broadcast_to(pos2[:, None, :], (N, D, M)), topi, axis=2)
+        n_sel = jnp.take_along_axis(
+            jnp.broadcast_to(neg2[:, None, :], (N, D, M)), topi, axis=2)
+        edge_out = EdgeMsgs(
+            valid=sel & edge_ok[:, :, None],
+            type=jnp.full((N, D, self.per_nb), T_ENTRY, I32),
+            a=topi.astype(I32), b=p_sel, c=n_sel)
+
+        # --- client replies ---
+        reply_type = jnp.where(is_add, T_ADD_OK,
+                               jnp.where(is_read, T_READ_OK, 0))
+        client_out = client_in.replace(
+            valid=is_add | is_read, dest=client_in.src,
+            reply_to=client_in.mid, type=reply_type,
+            a=jnp.zeros_like(client_in.a))
+
+        return ({"pos": pos2, "neg": neg2, "pending": pending,
+                 "synced": synced}, edge_out, client_out)
+
+    def quiescent(self, state):
+        nonzero = (state["pos"] > 0) | (state["neg"] > 0)
+        edge_ok = self.neighbors >= 0
+        unsynced = (~state["synced"] & nonzero[:, None, :]
+                    & edge_ok[:, :, None])
+        return ~(state["pending"].any() | unsynced.any())
+
+    # --- host boundary (RPC surface per workload/pn_counter.clj) ---
+
+    def request_for_op(self, op):
+        if op["f"] == "add":
+            return {"type": "add", "delta": op["value"]}
+        return {"type": "read"}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "add":
+            return (T_ADD, int(body["delta"]), 0, 0)
+        return (T_READ, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_ADD_OK:
+            return {"type": "add_ok"}
+        if t == T_READ_OK:
+            return {"type": "read_ok"}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] == "read_ok":
+            row = read_state()
+            value = int(np.asarray(row["pos"]).sum()
+                        - np.asarray(row["neg"]).sum())
+            return {**op, "type": "ok", "value": value}
+        return {**op, "type": "ok"}
+
+
+@register
+class GCounterProgram(PnCounterProgram):
+    """g-counter = pn-counter whose generator never emits negative deltas
+    (reference `workload/g_counter.clj:13-14` reuses the pn-counter
+    machinery the same way)."""
+    name = "g-counter"

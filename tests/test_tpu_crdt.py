@@ -1,0 +1,51 @@
+"""End-to-end TPU-path tests for the CRDT node programs: g-set,
+g-counter, pn-counter — including the BASELINE-style gossip-fanout and
+message-loss configurations."""
+
+from maelstrom_tpu import core
+
+
+def run(opts):
+    base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=11,
+                rate=20.0, time_limit=2.0)
+    return core.run({**base, **opts})
+
+
+def test_g_set_tpu_e2e():
+    res = run({"workload": "g-set", "node": "tpu:g-set", "node_count": 5})
+    assert res["valid"] is True, res["workload"]
+    w = res["workload"]
+    assert w["lost-count"] == 0 and w["stable-count"] > 0
+    assert res["net"]["servers"]["send-count"] > 0
+
+
+def test_g_set_tpu_fanout_with_loss():
+    """BASELINE config shape: gossip fanout 3 + 5% message loss."""
+    res = run({"workload": "g-set", "node": "tpu:g-set", "node_count": 20,
+               "gossip_fanout": 3, "p_loss": 0.05, "time_limit": 2.0,
+               "recovery_s": 3})
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["lost-count"] == 0
+
+
+def test_pn_counter_tpu_e2e():
+    res = run({"workload": "pn-counter", "node": "tpu:pn-counter",
+               "node_count": 5})
+    assert res["valid"] is True, res["workload"]
+    w = res["workload"]
+    assert w["final-reads"], w
+    assert all(v is not None for v in w["final-reads"])
+
+
+def test_pn_counter_tpu_partition():
+    res = run({"workload": "pn-counter", "node": "tpu:pn-counter",
+               "node_count": 5, "nemesis": {"partition"},
+               "nemesis_interval": 0.5, "time_limit": 3.0,
+               "recovery_s": 2})
+    assert res["valid"] is True, res["workload"]
+
+
+def test_g_counter_tpu_e2e():
+    res = run({"workload": "g-counter", "node": "tpu:g-counter",
+               "node_count": 5})
+    assert res["valid"] is True, res["workload"]

@@ -1,0 +1,82 @@
+"""Liveness regression tests: after a burst of activity with no faults,
+edge programs must acknowledge everything and fall silent — traffic
+converges to zero, sync/ack state engages, and the runner's idle
+fast-forward becomes possible. Guards against the
+echo-ack-cancelled-by-nb_ge class of bug (pending & ~nb_ge deleting the
+acknowledgement before it was ever sent)."""
+
+import jax
+import jax.numpy as jnp
+
+from maelstrom_tpu.net import tpu as T
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.sim import _round_edge, make_sim
+
+
+def drive_until_quiet(name, opts, inject_type, inject_a, n=5,
+                      max_rounds=120):
+    nodes = [f"n{i}" for i in range(n)]
+    prog = get_program(name, opts, nodes)
+    cfg = T.NetConfig(n_nodes=n, n_clients=1, pool_cap=256,
+                      inbox_cap=prog.inbox_cap, client_cap=8)
+    sim = make_sim(prog, cfg, seed=0)
+    inject = T.Msgs.empty(1).replace(
+        valid=jnp.ones(1, bool), src=jnp.full((1,), n, T.I32),
+        dest=jnp.zeros(1, T.I32), type=jnp.full((1,), inject_type, T.I32),
+        a=jnp.full((1,), inject_a, T.I32))
+    empty = T.Msgs.empty(1)
+    step = jax.jit(lambda s, i: _round_edge(prog, cfg, s, i))
+    sim, _, _ = step(sim, inject)
+    quiet_at = None
+    for r in range(1, max_rounds):
+        sim, _, _ = step(sim, empty)
+        if (bool(prog.quiescent(sim.nodes))
+                and not bool(sim.channels.valid.any())
+                and not bool(sim.net.pool.valid.any())):
+            quiet_at = r
+            break
+    return prog, sim, quiet_at
+
+
+def test_pn_counter_quiesces_after_add():
+    prog, sim, quiet_at = drive_until_quiet(
+        "pn-counter", {"latency": {"mean": 0}}, inject_type=10, inject_a=7)
+    assert quiet_at is not None, "pn-counter never acknowledged the add"
+    # and traffic genuinely stops: message counters freeze afterwards
+    before = T.stats_dict(sim.net)["sent_all"]
+    empty = T.Msgs.empty(1)
+    for _ in range(30):
+        sim, _, _ = _round_edge(prog,
+                                T.NetConfig(n_nodes=5, n_clients=1,
+                                            pool_cap=256,
+                                            inbox_cap=prog.inbox_cap,
+                                            client_cap=8),
+                                sim, empty)
+    assert T.stats_dict(sim.net)["sent_all"] == before
+    # every node converged on the value
+    pos = jax.device_get(sim.nodes["pos"])
+    assert (pos.sum(axis=1) == 7).all()
+
+
+def test_broadcast_quiesces_after_value():
+    prog, sim, quiet_at = drive_until_quiet(
+        "broadcast", {"topology": "grid", "max_values": 64,
+                      "latency": {"mean": 0}},
+        inject_type=10, inject_a=0)
+    assert quiet_at is not None, "broadcast never acknowledged the value"
+    seen = jax.device_get(sim.nodes["seen"])
+    assert seen[:, 0].all()
+
+
+def test_tiny_cluster_pn_counter_no_crash():
+    """n_nodes < gossip_per_neighbor must clamp top_k, not crash."""
+    prog, sim, quiet_at = drive_until_quiet(
+        "pn-counter", {"latency": {"mean": 0}}, inject_type=10,
+        inject_a=3, n=2)
+    assert quiet_at is not None
+
+
+def test_fanout_ge_cluster_size_terminates():
+    from maelstrom_tpu.nodes.gset import fanout_topology
+    topo = fanout_topology(["a", "b", "c"], 5)
+    assert all(len(v) == 2 for v in topo.values())
