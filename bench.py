@@ -29,7 +29,69 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 
+def bench_raft_clusters():
+    """Secondary benchmark: 10k independent 5-node raft clusters advance
+    under one vmap (BASELINE config 4). Metric: cluster-rounds/sec —
+    simulated raft rounds x clusters per wall second — plus a leader-
+    election sanity check."""
+    import jax
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.parallel import make_cluster_round_fn, \
+        make_cluster_sims
+
+    n = int(os.environ.get("BENCH_RAFT_NODES", 5))
+    clusters = int(os.environ.get("BENCH_RAFT_CLUSTERS", 10_000))
+    R = int(os.environ.get("BENCH_ROUNDS", 300))
+    chunk = min(int(os.environ.get("BENCH_CHUNK", 100)), R)
+
+    nodes = [f"n{i}" for i in range(n)]
+    program = get_program("lin-kv", {"latency": {"mean": 0}}, nodes)
+    cfg = T.NetConfig(n_nodes=n, n_clients=1, pool_cap=64,
+                      inbox_cap=program.inbox_cap, client_cap=4)
+    round_fn = make_cluster_round_fn(program, cfg)
+    scan = jax.jit(lambda sims, _: jax.lax.scan(
+        lambda s, x: (round_fn(s, T.Msgs.empty((clusters, 1)))[0], None),
+        sims, None, length=chunk)[0])
+
+    def run(sims):
+        for _ in range(R // chunk):
+            sims = scan(sims, None)
+        assert int(jax.device_get(sims.net.round[0])) == \
+            (R // chunk) * chunk
+        return sims
+
+    print(f"bench[raft]: {clusters} clusters x {n} nodes, {R} rounds",
+          file=sys.stderr)
+    sims0 = make_cluster_sims(program, cfg, clusters, seed=0)
+    sims1 = make_cluster_sims(program, cfg, clusters, seed=1)
+    t0 = time.perf_counter()
+    run(sims0)
+    print(f"bench[raft]: compile+first run {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    sims = run(sims1)              # sims built outside the timed window
+    dt = time.perf_counter() - t0
+
+    import numpy as np
+    roles = np.asarray(jax.device_get(sims.nodes["role"]))
+    one_leader = float(((roles == 2).sum(axis=1) == 1).mean())
+    rounds_done = (R // chunk) * chunk
+    rate = rounds_done * clusters / dt
+    print(json.dumps({
+        "metric": "raft_cluster_rounds_per_sec_10k_clusters",
+        "value": round(rate, 1), "unit": "cluster-rounds/sec",
+        "vs_baseline": round(rate / 1e6, 4),
+        "clusters": clusters, "nodes_per_cluster": n,
+        "rounds": rounds_done, "wall_s": round(dt, 3),
+        "clusters_with_one_leader": one_leader,
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODE") == "raft":
+        return bench_raft_clusters()
     import jax
     import jax.numpy as jnp
 

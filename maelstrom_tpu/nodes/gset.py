@@ -45,19 +45,26 @@ def fanout_topology(nodes, k: int, seed: int = 0):
     return {nodes[i]: [nodes[j] for j in sorted(adj[i])] for i in range(n)}
 
 
+def gossip_topology_opts(opts: dict, nodes) -> dict:
+    """Shared CRDT gossip-graph policy: an explicit `gossip_fanout` builds
+    a fixed random graph; otherwise gossip with all peers, like the
+    reference demo (`demo/ruby/crdt.rb`)."""
+    opts = dict(opts)
+    fan = opts.get("gossip_fanout")
+    if fan:
+        opts["topology_map"] = fanout_topology(nodes, int(fan),
+                                               opts.get("seed", 0))
+    else:
+        opts.setdefault("topology", "total")
+    return opts
+
+
 @register
 class GSetProgram(BroadcastProgram):
     name = "g-set"
 
     def __init__(self, opts, nodes):
-        opts = dict(opts)
-        fan = opts.get("gossip_fanout")
-        if fan:
-            opts["topology_map"] = fanout_topology(nodes, int(fan),
-                                                   opts.get("seed", 0))
-        else:
-            opts.setdefault("topology", "total")
-        super().__init__(opts, nodes)
+        super().__init__(gossip_topology_opts(opts, nodes), nodes)
 
     # --- host boundary (RPC surface per workload/g_set.clj) ---
 

@@ -35,7 +35,7 @@ from ..net.static import EdgeConfig, EdgeMsgs
 from ..net.tpu import I32
 from ..net.static import reverse_index
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from .gset import fanout_topology
+from .gset import gossip_topology_opts
 from . import NodeProgram, edge_timing, register
 
 T_ADD = 10        # client -> node: a = delta
@@ -54,13 +54,9 @@ class PnCounterProgram(NodeProgram):
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
-        opts = dict(opts)
-        fan = opts.get("gossip_fanout")
-        if fan:
-            topo = fanout_topology(nodes, int(fan), opts.get("seed", 0))
-        else:
-            topo = (opts.get("topology_map")
-                    or TOPOLOGIES["total"](nodes))
+        opts = gossip_topology_opts(opts, nodes)
+        topo = (opts.get("topology_map")
+                or TOPOLOGIES[opts["topology"]](nodes))
         nb = topology_indices(topo, nodes)
         self.neighbors = jnp.asarray(nb)
         self.rev = jnp.asarray(reverse_index(nb))

@@ -1,0 +1,550 @@
+"""Batched Raft serving lin-kv: every node of every cluster steps in one
+XLA dispatch.
+
+The TPU-native analogue of the reference's Raft demos
+(`demo/python/raft.py`, `demo/ruby/raft.rb`, serving
+`workload/lin_kv.clj`): leader election with randomized timeouts, log
+replication with conflict truncation, majority commit, and a KV state
+machine applied in log order — reads are logged too, so every operation
+linearizes at its apply point (passes the Knossos-style register checker).
+
+Where the reference demos branch per node (follower/candidate/leader
+methods, callbacks per RPC), here every rule is a masked update over arrays
+with a leading node axis — `role` is data, not control flow — so 10,000
+independent 5-node clusters advance under one `vmap` (the BASELINE
+"10k x 5-node raft clusters" configuration; see `maelstrom_tpu.parallel`).
+
+Cluster topology is the full mesh over the static edge channels
+(`net/static.py`). Per-edge lanes:
+
+  lane 0: request   — RequestVote or AppendEntries header
+  lane 1: reply     — vote or append result
+  lane 2: proxy     — a non-leader forwards one client request per round
+                      to its known leader (replies go straight from the
+                      leader to the client)
+  lanes 3..3+E:     — log entries riding an AppendEntries header
+
+Log entries pack to three words (term/key/op | client/values | request
+mid); values are the workload's small registers (0..254), keys are bounded
+by `kv_keys`. The leader resends its window every round until acknowledged
+— duplicates are idempotent overwrites, and the AppendEntries reply stream
+advances `next`/`match` exactly as in the paper (sections 5.3-5.4)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
+from ..net.tpu import I32
+from ..workloads.broadcast import TOPOLOGIES, topology_indices
+from . import NodeProgram, register
+
+# client RPCs
+T_READ = 10       # a = key
+T_READ_OK = 11    # a = value+1 (0 = key absent -> error 20)
+T_WRITE = 12      # a = key, b = value
+T_WRITE_OK = 13
+T_CAS = 14        # a = key, b = from, c = to
+T_CAS_OK = 15
+# raft RPCs (edge lanes)
+T_RV = 20         # a = term, b = last_log_idx, c = last_log_term
+T_RV_REPLY = 21   # a = term, b = granted
+T_AE = 22         # a = term, b = prev_idx<<16 | prev_term, c = commit<<4|cnt
+T_AE_REPLY = 23   # a = term, b = success, c = match idx (or len hint)
+T_PROXY = 24      # packed like an entry, minus the term
+T_ENTRY = 25      # a = term<<16|key<<4|op, b = client<<16|v1<<8|v2, c = mid
+
+OP_NOOP, OP_WRITE, OP_CAS, OP_READ = 0, 1, 2, 3
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+@register
+class RaftProgram(NodeProgram):
+    name = "lin-kv"
+    needs_state_reads = False
+    is_edge = True
+    tolerates_channel_overwrites = True   # AE windows resend every round
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        topo = TOPOLOGIES["total"](nodes)
+        nb = topology_indices(topo, nodes)
+        self.neighbors = jnp.asarray(nb)
+        self.rev = jnp.asarray(reverse_index(nb))
+        self.D = int(self.neighbors.shape[1])
+        self.E = int(opts.get("ae_entries", 4))
+        self.lanes = 3 + self.E
+        self.cap = int(opts.get("log_cap", 256))
+        self.keys = int(opts.get("kv_keys", 256))
+        # packed wire-field widths (entry: term<<16|key<<4|op; AE header:
+        # commit<<4|cnt with prev_idx in 16 bits)
+        assert self.E <= 15, "ae_entries must fit the 4-bit cnt field"
+        assert self.keys <= 4096, "kv_keys must fit the 12-bit key field"
+        assert self.cap <= 0xFFFF, "log_cap must fit 16-bit prev_idx"
+        from . import edge_timing
+        self.ring, _retry, lat_rounds = edge_timing(opts, len(nodes))
+        self.election = max(8 * (lat_rounds + 1), 24)
+        self.heartbeat = max(self.election // 8, 2)
+        self.inbox_cap = int(opts.get("inbox_cap", 4))
+        self.outbox_cap = self.inbox_cap
+        self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
+                                   lanes=self.lanes, ring=self.ring)
+
+    def init_state(self):
+        N, D, C = self.n_nodes, self.D, self.cap
+        z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+        return {
+            "role": z(N), "term": z(N),
+            "voted_for": jnp.full((N,), -1, I32),
+            "votes": jnp.zeros((N, N), bool),
+            "log_a": z(N, C), "log_b": z(N, C), "log_c": z(N, C),
+            "log_len": z(N),
+            "commit": jnp.full((N,), -1, I32),
+            "applied": jnp.full((N,), -1, I32),
+            "next": z(N, D), "match": jnp.full((N, D), -1, I32),
+            "kv": z(N, self.keys),          # value+1; 0 = absent
+            "deadline": z(N),               # election deadline (round)
+            "leader_hint": jnp.full((N,), -1, I32),  # believed leader edge
+            "log_overflow": z(N),
+        }
+
+    # --- packing helpers ---
+
+    @staticmethod
+    def _pack_entry(term, key, op, client, v1, v2):
+        a = (term << 16) | (key << 4) | op
+        b = (client << 16) | (v1 << 8) | v2
+        return a, b
+
+    @staticmethod
+    def _unpack_a(a):
+        return a >> 16, (a >> 4) & 0xFFF, a & 0xF       # term, key, op
+
+    @staticmethod
+    def _unpack_b(b):
+        return b >> 16, (b >> 8) & 0xFF, b & 0xFF       # client, v1, v2
+
+    def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        N, D, C, E = self.n_nodes, self.D, self.cap, self.E
+        nb, rnd = self.neighbors, ctx["round"]
+        edge_ok = nb >= 0
+        s = dict(state)
+        cap_i = jnp.arange(C, dtype=I32)
+
+        # ------------------------------------------------ inbound decode
+        req = jax.tree.map(lambda f: f[:, :, 0], edge_in)   # lane 0
+        rep = jax.tree.map(lambda f: f[:, :, 1], edge_in)   # lane 1
+        prx = jax.tree.map(lambda f: f[:, :, 2], edge_in)   # lane 2
+
+        is_rv = req.valid & (req.type == T_RV)
+        is_ae = req.valid & (req.type == T_AE)
+        is_rvr = rep.valid & (rep.type == T_RV_REPLY)
+        is_aer = rep.valid & (rep.type == T_AE_REPLY)
+        is_prx = prx.valid & (prx.type == T_PROXY)
+
+        # ------------------------------------------------ term catch-up
+        # any message with a newer term makes us a follower of that term
+        # (paper section 5.1)
+        terms_seen = jnp.maximum(
+            jnp.where(is_rv | is_ae, req.a, 0).max(axis=1),
+            jnp.where(is_rvr | is_aer, rep.a, 0).max(axis=1))
+        newer = terms_seen > s["term"]
+        s["term"] = jnp.where(newer, terms_seen, s["term"])
+        s["role"] = jnp.where(newer, FOLLOWER, s["role"])
+        s["voted_for"] = jnp.where(newer, -1, s["voted_for"])
+
+        # ------------------------------------------------ election timer
+        key_r = jax.random.fold_in(ctx["key"], 17)
+        jitter = jax.random.randint(key_r, (N,), 0, self.election)
+        timed_out = (s["role"] != LEADER) & (rnd >= s["deadline"])
+        became_candidate = timed_out
+        s["term"] = jnp.where(timed_out, s["term"] + 1, s["term"])
+        s["role"] = jnp.where(timed_out, CANDIDATE, s["role"])
+        s["voted_for"] = jnp.where(timed_out, jnp.arange(N, dtype=I32),
+                                   s["voted_for"])
+        s["votes"] = jnp.where(timed_out[:, None], False, s["votes"])
+        s["deadline"] = jnp.where(timed_out,
+                                  rnd + self.election + jitter,
+                                  s["deadline"])
+        s["leader_hint"] = jnp.where(timed_out, -1, s["leader_hint"])
+
+        last_idx = s["log_len"] - 1
+        last_term_arr = self._unpack_a(
+            jnp.take_along_axis(s["log_a"],
+                                jnp.clip(last_idx, 0, C - 1)[:, None],
+                                axis=1))[0][:, 0]
+        last_term = jnp.where(last_idx >= 0, last_term_arr, 0)
+
+        # ------------------------------------------------ votes (5.2)
+        # grant at most one vote per round: sequential unroll over edges
+        grant = jnp.zeros((N, D), bool)
+        for d in range(D):
+            rv_ok = is_rv[:, d] & (req.a[:, d] == s["term"])
+            cand = nb[:, d]
+            log_ok = ((req.c[:, d] > last_term)
+                      | ((req.c[:, d] == last_term)
+                         & (req.b[:, d] >= last_idx)))
+            can_vote = (s["voted_for"] < 0) | (s["voted_for"] == cand)
+            g = rv_ok & can_vote & log_ok
+            s["voted_for"] = jnp.where(g, cand, s["voted_for"])
+            s["deadline"] = jnp.where(g, rnd + self.election + jitter,
+                                      s["deadline"])
+            grant = grant.at[:, d].set(g)
+
+        # count granted replies; self-vote is implicit
+        rv_granted = (is_rvr & (rep.a == s["term"][:, None])
+                      & (rep.b > 0))
+        votes_add = jnp.zeros((N, N), bool)
+        me = jnp.arange(N, dtype=I32)
+        for d in range(D):
+            votes_add |= (rv_granted[:, d, None]
+                          & (nb[:, d, None] == me[None, :]))
+        s["votes"] = (s["votes"] | votes_add) & \
+            (s["role"] == CANDIDATE)[:, None]
+        won = (s["role"] == CANDIDATE) & \
+            (s["votes"].sum(axis=1) + 1 > (N // 2))
+        s["role"] = jnp.where(won, LEADER, s["role"])
+        s["next"] = jnp.where(won[:, None], s["log_len"][:, None],
+                              s["next"])
+        s["match"] = jnp.where(won[:, None], -1, s["match"])
+
+        # ------------------------------------------------ append entries
+        # decode the AE header and its entry lanes (follower side, 5.3)
+        cur = is_ae & (req.a == s["term"][:, None])
+        # the sender of a current-term AE is the leader
+        lead_edge = jnp.where(cur.any(axis=1),
+                              jnp.argmax(cur, axis=1), -1)
+        s["leader_hint"] = jnp.where(cur.any(axis=1), lead_edge,
+                                     s["leader_hint"])
+        s["deadline"] = jnp.where(cur.any(axis=1),
+                                  rnd + self.election + jitter,
+                                  s["deadline"])
+        s["role"] = jnp.where(cur.any(axis=1) & (s["role"] == CANDIDATE),
+                              FOLLOWER, s["role"])
+
+        ae_prev_idx = (req.b >> 16) - 1          # stored +1 to keep >=0
+        ae_prev_term = req.b & 0xFFFF
+        ae_commit = (req.c >> 4) - 1
+        ae_cnt = req.c & 0xF
+
+        prev_in_log = ae_prev_idx < s["log_len"][:, None]
+        prev_term_here = self._unpack_a(
+            jnp.take_along_axis(s["log_a"],
+                                jnp.clip(ae_prev_idx, 0, C - 1), axis=1))[0]
+        prev_match = (ae_prev_idx < 0) | (
+            prev_in_log & (prev_term_here == ae_prev_term))
+        accept = cur & prev_match
+        reject = cur & ~prev_match
+
+        # Append/overwrite the entry window; truncate on conflict. Entry
+        # lanes lose and delay independently of the header (per-lane loss
+        # draws in sim._round_edge), so ONLY a contiguous prefix of arrived
+        # entries may be appended and acknowledged — a header-only ack
+        # would let the leader commit entries a follower never stored
+        # (zero-filled log hole -> linearizability violation).
+        acc_any = accept.any(axis=1)
+        acc_d = jnp.argmax(accept, axis=1)
+        acc_prev = jnp.take_along_axis(ae_prev_idx, acc_d[:, None],
+                                       axis=1)[:, 0]
+        acc_cnt = jnp.take_along_axis(ae_cnt, acc_d[:, None], axis=1)[:, 0]
+
+        conflict = jnp.zeros((N,), bool)
+        new_len = s["log_len"]
+        contig = jnp.ones((N,), bool)
+        contig_cnt = jnp.zeros((N,), I32)
+        for e in range(E):
+            lane = jax.tree.map(lambda f: f[:, :, 3 + e], edge_in)
+            on_acc = (lane.valid & (lane.type == T_ENTRY)
+                      & (jnp.arange(D, dtype=I32)[None, :]
+                         == acc_d[:, None]))
+            present = acc_any & on_acc.any(axis=1)
+            expected = acc_any & (e < acc_cnt)
+            eff = present & contig & expected
+            contig = contig & (present | ~expected)
+            ea = jnp.take_along_axis(lane.a, acc_d[:, None], axis=1)[:, 0]
+            eb = jnp.take_along_axis(lane.b, acc_d[:, None], axis=1)[:, 0]
+            ec = jnp.take_along_axis(lane.c, acc_d[:, None], axis=1)[:, 0]
+            pos = acc_prev + 1 + e
+            in_cap = eff & (pos < C)
+            contig_cnt = contig_cnt + in_cap.astype(I32)
+            at = in_cap[:, None] & (cap_i == pos[:, None])
+            had = pos < s["log_len"]
+            old_term = self._unpack_a(
+                jnp.take_along_axis(s["log_a"],
+                                    jnp.clip(pos, 0, C - 1)[:, None],
+                                    axis=1))[0][:, 0]
+            conflict = conflict | (in_cap & had
+                                   & (old_term != (ea >> 16)))
+            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
+            s["log_b"] = jnp.where(at, eb[:, None], s["log_b"])
+            s["log_c"] = jnp.where(at, ec[:, None], s["log_c"])
+            new_len = jnp.where(in_cap, jnp.maximum(new_len, pos + 1),
+                                new_len)
+
+        window_end = acc_prev + 1 + contig_cnt
+        # conflict => adopt exactly the sent prefix (truncate suffix)
+        s["log_len"] = jnp.where(
+            acc_any,
+            jnp.where(conflict, jnp.minimum(new_len, window_end), new_len),
+            s["log_len"])
+        acc_commit = jnp.take_along_axis(ae_commit, acc_d[:, None],
+                                         axis=1)[:, 0]
+        s["commit"] = jnp.where(
+            acc_any,
+            jnp.maximum(s["commit"],
+                        jnp.minimum(acc_commit, s["log_len"] - 1)),
+            s["commit"])
+
+        # ------------------------------------------------ AE replies (leader)
+        aer_ok = (is_aer & (rep.a == s["term"][:, None])
+                  & (s["role"] == LEADER)[:, None])
+        succ = aer_ok & (rep.b > 0)
+        fail = aer_ok & (rep.b == 0)
+        s["match"] = jnp.where(succ, jnp.maximum(s["match"], rep.c),
+                               s["match"])
+        s["next"] = jnp.where(succ, jnp.maximum(s["next"], rep.c + 1),
+                              s["next"])
+        s["next"] = jnp.where(
+            fail, jnp.clip(jnp.minimum(s["next"] - 1, rep.c + 1), 0, C),
+            s["next"])
+
+        # commit advance: the majority-replicated index is the
+        # (majority)-th largest of {match_d} + {own log end}; commit moves
+        # there iff that entry is from the current term (5.4.2)
+        repl = jnp.concatenate(
+            [s["match"], (s["log_len"] - 1)[:, None]], axis=1)  # [N, D+1]
+        sorted_desc = -jnp.sort(-repl, axis=1)
+        best = sorted_desc[:, N // 2]           # majority = N//2 + 1 values
+        best_term = jnp.where(
+            best >= 0,
+            self._unpack_a(jnp.take_along_axis(
+                s["log_a"], jnp.clip(best, 0, C - 1)[:, None],
+                axis=1))[0][:, 0],
+            -1)
+        is_leader = s["role"] == LEADER
+        s["commit"] = jnp.where(is_leader & (best_term == s["term"]),
+                                jnp.maximum(s["commit"], best), s["commit"])
+
+        # ------------------------------------------------ client requests
+        K = client_in.valid.shape[1]
+        creq = client_in.valid & ((client_in.type == T_READ)
+                                  | (client_in.type == T_WRITE)
+                                  | (client_in.type == T_CAS))
+        op_of = jnp.where(client_in.type == T_WRITE, OP_WRITE,
+                          jnp.where(client_in.type == T_CAS, OP_CAS,
+                                    OP_READ))
+        # sequential append of direct requests (leader) — K is tiny
+        proxy_slot = jnp.full((N,), -1, I32)    # first unserved request
+        proxy_a = jnp.zeros((N,), I32)
+        proxy_b = jnp.zeros((N,), I32)
+        proxy_c = jnp.zeros((N,), I32)
+        for k in range(K):
+            rk = creq[:, k]
+            keyk = jnp.clip(client_in.a[:, k], 0, self.keys - 1)
+            v1 = jnp.where(client_in.type[:, k] == T_WRITE,
+                           client_in.b[:, k] + 1,
+                           jnp.where(client_in.type[:, k] == T_CAS,
+                                     client_in.b[:, k] + 1, 0))
+            v2 = jnp.where(client_in.type[:, k] == T_CAS,
+                           client_in.c[:, k] + 1, 0)
+            client_idx = client_in.src[:, k] - N
+            ea, eb = self._pack_entry(s["term"], keyk, op_of[:, k],
+                                      jnp.clip(client_idx, 0, 0xFFFF),
+                                      jnp.clip(v1, 0, 0xFF),
+                                      jnp.clip(v2, 0, 0xFF))
+            full = s["log_len"] >= C
+            do = rk & is_leader & ~full
+            at = do[:, None] & (cap_i == s["log_len"][:, None])
+            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
+            s["log_b"] = jnp.where(at, eb[:, None], s["log_b"])
+            s["log_c"] = jnp.where(at, client_in.mid[:, k, None],
+                                   s["log_c"])
+            s["log_len"] = jnp.where(do, s["log_len"] + 1, s["log_len"])
+            s["log_overflow"] = s["log_overflow"] + (
+                rk & is_leader & full).astype(I32)
+            # non-leader: remember ONE request to proxy toward the leader
+            want_proxy = rk & ~is_leader & (proxy_slot < 0)
+            proxy_slot = jnp.where(want_proxy, k, proxy_slot)
+            pa = (keyk << 4) | op_of[:, k]
+            pb = (jnp.clip(client_idx, 0, 0xFFFF) << 16) | \
+                (jnp.clip(v1, 0, 0xFF) << 8) | jnp.clip(v2, 0, 0xFF)
+            proxy_a = jnp.where(want_proxy, pa, proxy_a)
+            proxy_b = jnp.where(want_proxy, pb, proxy_b)
+            proxy_c = jnp.where(want_proxy, client_in.mid[:, k], proxy_c)
+
+        # proxied requests arriving at the leader: append (one per edge)
+        for d in range(D):
+            pk = is_prx[:, d] & is_leader & (s["log_len"] < C)
+            key_d = (prx.a[:, d] >> 4) & 0xFFF
+            op_d = prx.a[:, d] & 0xF
+            ea = (s["term"] << 16) | (key_d << 4) | op_d
+            at = pk[:, None] & (cap_i == s["log_len"][:, None])
+            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
+            s["log_b"] = jnp.where(at, prx.b[:, d, None], s["log_b"])
+            s["log_c"] = jnp.where(at, prx.c[:, d, None], s["log_c"])
+            s["log_len"] = jnp.where(pk, s["log_len"] + 1, s["log_len"])
+
+        # ------------------------------------------------ apply + replies
+        A = K                                    # replies share client slots
+        out_valid = jnp.zeros((N, A), bool)
+        out_dest = jnp.zeros((N, A), I32)
+        out_type = jnp.zeros((N, A), I32)
+        out_a = jnp.zeros((N, A), I32)
+        out_reply = jnp.full((N, A), -1, I32)
+        key_i = jnp.arange(self.keys, dtype=I32)
+        for j in range(A):
+            idx = s["applied"] + 1
+            active = idx <= s["commit"]
+            ea = jnp.take_along_axis(s["log_a"],
+                                     jnp.clip(idx, 0, C - 1)[:, None],
+                                     axis=1)[:, 0]
+            eb = jnp.take_along_axis(s["log_b"],
+                                     jnp.clip(idx, 0, C - 1)[:, None],
+                                     axis=1)[:, 0]
+            ec = jnp.take_along_axis(s["log_c"],
+                                     jnp.clip(idx, 0, C - 1)[:, None],
+                                     axis=1)[:, 0]
+            _t, key, op = self._unpack_a(ea)
+            client, v1, v2 = self._unpack_b(eb)
+            at_key = active[:, None] & (key_i == key[:, None])
+            cur_v = jnp.take_along_axis(s["kv"],
+                                        jnp.clip(key, 0,
+                                                 self.keys - 1)[:, None],
+                                        axis=1)[:, 0]
+            cas_ok = (op == OP_CAS) & (cur_v == v1) & (cur_v > 0)
+            do_write = (op == OP_WRITE) | cas_ok
+            new_v = jnp.where(op == OP_WRITE, v1, v2)
+            s["kv"] = jnp.where(at_key & do_write[:, None],
+                                new_v[:, None], s["kv"])
+            s["applied"] = jnp.where(active, idx, s["applied"])
+            # leader replies to the originating client
+            say = active & is_leader & (op != OP_NOOP)
+            rtype = jnp.where(
+                op == OP_READ,
+                jnp.where(cur_v > 0, T_READ_OK, 1),      # 1 = T_ERROR
+                jnp.where(op == OP_WRITE, T_WRITE_OK,
+                          jnp.where(cas_ok, T_CAS_OK, 1)))
+            ra = jnp.where(op == OP_READ,
+                           jnp.where(cur_v > 0, cur_v, 20),
+                           jnp.where((op == OP_CAS) & ~cas_ok,
+                                     jnp.where(cur_v > 0, 22, 20), 0))
+            out_valid = out_valid.at[:, j].set(say)
+            out_dest = out_dest.at[:, j].set(N + client)
+            out_type = out_type.at[:, j].set(rtype)
+            out_a = out_a.at[:, j].set(ra)
+            out_reply = out_reply.at[:, j].set(ec)
+
+        # ------------------------------------------------ outbound lanes
+        # lane 0 requests: candidates ask for votes; leaders send AE
+        send_rv = became_candidate[:, None] & edge_ok
+        nxt = jnp.minimum(s["next"], s["log_len"][:, None])
+        cnt = jnp.clip(s["log_len"][:, None] - nxt, 0, E)
+        beat = (rnd % self.heartbeat) == 0
+        send_ae = (is_leader[:, None] & edge_ok & ((cnt > 0) | beat))
+        prev_idx = nxt - 1
+        prev_term = jnp.where(
+            prev_idx >= 0,
+            self._unpack_a(jnp.take_along_axis(
+                s["log_a"], jnp.clip(prev_idx, 0, C - 1), axis=1))[0],
+            0)
+        l0_valid = send_rv | send_ae
+        l0_type = jnp.where(send_rv, T_RV, T_AE)
+        l0_a = jnp.broadcast_to(s["term"][:, None], (N, D))
+        l0_b = jnp.where(send_rv,
+                         jnp.broadcast_to(last_idx[:, None], (N, D)),
+                         ((prev_idx + 1) << 16) | prev_term)
+        l0_c = jnp.where(send_rv,
+                         jnp.broadcast_to(last_term[:, None], (N, D)),
+                         ((s["commit"][:, None] + 1) << 4) | cnt)
+
+        # lane 1 replies: vote results and append results
+        ae_reply = cur                     # reply to every current-term AE
+        l1_valid = is_rv | ae_reply
+        l1_type = jnp.where(is_rv, T_RV_REPLY, T_AE_REPLY)
+        # ack only the contiguously-appended prefix, never the header's
+        # claimed window (entry lanes may have been lost independently)
+        match_val = jnp.where(accept,
+                              (acc_prev + contig_cnt)[:, None],
+                              jnp.minimum(s["log_len"][:, None] - 1,
+                                          ae_prev_idx - 1))
+        l1_a = jnp.broadcast_to(s["term"][:, None], (N, D))
+        l1_b = jnp.where(is_rv, grant.astype(I32), accept.astype(I32))
+        l1_c = jnp.where(is_rv, 0, match_val)
+
+        # lane 2 proxy: forward the remembered request to the leader edge
+        lh = s["leader_hint"]
+        l2_valid = (proxy_slot >= 0)[:, None] & \
+            (lh[:, None] == jnp.arange(D, dtype=I32)[None, :]) & edge_ok
+        l2_type = jnp.full((N, D), T_PROXY, I32)
+        l2_a = jnp.broadcast_to(proxy_a[:, None], (N, D))
+        l2_b = jnp.broadcast_to(proxy_b[:, None], (N, D))
+        l2_c = jnp.broadcast_to(proxy_c[:, None], (N, D))
+
+        # entry lanes
+        lanes = [
+            (l0_valid, l0_type, l0_a, l0_b, l0_c),
+            (l1_valid, l1_type, l1_a, l1_b, l1_c),
+            (l2_valid, l2_type, l2_a, l2_b, l2_c),
+        ]
+        for e in range(E):
+            pos = jnp.clip(nxt + e, 0, C - 1)
+            ev = send_ae & (e < cnt)
+            ea = jnp.take_along_axis(s["log_a"], pos, axis=1)
+            eb = jnp.take_along_axis(s["log_b"], pos, axis=1)
+            ec = jnp.take_along_axis(s["log_c"], pos, axis=1)
+            lanes.append((ev, jnp.full((N, D), T_ENTRY, I32), ea, eb, ec))
+
+        edge_out = EdgeMsgs(
+            valid=jnp.stack([x[0] for x in lanes], axis=2),
+            type=jnp.stack([x[1] for x in lanes], axis=2),
+            a=jnp.stack([x[2] for x in lanes], axis=2),
+            b=jnp.stack([x[3] for x in lanes], axis=2),
+            c=jnp.stack([x[4] for x in lanes], axis=2))
+
+        client_out = client_in.replace(
+            valid=out_valid, dest=out_dest, type=out_type, a=out_a,
+            b=jnp.zeros((N, A), I32), c=jnp.zeros((N, A), I32),
+            reply_to=out_reply, src=jnp.broadcast_to(me[:, None], (N, A)))
+
+        return s, edge_out, client_out
+
+    def quiescent(self, state):
+        # raft is never quiescent: heartbeats and election timers tick
+        return jnp.array(False)
+
+    # --- host boundary (RPC surface per workload/lin_kv.clj) ---
+
+    def request_for_op(self, op):
+        k, v = op["value"]
+        if op["f"] == "read":
+            return {"type": "read", "key": k}
+        if op["f"] == "write":
+            return {"type": "write", "key": k, "value": v}
+        return {"type": "cas", "key": k, "from": v[0], "to": v[1]}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "read":
+            return (T_READ, int(body["key"]), 0, 0)
+        if body["type"] == "write":
+            return (T_WRITE, int(body["key"]), int(body["value"]), 0)
+        return (T_CAS, int(body["key"]), int(body["from"]),
+                int(body["to"]))
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_READ_OK:
+            return {"type": "read_ok", "value": int(a) - 1}
+        if t == T_WRITE_OK:
+            return {"type": "write_ok"}
+        if t == T_CAS_OK:
+            return {"type": "cas_ok"}
+        if t == 1:
+            return {"type": "error", "code": int(a), "text": "kv error"}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] == "read_ok":
+            k = op["value"][0]
+            return {**op, "type": "ok", "value": [k, body["value"]]}
+        return {**op, "type": "ok"}
