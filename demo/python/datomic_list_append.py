@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""A transactional list-append server backed by the lin-kv service, in the
+style of the reference's Datomic demo (`demo/ruby/datomic_list_append.rb`):
+the whole database lives behind a single linearizable register, transactions
+apply functionally to a copy, and a compare-and-set commits — a CAS race
+returns error 30 (txn-conflict, definite), which the checker understands as
+an aborted transaction.
+
+Because every transaction serializes through one lin-kv CAS, the system is
+strict-serializable by construction (reference
+`doc/05-datomic/01-single-node.md` onward)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+ROOT = "root"
+
+
+def apply_txn(db: dict, txn: list):
+    """Functionally applies micro-ops to db; returns (db', completed)."""
+    db = dict(db)
+    out = []
+    for f, k, v in txn:
+        key = str(k)
+        if f == "r":
+            out.append([f, k, db.get(key)])
+        elif f == "append":
+            db[key] = list(db.get(key) or []) + [v]
+            out.append([f, k, v])
+        else:
+            raise RPCError.not_supported(f"unknown micro-op {f!r}")
+    return db, out
+
+
+@node.on("txn")
+def handle_txn(msg):
+    txn = msg["body"]["txn"]
+    try:
+        cur = node.sync_rpc("lin-kv", {"type": "read", "key": ROOT})
+        db = cur["value"] or {}
+    except RPCError as e:
+        if e.code != 20:
+            raise
+        db = {}
+    db2, completed = apply_txn(db, txn)
+    try:
+        node.sync_rpc("lin-kv", {"type": "cas", "key": ROOT,
+                                 "from": db, "to": db2,
+                                 "create_if_not_exists": True})
+    except RPCError as e:
+        if e.code in (20, 22):
+            raise RPCError.txn_conflict(
+                "CAS of the database root failed; txn aborted")
+        raise
+    node.reply(msg, {"type": "txn_ok", "txn": completed})
+
+
+if __name__ == "__main__":
+    node.run()

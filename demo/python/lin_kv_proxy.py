@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""A lin-kv server that simply proxies every operation to Maelstrom's
+built-in `lin-kv` service — the smallest possible way to pass the lin-kv
+workload (reference `demo/ruby/lin_kv_proxy.rb`): the service is
+linearizable, so the proxy is too."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+
+
+def proxy(msg, body):
+    try:
+        res = node.sync_rpc("lin-kv", body)
+    except RPCError as e:
+        node.reply(msg, e.to_body())
+        return
+    node.reply(msg, res)
+
+
+@node.on("read")
+def read(msg):
+    proxy(msg, {"type": "read", "key": msg["body"]["key"]})
+
+
+@node.on("write")
+def write(msg):
+    res_body = {"type": "write", "key": msg["body"]["key"],
+                "value": msg["body"]["value"]}
+    proxy(msg, res_body)
+
+
+@node.on("cas")
+def cas(msg):
+    b = msg["body"]
+    proxy(msg, {"type": "cas", "key": b["key"], "from": b["from"],
+                "to": b["to"]})
+
+
+if __name__ == "__main__":
+    node.run()

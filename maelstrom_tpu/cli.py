@@ -118,12 +118,16 @@ DEMOS = [
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
     {"workload": "pn-counter", "bin": "demo/python/pn_counter.py"},
-    {"workload": "lin-kv", "bin": "demo/python/raft.py",
-     "concurrency": 10},
     {"workload": "lin-kv", "bin": "demo/python/lin_kv_proxy.py",
      "concurrency": 10},
     {"workload": "txn-list-append",
      "bin": "demo/python/datomic_list_append.py"},
+    # native batched node programs (the TPU path's userland)
+    {"workload": "broadcast", "node": "tpu:broadcast", "topology": "tree4"},
+    {"workload": "g-set", "node": "tpu:g-set"},
+    {"workload": "pn-counter", "node": "tpu:pn-counter"},
+    {"workload": "lin-kv", "node": "tpu:lin-kv"},
+    {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
 ]
 
 
@@ -157,16 +161,19 @@ def main(argv=None) -> int:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         failures = []
         for demo in DEMOS:
-            if args.only and args.only not in demo["bin"]:
+            runner = demo.get("bin") or demo["node"]
+            if args.only and args.only not in runner:
                 continue
-            bin_path = os.path.join(repo, demo["bin"])
-            if not os.path.exists(bin_path):
-                print(f"skip {demo['bin']} (not present)")
-                continue
-            opts = {**demo, "bin": bin_path, "node_count": 3,
+            opts = {**demo, "node_count": 3,
                     "time_limit": args.time_limit, "rate": 10,
                     "store_root": args.store, "recovery_s": 2.5}
-            print(f"\n=== {demo['workload']} :: {demo['bin']} ===")
+            if "bin" in demo:
+                bin_path = os.path.join(repo, demo["bin"])
+                if not os.path.exists(bin_path):
+                    print(f"skip {demo['bin']} (not present)")
+                    continue
+                opts["bin"] = bin_path
+            print(f"\n=== {demo['workload']} :: {runner} ===")
             r = core.run(opts)
             print(f"valid: {r.get('valid')}")
             if r.get("valid") is not True:

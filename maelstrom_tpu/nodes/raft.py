@@ -47,6 +47,8 @@ T_WRITE = 12      # a = key, b = value
 T_WRITE_OK = 13
 T_CAS = 14        # a = key, b = from, c = to
 T_CAS_OK = 15
+T_TXN = 16        # a = interned txn id (opaque replicated command)
+T_TXN_OK = 17     # a = commit position in the raft log
 # raft RPCs (edge lanes)
 T_RV = 20         # a = term, b = last_log_idx, c = last_log_term
 T_RV_REPLY = 21   # a = term, b = granted
@@ -55,7 +57,7 @@ T_AE_REPLY = 23   # a = term, b = success, c = match idx (or len hint)
 T_PROXY = 24      # packed like an entry, minus the term
 T_ENTRY = 25      # a = term<<16|key<<4|op, b = client<<16|v1<<8|v2, c = mid
 
-OP_NOOP, OP_WRITE, OP_CAS, OP_READ = 0, 1, 2, 3
+OP_NOOP, OP_WRITE, OP_CAS, OP_READ, OP_TXN = 0, 1, 2, 3, 4
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -82,7 +84,12 @@ class RaftProgram(NodeProgram):
         # commit<<4|cnt with prev_idx in 16 bits)
         assert self.E <= 15, "ae_entries must fit the 4-bit cnt field"
         assert self.keys <= 4096, "kv_keys must fit the 12-bit key field"
-        assert self.cap <= 0xFFFF, "log_cap must fit 16-bit prev_idx"
+        # 15-bit, not 16: (prev_idx+1) << 16 must stay positive in int32
+        # (arithmetic shift-right on a negative word would corrupt the
+        # decoded index). Terms share the top half of entry words; term
+        # growth is ~1 per election (>= 24 rounds), far below 2^15 in any
+        # practical run.
+        assert self.cap <= 0x7FFF, "log_cap must fit 15-bit prev_idx"
         from . import edge_timing
         self.ring, _retry, lat_rounds = edge_timing(opts, len(nodes))
         self.election = max(8 * (lat_rounds + 1), 24)
@@ -291,10 +298,14 @@ class RaftProgram(NodeProgram):
             s["log_len"])
         acc_commit = jnp.take_along_axis(ae_commit, acc_d[:, None],
                                          axis=1)[:, 0]
+        # bound by the VERIFIED prefix (prev match + contiguously appended
+        # entries), i.e. the paper's "index of last new entry" — bounding
+        # by log_len-1 would let a stale uncommitted suffix from a deposed
+        # leader be committed and applied
         s["commit"] = jnp.where(
             acc_any,
             jnp.maximum(s["commit"],
-                        jnp.minimum(acc_commit, s["log_len"] - 1)),
+                        jnp.minimum(acc_commit, acc_prev + contig_cnt)),
             s["commit"])
 
         # ------------------------------------------------ AE replies (leader)
@@ -331,10 +342,13 @@ class RaftProgram(NodeProgram):
         K = client_in.valid.shape[1]
         creq = client_in.valid & ((client_in.type == T_READ)
                                   | (client_in.type == T_WRITE)
-                                  | (client_in.type == T_CAS))
-        op_of = jnp.where(client_in.type == T_WRITE, OP_WRITE,
-                          jnp.where(client_in.type == T_CAS, OP_CAS,
-                                    OP_READ))
+                                  | (client_in.type == T_CAS)
+                                  | (client_in.type == T_TXN))
+        op_of = jnp.where(
+            client_in.type == T_WRITE, OP_WRITE,
+            jnp.where(client_in.type == T_CAS, OP_CAS,
+                      jnp.where(client_in.type == T_TXN, OP_TXN,
+                                OP_READ)))
         # sequential append of direct requests (leader) — K is tiny
         proxy_slot = jnp.full((N,), -1, I32)    # first unserved request
         proxy_a = jnp.zeros((N,), I32)
@@ -342,13 +356,21 @@ class RaftProgram(NodeProgram):
         proxy_c = jnp.zeros((N,), I32)
         for k in range(K):
             rk = creq[:, k]
-            keyk = jnp.clip(client_in.a[:, k], 0, self.keys - 1)
-            v1 = jnp.where(client_in.type[:, k] == T_WRITE,
-                           client_in.b[:, k] + 1,
-                           jnp.where(client_in.type[:, k] == T_CAS,
-                                     client_in.b[:, k] + 1, 0))
-            v2 = jnp.where(client_in.type[:, k] == T_CAS,
-                           client_in.c[:, k] + 1, 0)
+            is_txn_k = client_in.type[:, k] == T_TXN
+            keyk = jnp.where(is_txn_k, 0,
+                             jnp.clip(client_in.a[:, k], 0,
+                                      self.keys - 1))
+            # OP_TXN carries a 16-bit opaque command id split across v1/v2
+            v1 = jnp.where(
+                is_txn_k, (client_in.a[:, k] >> 8) & 0xFF,
+                jnp.where(client_in.type[:, k] == T_WRITE,
+                          client_in.b[:, k] + 1,
+                          jnp.where(client_in.type[:, k] == T_CAS,
+                                    client_in.b[:, k] + 1, 0)))
+            v2 = jnp.where(
+                is_txn_k, client_in.a[:, k] & 0xFF,
+                jnp.where(client_in.type[:, k] == T_CAS,
+                          client_in.c[:, k] + 1, 0))
             client_idx = client_in.src[:, k] - N
             ea, eb = self._pack_entry(s["term"], keyk, op_of[:, k],
                                       jnp.clip(client_idx, 0, 0xFFFF),
@@ -422,14 +444,18 @@ class RaftProgram(NodeProgram):
             # leader replies to the originating client
             say = active & is_leader & (op != OP_NOOP)
             rtype = jnp.where(
-                op == OP_READ,
-                jnp.where(cur_v > 0, T_READ_OK, 1),      # 1 = T_ERROR
-                jnp.where(op == OP_WRITE, T_WRITE_OK,
-                          jnp.where(cas_ok, T_CAS_OK, 1)))
-            ra = jnp.where(op == OP_READ,
-                           jnp.where(cur_v > 0, cur_v, 20),
-                           jnp.where((op == OP_CAS) & ~cas_ok,
-                                     jnp.where(cur_v > 0, 22, 20), 0))
+                op == OP_TXN, T_TXN_OK,
+                jnp.where(
+                    op == OP_READ,
+                    jnp.where(cur_v > 0, T_READ_OK, 1),  # 1 = T_ERROR
+                    jnp.where(op == OP_WRITE, T_WRITE_OK,
+                              jnp.where(cas_ok, T_CAS_OK, 1))))
+            ra = jnp.where(
+                op == OP_TXN, idx,                       # commit position
+                jnp.where(op == OP_READ,
+                          jnp.where(cur_v > 0, cur_v, 20),
+                          jnp.where((op == OP_CAS) & ~cas_ok,
+                                    jnp.where(cur_v > 0, 22, 20), 0)))
             out_valid = out_valid.at[:, j].set(say)
             out_dest = out_dest.at[:, j].set(N + client)
             out_type = out_type.at[:, j].set(rtype)

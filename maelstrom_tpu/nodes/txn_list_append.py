@@ -1,0 +1,92 @@
+"""Batched transactional list-append over Raft
+(serving `workload/txn_list_append.clj`).
+
+Architecture: the raft cluster replicates *opaque commands* — each
+transaction is interned host-side to a 16-bit id and rides the raft log as
+an `OP_TXN` entry (the classic replicated-state-machine split: consensus
+orders commands it does not interpret). The leader's reply carries the
+transaction's commit position; the host then deterministically replays the
+committed log prefix (same interned commands, same order, on every replica)
+to materialize read results exactly as of the transaction's serialization
+point. Total order through a single log => strict serializability, the
+default consistency model the checker demands (`core.clj:126-131`).
+
+The reference reaches the same guarantee differently (CAS on a root
+pointer in lin-kv, `demo/ruby/datomic_list_append.rb` — see
+`demo/python/datomic_list_append.py` for that design on the host path);
+running the data plane through raft instead exercises the batched
+consensus machinery end to end."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import register
+from .raft import OP_TXN, RaftProgram, T_TXN, T_TXN_OK
+
+
+def apply_txn(db: dict, txn) -> tuple[dict, list]:
+    """Pure micro-op interpreter (same semantics as the reference's
+    datomic demos): reads observe the current list (None if absent),
+    appends extend it."""
+    out = []
+    for f, k, v in txn:
+        key = str(k)
+        if f == "r":
+            got = db.get(key)
+            out.append([f, k, list(got) if got is not None else None])
+        else:
+            db = {**db, key: list(db.get(key) or []) + [v]}
+            out.append([f, k, v])
+    return db, out
+
+
+@register
+class TxnRaftProgram(RaftProgram):
+    name = "txn-list-append"
+    needs_state_reads = True
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        return {"type": "txn", "txn": op["value"]}
+
+    def encode_body(self, body, intern):
+        tid = intern.id(body["txn"])
+        if tid > 0xFFFF:
+            raise ValueError("txn command table full (65536 commands)")
+        return (T_TXN, tid, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_TXN_OK:
+            return {"type": "txn_ok", "position": int(a)}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] != "txn_ok":
+            return super().completion(op, body, read_state, intern)
+        p = body["position"]
+        # replay the committed prefix from any replica whose commit has
+        # reached p (the leader's has; entries <= commit are final and
+        # identical on every replica)
+        row = None
+        for i in range(self.n_nodes):
+            cand = read_state(i)
+            if int(cand["commit"]) >= p and int(cand["log_len"]) > p:
+                row = cand
+                break
+        assert row is not None, "no replica has the committed prefix"
+        log_a = np.asarray(row["log_a"])
+        log_b = np.asarray(row["log_b"])
+        db: dict = {}
+        completed = None
+        for i in range(p + 1):
+            if (log_a[i] & 0xF) != OP_TXN:
+                continue
+            tid = ((log_b[i] >> 8) & 0xFF) << 8 | (log_b[i] & 0xFF)
+            txn = intern.value(int(tid))
+            db, out = apply_txn(db, txn)
+            if i == p:
+                completed = out
+        assert completed is not None, f"no OP_TXN entry at position {p}"
+        return {**op, "type": "ok", "value": completed}

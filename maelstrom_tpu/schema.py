@@ -59,6 +59,15 @@ class Either:
         return " | ".join(repr(explain(a)) for a in self.alts)
 
 
+class Maybe:
+    """Nullable: None or the inner schema (schema.core's `s/maybe`; the
+    reference uses it for read results of missing keys,
+    `txn_list_append.clj:55-59`)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+
 class Tup:
     """Fixed-length heterogeneous sequence, e.g. txn micro-ops
     (reference `txn_list_append.clj:55-59`)."""
@@ -90,6 +99,10 @@ def check(schema, data):
         if not isinstance(data, bool):
             return f"expected a boolean, got {data!r}"
         return None
+    if isinstance(schema, Maybe):
+        if data is None:
+            return None
+        return check(schema.inner, data)
     if isinstance(schema, Either):
         errs = []
         for alt in schema.alts:
@@ -164,6 +177,8 @@ def explain(schema):
         return "bool"
     if isinstance(schema, Eq):
         return schema.value
+    if isinstance(schema, Maybe):
+        return {"maybe": explain(schema.inner)}
     if isinstance(schema, Either):
         return {"either": [explain(a) for a in schema.alts]}
     if isinstance(schema, Tup):

@@ -24,7 +24,7 @@ deferror(30, "txn-conflict",
          definite=True, ns="maelstrom_tpu.workloads.txn_list_append")
 
 ReadReq = S.Tup(S.Eq("r"), S.Any, S.Eq(None))
-ReadRes = S.Tup(S.Eq("r"), S.Any, [S.Any])
+ReadRes = S.Tup(S.Eq("r"), S.Any, S.Maybe([S.Any]))
 Append = S.Tup(S.Eq("append"), S.Any, S.Any)
 
 txn_rpc = defrpc(

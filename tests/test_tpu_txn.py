@@ -1,0 +1,41 @@
+"""End-to-end tests for transactional list-append: the host-path
+datomic-style demo binary (CAS on a root register in the lin-kv service)
+and the TPU-path raft-sequenced program, both graded strict-serializable
+by the Elle-style checker."""
+
+import pytest
+
+from maelstrom_tpu import core
+
+
+def test_txn_list_append_host_datomic_demo():
+    res = core.run({"workload": "txn-list-append",
+                    "bin": "demo/python/datomic_list_append.py",
+                    "node_count": 2, "rate": 8.0, "time_limit": 3.0,
+                    "seed": 4,
+                    "store_root": "/tmp/maelstrom-tpu-test-store"})
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["valid"] is True
+    assert res["stats"]["by-f"]["txn"]["ok-count"] > 5
+
+
+def test_txn_list_append_tpu_raft():
+    res = core.run({"workload": "txn-list-append",
+                    "node": "tpu:txn-list-append",
+                    "node_count": 5, "rate": 10.0, "time_limit": 3.0,
+                    "seed": 9,
+                    "store_root": "/tmp/maelstrom-tpu-test-store"})
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["valid"] is True
+    assert res["stats"]["by-f"]["txn"]["ok-count"] > 5
+
+
+def test_txn_list_append_tpu_raft_partition():
+    res = core.run({"workload": "txn-list-append",
+                    "node": "tpu:txn-list-append",
+                    "node_count": 5, "rate": 10.0, "time_limit": 4.0,
+                    "nemesis": {"partition"}, "nemesis_interval": 1.0,
+                    "seed": 9,
+                    "store_root": "/tmp/maelstrom-tpu-test-store"})
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["valid"] is True
