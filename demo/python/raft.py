@@ -1,0 +1,299 @@
+#!/usr/bin/env python3
+"""A userland Raft serving lin-kv, written against this repo's tiny node
+library — the host-path counterpart of the reference's Raft demos
+(`demo/ruby/raft.rb`, `demo/python/raft.py` in the reference tree; this is
+a fresh implementation, not a port).
+
+Leader election with randomized timeouts, log replication with conflict
+truncation, majority commit, and a KV state machine applied in log order.
+Client requests at a non-leader return error 11 (temporarily-unavailable,
+definite -> the workload records a clean :fail and retries elsewhere),
+like the reference demo. Reads go through the log, so every operation
+linearizes at its apply point.
+
+Handlers run on separate threads (node.run's dispatch), so all Raft state
+is guarded by one big lock; timers are periodic tasks."""
+
+import os
+import random
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+ELECTION_S = 0.6
+HEARTBEAT_S = 0.08
+
+node = Node()
+lock = threading.RLock()
+
+role = "follower"
+term = 0
+voted_for = None
+votes = set()
+log = []                # entries: {"term": t, "op": body-or-None}
+commit_idx = -1
+applied_idx = -1
+next_idx = {}
+match_idx = {}
+kv = {}
+leader = None
+deadline = 0.0
+
+
+def now():
+    import time
+    return time.monotonic()
+
+
+def reset_deadline():
+    global deadline
+    deadline = now() + ELECTION_S * (1 + random.random())
+
+
+def last_log():
+    if log:
+        return len(log) - 1, log[-1]["term"]
+    return -1, 0
+
+
+def become_follower(new_term):
+    global role, term, voted_for, leader
+    role = "follower"
+    term = new_term
+    voted_for = None
+    leader = None
+    reset_deadline()
+
+
+def become_candidate():
+    global role, term, voted_for, votes, leader
+    role = "candidate"
+    term += 1
+    voted_for = node.node_id
+    votes = {node.node_id}
+    leader = None
+    reset_deadline()
+    li, lt = last_log()
+    for peer in other_nodes():
+        node.rpc(peer, {"type": "request_vote", "term": term,
+                        "candidate_id": node.node_id,
+                        "last_log_index": li, "last_log_term": lt},
+                 callback=on_vote_reply(term))
+
+
+def become_leader():
+    global role, leader, next_idx, match_idx
+    role = "leader"
+    leader = node.node_id
+    next_idx = {p: len(log) for p in other_nodes()}
+    match_idx = {p: -1 for p in other_nodes()}
+    node.log(f"became leader for term {term}")
+    replicate()
+
+
+def other_nodes():
+    return [p for p in node.node_ids if p != node.node_id]
+
+
+def majority():
+    return len(node.node_ids) // 2 + 1
+
+
+def on_vote_reply(req_term):
+    def cb(msg):
+        global votes
+        with lock:
+            b = msg["body"]
+            if b.get("term", 0) > term:
+                become_follower(b["term"])
+                return
+            if role != "candidate" or term != req_term:
+                return
+            if b.get("vote_granted"):
+                votes.add(msg["src"])
+                if len(votes) >= majority():
+                    become_leader()
+    return cb
+
+
+@node.on("request_vote")
+def handle_request_vote(msg):
+    global voted_for
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        granted = False
+        if b["term"] == term and voted_for in (None, b["candidate_id"]):
+            li, lt = last_log()
+            up_to_date = (b["last_log_term"], b["last_log_index"]) >= (lt,
+                                                                       li)
+            if up_to_date:
+                granted = True
+                voted_for = b["candidate_id"]
+                reset_deadline()
+        node.reply(msg, {"type": "request_vote_res", "term": term,
+                         "vote_granted": granted})
+
+
+@node.on("append_entries")
+def handle_append_entries(msg):
+    global log, commit_idx, leader
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        if b["term"] < term:
+            node.reply(msg, {"type": "append_entries_res", "term": term,
+                             "success": False, "match_index": -1})
+            return
+        # valid leader for our term
+        global role
+        if role == "candidate":
+            role = "follower"
+        leader = b["leader_id"]
+        reset_deadline()
+        prev = b["prev_log_index"]
+        if prev >= 0 and (prev >= len(log)
+                          or log[prev]["term"] != b["prev_log_term"]):
+            node.reply(msg, {"type": "append_entries_res", "term": term,
+                             "success": False,
+                             "match_index": min(len(log) - 1, prev - 1)})
+            return
+        i = prev + 1
+        for ent in b["entries"]:
+            if i < len(log) and log[i]["term"] != ent["term"]:
+                del log[i:]                     # conflict: truncate suffix
+            if i >= len(log):
+                log.append(ent)
+            i += 1
+        new_match = prev + len(b["entries"])
+        global commit_idx
+        commit_idx = max(commit_idx, min(b["leader_commit"], new_match))
+        apply_committed()
+        node.reply(msg, {"type": "append_entries_res", "term": term,
+                         "success": True, "match_index": new_match})
+
+
+def on_append_reply(peer, req_term):
+    def cb(msg):
+        global commit_idx
+        with lock:
+            b = msg["body"]
+            if b.get("term", 0) > term:
+                become_follower(b["term"])
+                return
+            if role != "leader" or term != req_term:
+                return
+            if b.get("success"):
+                match_idx[peer] = max(match_idx[peer], b["match_index"])
+                next_idx[peer] = match_idx[peer] + 1
+                # commit = majority-replicated index with a current-term
+                # entry (paper section 5.4.2)
+                marks = sorted(list(match_idx.values()) + [len(log) - 1],
+                               reverse=True)
+                best = marks[majority() - 1]
+                if best > commit_idx and best >= 0 \
+                        and log[best]["term"] == term:
+                    commit_idx = best
+                    apply_committed()
+            else:
+                next_idx[peer] = max(0, min(next_idx[peer] - 1,
+                                            b.get("match_index", -1) + 1))
+    return cb
+
+
+def replicate():
+    with lock:
+        if role != "leader":
+            return
+        for peer in other_nodes():
+            nx = next_idx[peer]
+            prev = nx - 1
+            prev_term = log[prev]["term"] if prev >= 0 else 0
+            entries = log[nx:nx + 16]
+            node.rpc(peer, {"type": "append_entries", "term": term,
+                            "leader_id": node.node_id,
+                            "prev_log_index": prev,
+                            "prev_log_term": prev_term,
+                            "entries": entries,
+                            "leader_commit": commit_idx},
+                     callback=on_append_reply(peer, term))
+
+
+def apply_committed():
+    """Applies entries up to commit_idx; the leader answers clients."""
+    global applied_idx
+    while applied_idx < commit_idx:
+        applied_idx += 1
+        ent = log[applied_idx]
+        op = ent.get("op")
+        if op is None:
+            continue
+        body, client = op["body"], op["client"]
+        t, k = body["type"], body.get("key")
+        reply = None
+        if t == "read":
+            if k in kv:
+                reply = {"type": "read_ok", "value": kv[k]}
+            else:
+                reply = RPCError.key_does_not_exist(f"no key {k}").to_body()
+        elif t == "write":
+            kv[k] = body["value"]
+            reply = {"type": "write_ok"}
+        elif t == "cas":
+            if k not in kv:
+                reply = RPCError.key_does_not_exist(f"no key {k}").to_body()
+            elif kv[k] != body["from"]:
+                reply = RPCError.precondition_failed(
+                    f"expected {body['from']!r}, had {kv[k]!r}").to_body()
+            else:
+                kv[k] = body["to"]
+                reply = {"type": "cas_ok"}
+        if role == "leader" and client is not None:
+            node.send_msg(client, dict(reply,
+                                       in_reply_to=op["msg_id"]))
+
+
+def handle_client(msg):
+    with lock:
+        if role == "leader":
+            log.append({"term": term,
+                        "op": {"body": msg["body"], "client": msg["src"],
+                               "msg_id": msg["body"]["msg_id"]}})
+            replicate()
+            return
+        target = leader
+    if target is None or target == node.node_id:
+        raise RPCError.temporarily_unavailable("no leader known yet")
+
+    # forward to the known leader and relay its reply back to the client
+    def relay(res):
+        body = {k: v for k, v in res["body"].items()
+                if k not in ("msg_id", "in_reply_to")}
+        body["in_reply_to"] = msg["body"]["msg_id"]
+        node.send_msg(msg["src"], body)
+
+    fwd = {k: v for k, v in msg["body"].items() if k != "msg_id"}
+    node.rpc(target, fwd, callback=relay)
+
+
+for _type in ("read", "write", "cas"):
+    node.on(_type)(handle_client)
+
+
+@node.every(HEARTBEAT_S)
+def tick():
+    with lock:
+        if role == "leader":
+            replicate()
+        elif now() >= deadline:
+            become_candidate()
+
+
+reset_deadline()
+
+if __name__ == "__main__":
+    node.run()

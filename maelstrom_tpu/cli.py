@@ -120,6 +120,8 @@ DEMOS = [
     {"workload": "pn-counter", "bin": "demo/python/pn_counter.py"},
     {"workload": "lin-kv", "bin": "demo/python/lin_kv_proxy.py",
      "concurrency": 10},
+    {"workload": "lin-kv", "bin": "demo/python/raft.py",
+     "concurrency": 10, "time_limit_min": 8.0},
     {"workload": "txn-list-append",
      "bin": "demo/python/datomic_list_append.py"},
     # native batched node programs (the TPU path's userland)
@@ -165,8 +167,11 @@ def main(argv=None) -> int:
             if args.only and args.only not in runner:
                 continue
             opts = {**demo, "node_count": 3,
-                    "time_limit": args.time_limit, "rate": 10,
+                    "time_limit": max(args.time_limit,
+                                      demo.get("time_limit_min", 0)),
+                    "rate": 10,
                     "store_root": args.store, "recovery_s": 2.5}
+            opts.pop("time_limit_min", None)
             if "bin" in demo:
                 bin_path = os.path.join(repo, demo["bin"])
                 if not os.path.exists(bin_path):

@@ -29,6 +29,21 @@ PENDING = "pending"
 NEMESIS = "nemesis"
 
 
+def rotate_free(free, dispatch_count: int) -> list:
+    """Rotates the sorted free-process list by a monotonically increasing
+    dispatch counter so successive ops spread across workers — and
+    therefore across nodes (worker i talks to node i % n). Leaf generators
+    take free[0]; always offering the same first worker would starve every
+    node but one of client traffic (fatal for e.g. a raft leader elsewhere).
+    The counter must count dispatches, not history length: history grows by
+    two per op (invoke + completion), which aliases even-sized pools."""
+    fs = sorted(free, key=str)
+    if not fs:
+        return fs
+    k = dispatch_count % len(fs)
+    return fs[k:] + fs[:k]
+
+
 def client_processes(ctx) -> list:
     """Processes visible in this context. Routing to clients vs the nemesis
     is done by the OnProcesses wrappers (clients()/nemesis_gen()), so leaf

@@ -72,6 +72,11 @@ class BroadcastProgram(NodeProgram):
         self.n_windows = (self.V + 63) // 64
         self.Vp = self.n_windows * 64                 # padded bitmap width
         self.per_nb = min(int(opts.get("gossip_per_neighbor", 4)), self.V)
+        # eager mode: resend pending values every round until a digest
+        # clears them (no send-once aging) — maximum message load per
+        # round; used by the throughput benchmark. Default is the
+        # efficient send-once-plus-retry protocol.
+        self.eager_resend = bool(opts.get("eager_resend", False))
         self.lanes = self.per_nb + 1                  # +1 digest lane
         self.ring, retry, _lat = edge_timing(opts, len(nodes))
         # a digest for any window returns within the round-trip plus one
@@ -162,12 +167,13 @@ class BroadcastProgram(NodeProgram):
         prio = jnp.where(pending, V - rot, 0)
         topv, topi = jax.lax.top_k(prio, self.per_nb)       # [N, D, per_nb]
         sel = topv > 0
-        sent = jnp.zeros((N, D, V), bool)
-        for j in range(self.per_nb):
-            sent |= sel[:, :, j, None] & (topi[:, :, j, None]
-                                          == jnp.arange(V, dtype=I32))
-        pending = pending & ~sent
-        inflight = inflight | sent
+        if not self.eager_resend:
+            sent = jnp.zeros((N, D, V), bool)
+            for j in range(self.per_nb):
+                sent |= sel[:, :, j, None] & (topi[:, :, j, None]
+                                              == jnp.arange(V, dtype=I32))
+            pending = pending & ~sent
+            inflight = inflight | sent
 
         # --- digest scheduling: ack exactly the windows gossip arrived in,
         # one owed window per edge per round ---

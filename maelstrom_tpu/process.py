@@ -19,6 +19,7 @@ import subprocess
 import threading
 from collections import deque
 
+from .errors import RPCError
 from .message import MalformedMessage, parse_msg
 
 log = logging.getLogger("maelstrom.process")
@@ -102,6 +103,15 @@ class NodeProcess:
                 self.net.send(parse_msg(self.node_id, line))
             except MalformedMessage as e:
                 log.error("%s", e)
+            except RPCError as e:
+                if e.code == 1:
+                    # destination already torn down (expected during
+                    # shutdown: peers keep heartbeating)
+                    log.debug("%s -> departed node: %s", self.node_id,
+                              e.body.get("text"))
+                elif self.running:
+                    log.exception("Error handling stdout of %s",
+                                  self.node_id)
             except Exception:
                 if self.running:
                     log.exception("Error handling stdout of %s",

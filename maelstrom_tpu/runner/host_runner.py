@@ -88,6 +88,11 @@ def run_test(test: dict) -> History:
     deadline = _time.monotonic() + test.get("hard_deadline_s", 3600)
     lock = threading.Lock()
 
+    dispatches = [0]
+
+    def free_rotated():
+        return g.rotate_free(free, dispatches[0])
+
     def nemesis_invoke(op):
         completed = nemesis.invoke(op)
         results.put((g.NEMESIS, completed))
@@ -106,13 +111,13 @@ def run_test(test: dict) -> History:
                             final=completed.get("final", False))
                     history.append(op)
                     free.add(process)
-                    ctx = {"time": time_source(), "free": sorted(free, key=str),
+                    ctx = {"time": time_source(), "free": free_rotated(),
                            "processes": processes}
                     gen = gen.update(ctx, completed)
             except queue.Empty:
                 pass
 
-            ctx = {"time": time_source(), "free": sorted(free, key=str),
+            ctx = {"time": time_source(), "free": free_rotated(),
                    "processes": processes}
             res, gen = gen.op(ctx)
             if res is None:
@@ -124,6 +129,7 @@ def run_test(test: dict) -> History:
                 _time.sleep(0.001)
                 continue
             # Dispatch
+            dispatches[0] += 1
             process = res["process"]
             free.discard(process)
             invoke = Op(type="invoke", f=res.get("f"),

@@ -202,6 +202,7 @@ class TpuRunner:
             self.journal = journal
         self.node_names = list(nodes) + [f"c{i}"
                                          for i in range(self.concurrency)]
+        self._dispatches = 0
         self._state_cache = None
         self._bump = jax.jit(
             lambda sim, k: sim.replace(net=sim.net.replace(
@@ -228,6 +229,10 @@ class TpuRunner:
         free.add(process)
         return gen.update(ctx, completed)
 
+
+    def _free_rotated(self, free, history):
+        return g.rotate_free(free, self._dispatches)
+
     # --- main loop ---
 
     def run(self) -> History:
@@ -247,7 +252,7 @@ class TpuRunner:
         r = 0
         exhausted = False
         while r < max_rounds:
-            ctx = {"time": self._time_ns(r), "free": sorted(free, key=str),
+            ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
             inject_rows = []
             while True:
@@ -259,6 +264,7 @@ class TpuRunner:
                 if res == g.PENDING:
                     break
                 process = res["process"]
+                self._dispatches += 1
                 free.discard(process)
                 op = {k: v for k, v in res.items() if k != "time"}
                 history.append(Op(type="invoke", f=op.get("f"),
@@ -283,7 +289,7 @@ class TpuRunner:
                         inject_rows.append((process, op, node_idx, t, a, b,
                                             c))
                 ctx = {"time": self._time_ns(r),
-                       "free": sorted(free, key=str),
+                       "free": self._free_rotated(free, history),
                        "processes": processes}
 
             if exhausted and not pending and free == set(processes):
@@ -324,7 +330,7 @@ class TpuRunner:
             if self.journal is not None:
                 self._journal_round(io, client_msgs, r)
             r += 1
-            ctx = {"time": self._time_ns(r), "free": sorted(free, key=str),
+            ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
             cm = jax.device_get(client_msgs)

@@ -66,3 +66,22 @@ def test_crashed_node_fails_test(tmp_path):
     crasher.chmod(0o755)
     with pytest.raises(Exception):
         run(tmp_path, workload="echo", bin=str(crasher), time_limit=1)
+
+
+def test_lin_kv_proxy_e2e(tmp_path):
+    r = run(tmp_path, workload="lin-kv",
+            bin=os.path.join(DEMO, "lin_kv_proxy.py"), time_limit=3,
+            concurrency=6)
+    assert r["valid"] is True, r.get("workload")
+
+
+def test_raft_demo_e2e(tmp_path):
+    """The userland Python Raft demo passes the linearizability checker
+    (requires the op-spreading free-list rotation: a single always-first
+    worker would only ever talk to one node)."""
+    r = run(tmp_path, workload="lin-kv",
+            bin=os.path.join(DEMO, "raft.py"), time_limit=8,
+            concurrency=6, rate=8)
+    assert r["valid"] is True, r.get("workload")
+    ok = sum(v["ok-count"] for v in r["stats"]["by-f"].values())
+    assert ok > 5
