@@ -378,7 +378,8 @@ class TpuRunner:
         (the interactive-mode analogue of the send!/recv! hooks,
         reference `net.clj:207,243`)."""
         import numpy as np
-        inject_sent, outbox_sent, inbox = jax.device_get(io)
+        io = jax.device_get(io)
+        inject_sent, outbox_sent, inbox = io[0], io[1], io[2]
         cm = jax.device_get(client_msgs)
         t_ns = self._time_ns(r)
         for batch, typ in ((inject_sent, "send"), (outbox_sent, "send"),
@@ -391,6 +392,55 @@ class TpuRunner:
             dest = np.asarray(batch.dest).reshape(-1)[valid]
             self.journal.log_batch(typ, mid, np.full(mid.shape, t_ns),
                                    src, dest, node_names=self.node_names)
+        if len(io) >= 5:
+            self._journal_edges(io[3], io[4], r)
+
+    def _journal_edges(self, edge_out, edge_in, r: int):
+        """Synthesizes journal rows for static edge-channel traffic. Ids
+        are deterministic functions of (send round, edge, lane), so the
+        receive side reconstructs its send id and Lamport pairing works —
+        exact for constant latency; under randomized draws receive rows
+        pair approximately (ids use the mean delay). High id bit space
+        keeps them disjoint from pool message ids."""
+        import numpy as np
+        prog = self.program
+        N, D = self.cfg.n_nodes, prog.D
+        L = prog.lanes
+        if not hasattr(self, "_edge_topo"):
+            # static for the runner's lifetime: materialize once
+            self._edge_topo = (np.asarray(prog.neighbors),
+                               np.asarray(prog.rev))
+        nb, rev = self._edge_topo
+        base = 1 << 40
+        # mirror the device-side draw exactly: scale by the live
+        # latency_scale (slow!/fast!) and clip to the ring as edge_write
+        # does, or recv ids desync from their sends
+        scale = float(jax.device_get(self.sim.net.latency_scale))
+        lat = min(int(round(self.cfg.latency_mean_rounds * scale)),
+                  prog.ring - 2)
+
+        ov = np.asarray(edge_out.valid)              # [N, D, L]
+        if ov.any():
+            n_i, d_i, l_i = np.nonzero(ov)
+            ids = base + (r * (N * D * L)
+                          + (n_i * D + d_i) * L + l_i).astype(np.int64)
+            self.journal.log_batch(
+                "send", ids, np.full(ids.shape, self._time_ns(r)),
+                n_i.astype(np.int32), nb[n_i, d_i].astype(np.int32),
+                node_names=self.node_names)
+        iv = np.asarray(edge_in.valid)               # [N, D, L] (receiver)
+        if iv.any():
+            m_i, e_i, l_i = np.nonzero(iv)
+            senders = nb[m_i, e_i]
+            send_d = rev[m_i, e_i]
+            send_round = r - 1 - lat
+            ids = base + (send_round * (N * D * L)
+                          + (senders * D + send_d) * L + l_i
+                          ).astype(np.int64)
+            self.journal.log_batch(
+                "recv", ids, np.full(ids.shape, self._time_ns(r)),
+                senders.astype(np.int32), m_i.astype(np.int32),
+                node_names=self.node_names)
 
     def _pool_empty(self) -> bool:
         return not bool(self.sim.net.pool.valid.any())

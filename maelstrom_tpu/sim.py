@@ -128,7 +128,8 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     net = net.replace(stats=st)
     net = T.advance(net)
     return (SimState(net=net, nodes=nodes, key=key, channels=ch),
-            client_msgs, (inject_sent, outbox_sent, client_inbox))
+            client_msgs,
+            (inject_sent, outbox_sent, client_inbox, edge_out, edge_in))
 
 
 def make_round_fn(program, cfg: NetConfig):
