@@ -1,0 +1,42 @@
+"""Regression tests for op spreading and schema nullability — two small
+host-side utilities whose failure modes were subtle (rotation aliasing
+pinned all client traffic to one node; strict read schemas rejected null
+reads of absent keys)."""
+
+from maelstrom_tpu import schema as S
+from maelstrom_tpu.generators import rotate_free
+
+
+def test_rotate_free_spreads_over_even_pool_under_serial_load():
+    """Serial load: all workers free at every dispatch. The rotation must
+    still visit every worker (keying on history length, which grows by 2
+    per op, would alias an even pool and pin everything to worker 0)."""
+    free = {0, 1}
+    seen = set()
+    for dispatch in range(4):
+        seen.add(rotate_free(free, dispatch)[0])
+    assert seen == {0, 1}
+
+
+def test_rotate_free_covers_all_workers():
+    free = {0, 1, 2, "nemesis"}
+    firsts = [rotate_free(free, d)[0] for d in range(8)]
+    assert set(firsts) == {0, 1, 2, "nemesis"}
+
+
+def test_rotate_free_empty():
+    assert rotate_free(set(), 3) == []
+
+
+def test_schema_maybe_allows_null_and_checks_inner():
+    sch = S.Maybe([S.Any])
+    assert S.check(sch, None) is None
+    assert S.check(sch, [1, 2]) is None
+    assert S.check(sch, "nope") is not None
+
+
+def test_txn_read_result_schema_accepts_null_reads():
+    from maelstrom_tpu.workloads.txn_list_append import ReadRes
+    assert S.check(ReadRes, ["r", 5, None]) is None
+    assert S.check(ReadRes, ["r", 5, [1, 2]]) is None
+    assert S.check(ReadRes, ["append", 5, 1]) is not None
