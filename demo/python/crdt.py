@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""A generic state-based CRDT server (counterpart of demo/ruby/crdt.rb).
+
+Wraps a Node around any CRDT value exposing:
+
+  from_json(j)  inflate a value from a JSON structure
+  to_json()     JSON structure for serialization
+  merge(other)  a *new* value, this merged with other
+  read()        the effective (client-visible) state
+
+and serves:
+
+  {type: "read"}               -> {type: "read_ok", value: <read()>}
+  {type: "merge", value: <j>}  -> {type: "merge_ok"}   (gossip ingest)
+
+replicating the full state to every other node every `interval_s` seconds.
+Ships three value types: GSet, GCounter, PNCounter.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+
+class CRDTServer:
+    def __init__(self, node: Node, value, interval_s: float = 5.0):
+        self.node = node
+        self.value = value
+        self.lock = threading.Lock()
+
+        @node.on("read")
+        def read(msg):
+            with self.lock:
+                v = self.value.read()
+            node.reply(msg, {"type": "read_ok", "value": v})
+
+        @node.on("merge")
+        def merge(msg):
+            with self.lock:
+                other = self.value.from_json(msg["body"]["value"])
+                self.value = self.value.merge(other)
+                node.log(f"value now {self.value.to_json()}")
+            node.reply(msg, {"type": "merge_ok"})
+
+        @node.on("merge_ok")
+        def merge_ok(msg):
+            pass        # gossip acks need no action
+
+        @node.every(interval_s)
+        def replicate():
+            with self.lock:
+                j = self.value.to_json()
+            for other in node.node_ids:
+                if other != node.node_id:
+                    node.send_msg(other, {"type": "merge", "value": j})
+
+
+class GSet:
+    """Grow-only set."""
+
+    def __init__(self, elements=()):
+        self.elements = frozenset(elements)
+
+    def from_json(self, j):
+        return GSet(j)
+
+    def to_json(self):
+        return sorted(self.elements)
+
+    def merge(self, other):
+        return GSet(self.elements | other.elements)
+
+    def read(self):
+        return sorted(self.elements)
+
+    def add(self, element):
+        return GSet(self.elements | {element})
+
+
+class GCounter:
+    """Grow-only counter: one non-negative slot per node, merged by max."""
+
+    def __init__(self, counts=None):
+        self.counts = dict(counts or {})
+
+    def from_json(self, j):
+        return GCounter(j)
+
+    def to_json(self):
+        return dict(self.counts)
+
+    def merge(self, other):
+        merged = dict(self.counts)
+        for k, v in other.counts.items():
+            merged[k] = max(merged.get(k, 0), v)
+        return GCounter(merged)
+
+    def read(self):
+        return sum(self.counts.values())
+
+    def add(self, node_id, delta):
+        assert delta >= 0
+        c = dict(self.counts)
+        c[node_id] = c.get(node_id, 0) + delta
+        return GCounter(c)
+
+
+class PNCounter:
+    """Increment/decrement counter: a pair of GCounters."""
+
+    def __init__(self, inc=None, dec=None):
+        self.inc = inc or GCounter()
+        self.dec = dec or GCounter()
+
+    def from_json(self, j):
+        return PNCounter(GCounter(j["inc"]), GCounter(j["dec"]))
+
+    def to_json(self):
+        return {"inc": self.inc.to_json(), "dec": self.dec.to_json()}
+
+    def merge(self, other):
+        return PNCounter(self.inc.merge(other.inc), self.dec.merge(other.dec))
+
+    def read(self):
+        return self.inc.read() - self.dec.read()
+
+    def add(self, node_id, delta):
+        if delta >= 0:
+            return PNCounter(self.inc.add(node_id, delta), self.dec)
+        return PNCounter(self.inc, self.dec.add(node_id, -delta))

@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Echo server that reflects the entire request body back
+(counterpart of demo/ruby/echo_full.rb)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node
+
+node = Node()
+
+
+@node.on("echo")
+def echo(msg):
+    node.reply(msg, dict(msg["body"], type="echo_ok"))
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Grow-only counter node on the generic CRDT server (counterpart of
+demo/clojure/gcounter.clj; g-counter workload, non-negative deltas)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from crdt import CRDTServer, GCounter
+from node import Node
+
+node = Node()
+server = CRDTServer(node, GCounter(), interval_s=0.7)
+
+
+@node.on("add")
+def add(msg):
+    with server.lock:
+        server.value = server.value.add(node.node_id, msg["body"]["delta"])
+    node.reply(msg, {"type": "add_ok"})
+
+
+if __name__ == "__main__":
+    node.run()

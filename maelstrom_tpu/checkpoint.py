@@ -1,0 +1,77 @@
+"""True checkpoint/resume for TPU-path tests.
+
+The reference cannot snapshot a running test: node state lives inside
+opaque OS processes, so a test either runs to completion or is lost
+(SURVEY.md section 5.4 — its store dir only enables post-hoc re-analysis).
+The TPU path's entire run state is pure data — device arrays including the
+PRNG key, picklable generator trees, the history so far, and in-flight RPC
+bookkeeping — so a checkpoint is one atomic file, and a resumed run
+continues *deterministically*: it produces byte-identical histories to an
+uninterrupted run with the same options.
+
+Layout: `store/<test>/<time>/checkpoint.pkl`, rewritten atomically
+(tmp + rename) every `--checkpoint-every` virtual seconds. Resume with
+`maelstrom_tpu test ... --resume <that dir>` (same workload options).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+CHECKPOINT_FILE = "checkpoint.pkl"
+
+# Options that must match between the checkpointing run and the resuming
+# run: they shape the compiled round function, the generator tree, the
+# simulated cluster, or the runner's dispatch cadence (anything that can
+# change the op stream or the PRNG consumption order).
+FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
+                    "concurrency", "latency", "nemesis", "nemesis_interval",
+                    "topology", "seed", "key_count", "max_txn_length",
+                    "max_writes_per_key", "min_txn_length", "ops_per_key",
+                    "p_loss", "timeout_ms", "ms_per_round", "recovery_s",
+                    "journal_rows", "max_scan", "pool_cap", "gossip_fanout")
+
+
+def fingerprint(test: dict) -> dict:
+    return {k: sorted(v) if isinstance(v, set) else v
+            for k, v in ((k, test.get(k)) for k in FINGERPRINT_KEYS)}
+
+
+def save(dir_path: str, state: dict) -> str:
+    """Atomically writes a checkpoint into `dir_path`. Device arrays are
+    pulled to host numpy first (one transfer for the whole pytree)."""
+    os.makedirs(dir_path, exist_ok=True)
+    path = os.path.join(dir_path, CHECKPOINT_FILE)
+    tmp = path + ".tmp"
+    state = dict(state, sim=jax.device_get(state["sim"]))
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
+
+
+def load(dir_path: str) -> dict:
+    """Loads a checkpoint; `sim` leaves come back as device arrays."""
+    path = os.path.join(dir_path, CHECKPOINT_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {CHECKPOINT_FILE} in {dir_path!r} - was the original run "
+            "started with --checkpoint-every?")
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    state["sim"] = jax.tree.map(jnp.asarray, state["sim"])
+    return state
+
+
+def check_fingerprint(ckpt: dict, test: dict):
+    want, got = ckpt.get("fingerprint", {}), fingerprint(test)
+    diffs = {k: (want.get(k), got.get(k)) for k in want
+             if want.get(k) != got.get(k)}
+    if diffs:
+        raise ValueError(
+            "resume options differ from the checkpointed run "
+            f"(checkpointed vs given): {diffs}")

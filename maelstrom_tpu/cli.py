@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Log packets as they're received")
     t.add_argument("--seed", type=int, default=0, help="PRNG seed")
     t.add_argument("--store", default="store", help="Store directory root")
+    t.add_argument("--checkpoint-every", type=float,
+                   help="Checkpoint the run every N virtual seconds "
+                        "(TPU path only)")
+    t.add_argument("--resume",
+                   help="Resume from the checkpoint in this store test dir "
+                        "(TPU path only; same options as the original run)")
 
     s = sub.add_parser("serve", help="Serve the store directory")
     s.add_argument("--port", type=int, default=8080)
@@ -108,15 +114,24 @@ def opts_from_args(args) -> dict:
         "log_net_recv": args.log_net_recv,
         "seed": args.seed,
         "store_root": args.store,
+        "checkpoint_every": args.checkpoint_every,
+        "resume": args.resume,
     }
+    if (args.checkpoint_every or args.resume) and not (
+            args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--checkpoint-every/--resume need the TPU path "
+                         "(--node tpu:<program>): external --bin processes "
+                         "hold opaque state that cannot be snapshotted")
     return opts
 
 
 # The bundled demo suite (reference `core.clj:93-103`)
 DEMOS = [
     {"workload": "echo", "bin": "demo/python/echo.py"},
+    {"workload": "echo", "bin": "demo/python/echo_full.py"},
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
+    {"workload": "g-counter", "bin": "demo/python/g_counter.py"},
     {"workload": "pn-counter", "bin": "demo/python/pn_counter.py"},
     {"workload": "lin-kv", "bin": "demo/python/lin_kv_proxy.py",
      "concurrency": 10},
@@ -137,6 +152,8 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    from .util import honor_jax_platforms
+    honor_jax_platforms()
     args = build_parser().parse_args(argv)
 
     if args.cmd == "test":

@@ -62,6 +62,36 @@ class Gen:
     def update(self, ctx, event):
         return self
 
+    def next_interesting_time(self, ctx) -> float:
+        """After this generator returned PENDING at ctx["time"]: the
+        earliest ctx time (ns) at which it might produce an op *without any
+        new completion event*, or +inf if only a completion (reply/timeout
+        freeing a worker) can unblock it. Lets the TPU runner scan many
+        rounds in one compiled dispatch, stopping exactly where the
+        generator could next act. Returning a too-late time would delay
+        ops (wrong); too-early merely costs a dispatch (safe). The inf
+        default is correct for every generator that PENDs only for lack of
+        free processes."""
+        return math.inf
+
+
+class cycle:
+    """Endless iterator over a fixed element list — the picklable
+    itertools.cycle replacement (itertools pickling goes away in 3.14).
+    Feed to Seq for repeating schedules (e.g. the nemesis on/off cycle)."""
+
+    def __init__(self, elements, i: int = 0):
+        self.elements = list(elements)
+        self.i = i
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        x = self.elements[self.i % len(self.elements)]
+        self.i += 1
+        return x
+
 
 def fill_op(op: dict, ctx, process) -> dict:
     out = dict(op)
@@ -131,6 +161,11 @@ class Seq(Gen):
             self.head = None
             return fill_op(h, ctx, free[0]), self
 
+    def next_interesting_time(self, ctx) -> float:
+        if isinstance(self.head, Gen):
+            return self.head.next_interesting_time(ctx)
+        return math.inf
+
 
 class Fn(Gen):
     """Calls a zero-arg function to produce each op map (like the
@@ -147,6 +182,23 @@ class Fn(Gen):
         if op_map is None:
             return None, self
         return fill_op(op_map, ctx, free[0]), self
+
+
+class Counting(Gen):
+    """Emits {"f": f, "value": 0}, {"f": f, "value": 1}, ... — the picklable
+    form of `Seq({"f": f, "value": x} for x in itertools.count())` used by
+    set-style workloads (reference `broadcast.clj:229-233`)."""
+
+    def __init__(self, f: str, n: int = 0):
+        self.f = f
+        self.n = n
+
+    def op(self, ctx):
+        free = free_clients(ctx)
+        if not free:
+            return PENDING, self
+        op_map = {"f": self.f, "value": self.n}
+        return fill_op(op_map, ctx, free[0]), Counting(self.f, self.n + 1)
 
 
 class Repeat(Gen):
@@ -199,6 +251,13 @@ class TimeLimit(Gen):
     def update(self, ctx, event):
         return TimeLimit(self.dt_ns, self.gen.update(ctx, event), self.t0)
 
+    def next_interesting_time(self, ctx) -> float:
+        t0 = ctx["time"] if self.t0 is None else self.t0
+        if ctx["time"] - t0 >= self.dt_ns:
+            return math.inf     # already exhausted: nothing more, ever
+        # expiry matters: it exhausts this gen, which can advance Phases
+        return min(self.gen.next_interesting_time(ctx), t0 + self.dt_ns)
+
 
 class Stagger(Gen):
     """Rate limiting: introduces random delays averaging dt between ops
@@ -227,8 +286,10 @@ class Stagger(Gen):
         return Stagger(self.dt_ns, self.gen.update(ctx, event),
                        self.next_time, self.rng)
 
-    def next_interesting_time(self, ctx):
-        return self.next_time
+    def next_interesting_time(self, ctx) -> float:
+        if self.next_time is not None and ctx["time"] < self.next_time:
+            return self.next_time
+        return self.gen.next_interesting_time(ctx)
 
 
 class Sleep(Gen):
@@ -247,7 +308,7 @@ class Sleep(Gen):
             return None, self
         return PENDING, self
 
-    def next_interesting_time(self, ctx):
+    def next_interesting_time(self, ctx) -> float:
         t0 = ctx["time"] if self.t0 is None else self.t0
         return t0 + self.dt_ns
 
@@ -301,6 +362,13 @@ class Phases(Gen):
         p.gens = [self.gens[0].update(ctx, event)] + self.gens[1:]
         return p
 
+    def next_interesting_time(self, ctx) -> float:
+        # Advancement past an exhausted phase requires quiescence (a
+        # completion event), so the current phase alone bounds the time.
+        if not self.gens:
+            return math.inf
+        return self.gens[0].next_interesting_time(ctx)
+
 
 class OnProcesses(Gen):
     """Restricts a generator to a subset of processes. The basis for
@@ -323,13 +391,29 @@ class OnProcesses(Gen):
     def update(self, ctx, event):
         return OnProcesses(self.pred, self.gen.update(ctx, event))
 
+    def next_interesting_time(self, ctx) -> float:
+        return self.gen.next_interesting_time(ctx)
+
+
+class _NotNemesis:
+    """Picklable predicate: client processes only. Generator trees must
+    stay picklable end-to-end so runs can checkpoint/resume."""
+
+    def __call__(self, p):
+        return p != NEMESIS
+
+
+class _IsNemesis:
+    def __call__(self, p):
+        return p == NEMESIS
+
 
 def clients(gen):
-    return OnProcesses(lambda p: p != NEMESIS, gen)
+    return OnProcesses(_NotNemesis(), gen)
 
 
 def nemesis_gen(gen):
-    g = OnProcesses(lambda p: p == NEMESIS, gen)
+    g = OnProcesses(_IsNemesis(), gen)
     return g
 
 
@@ -356,6 +440,10 @@ class Any2(Gen):
     def update(self, ctx, event):
         return Any2(self.a.update(ctx, event) if self.a else None,
                     self.b.update(ctx, event) if self.b else None)
+
+    def next_interesting_time(self, ctx) -> float:
+        return min(self.a.next_interesting_time(ctx) if self.a else math.inf,
+                   self.b.next_interesting_time(ctx) if self.b else math.inf)
 
 
 def nemesis_wrap(nemesis_g, client_g):
@@ -387,6 +475,9 @@ class Filter(Gen):
     def update(self, ctx, event):
         return Filter(self.pred, self.gen.update(ctx, event))
 
+    def next_interesting_time(self, ctx) -> float:
+        return self.gen.next_interesting_time(ctx)
+
 
 class FMap(Gen):
     """Transforms emitted ops with f (jepsen gen/map)."""
@@ -404,6 +495,9 @@ class FMap(Gen):
     def update(self, ctx, event):
         return FMap(self.f, self.gen.update(ctx, event))
 
+    def next_interesting_time(self, ctx) -> float:
+        return self.gen.next_interesting_time(ctx)
+
 
 class MixG(Gen):
     """Random mixture of generators (clean implementation)."""
@@ -413,6 +507,13 @@ class MixG(Gen):
         self.rng = rng or random.Random(0)
 
     def op(self, ctx):
+        # Fruitless polls must be rng-neutral: the scan-ahead fast path
+        # polls once per *dispatch* while the per-round path polls once per
+        # *round*, and any draw consumed on a PENDING poll would make their
+        # op streams diverge (breaking scan/per-round equivalence and
+        # deterministic resume). Child successor states from fruitless
+        # polls are already discarded below for the same reason.
+        st = self.rng.getstate()
         live = list(range(len(self.gens)))
         pending = False
         while live:
@@ -429,7 +530,12 @@ class MixG(Gen):
             gens2 = list(self.gens)
             gens2[i] = g2
             return res, MixG(gens2, self.rng)
+        self.rng.setstate(st)
         return (PENDING if pending else None), self
+
+    def next_interesting_time(self, ctx) -> float:
+        return min((gen.next_interesting_time(ctx) for gen in self.gens),
+                   default=math.inf)
 
 
 def mix(gens, rng=None):

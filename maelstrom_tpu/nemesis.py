@@ -75,13 +75,15 @@ def package(faults: set, interval_s: float = 10.0):
     if "partition" not in faults:
         return {"generator": None, "final_generator": None}
 
-    def cycle():
-        while True:
-            yield g.sleep(interval_s)
-            yield {"f": "start-partition", "type": "invoke"}
-            yield g.sleep(interval_s)
-            yield {"f": "stop-partition", "type": "invoke"}
+    # g.cycle pickles (checkpoint/resume); Seq never mutates the pristine
+    # Sleep instances it re-yields each lap
+    schedule = g.cycle([
+        g.sleep(interval_s),
+        {"f": "start-partition", "type": "invoke"},
+        g.sleep(interval_s),
+        {"f": "stop-partition", "type": "invoke"},
+    ])
 
-    return {"generator": g.Seq(cycle()),
+    return {"generator": g.Seq(schedule),
             "final_generator": g.Once({"f": "stop-partition",
                                        "type": "invoke"})}

@@ -176,8 +176,14 @@ class TpuRunner:
         lat = test.get("latency") or {}
         mean_rounds = float(lat.get("mean", 0)) / self.ms_per_round
         n = len(nodes)
-        pool_cap = int(test.get("pool_cap") or max(
-            4096, 4 * n * self.program.outbox_cap))
+        if getattr(self.program, "is_edge", False):
+            # edge programs route node<->node traffic over static channels;
+            # the pool only ever holds in-flight *client requests*, so a
+            # tight pool keeps the per-round argsort cheap
+            default_pool = max(8 * self.concurrency, 64)
+        else:
+            default_pool = max(4096, 4 * n * self.program.outbox_cap)
+        pool_cap = int(test.get("pool_cap") or default_pool)
         self.cfg = T.NetConfig(
             n_nodes=n, n_clients=self.concurrency, pool_cap=pool_cap,
             inbox_cap=self.program.inbox_cap,
@@ -190,6 +196,9 @@ class TpuRunner:
             self.sim = self.sim.replace(
                 net=T.flaky(self.sim.net, float(test["p_loss"])))
         self.round_fn = make_round_fn(self.program, self.cfg)
+        self._scan_fn = None     # built lazily (only journal-less runs)
+        self._quiet_fn = None
+        self.max_scan = int(test.get("max_scan", 65536))
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
@@ -204,6 +213,12 @@ class TpuRunner:
                                          for i in range(self.concurrency)]
         self._dispatches = 0
         self._state_cache = None
+        # checkpoint/resume (no reference equivalent; SURVEY.md section 5.4)
+        ckpt_s = test.get("checkpoint_every")
+        self.checkpoint_every_rounds = (
+            int(float(ckpt_s) * 1000.0 / self.ms_per_round)
+            if ckpt_s else None)
+        self.nemesis = None
         self._bump = jax.jit(
             lambda sim, k: sim.replace(net=sim.net.replace(
                 round=sim.net.round + k)))
@@ -233,15 +248,55 @@ class TpuRunner:
     def _free_rotated(self, free, history):
         return g.rotate_free(free, self._dispatches)
 
+    def _scan_bound(self, gen, ctx, pending, r, next_ckpt,
+                    max_rounds) -> int:
+        """How many injection-free rounds may run in one compiled dispatch
+        without the host needing to look: bounded by the generator's next
+        interesting time, the earliest RPC timeout deadline, the next
+        checkpoint, and max_rounds. Always >= 1."""
+        import math
+        ns_pr = self.ms_per_round * 1e6
+        bound = r + self.max_scan
+        nt = gen.next_interesting_time(ctx)
+        if nt != math.inf:
+            bound = min(bound, int(math.ceil(nt / ns_pr)))
+        if pending:
+            bound = min(bound, min(v[3] for v in pending.values()))
+        if next_ckpt is not None:
+            bound = min(bound, next_ckpt)
+        bound = min(bound, max_rounds)
+        return max(bound - r, 1)
+
+    # --- checkpoint/resume (SURVEY.md section 5.4: the reference can't) ---
+
+    def _save_checkpoint(self, gen, history, pending, free, r):
+        from .. import checkpoint as cp
+        state = {
+            "fingerprint": cp.fingerprint(self.test),
+            "r": r,
+            "dispatches": self._dispatches,
+            "sim": self.sim,
+            "gen": gen,
+            "history": list(history),
+            "pending": dict(pending),
+            "free": set(free),
+            "intern": self.intern,
+            "nemesis_rng": (self.nemesis.rng.getstate()
+                            if self.nemesis else None),
+        }
+        path = cp.save(self.test["store_dir"], state)
+        log.info("checkpointed round %d -> %s", r, path)
+
     # --- main loop ---
 
-    def run(self) -> History:
+    def run(self, resume: dict | None = None) -> History:
         test, cfg, program = self.test, self.cfg, self.program
         N, C = cfg.n_nodes, self.concurrency
         gen = g.to_gen(test["generator"])
         nemesis = (TpuPartitionNemesis(self, self.nodes, test.get("seed", 0))
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
+        self.nemesis = nemesis
         processes = list(range(C)) + ([g.NEMESIS] if nemesis else [])
         free = set(processes)
         pending: dict[int, tuple] = {}   # mid -> (process, op, node_idx, deadline_round)
@@ -250,6 +305,27 @@ class TpuRunner:
         skip_chunk = max(int(10.0 / self.ms_per_round), 1)
 
         r = 0
+        if resume is not None:
+            r = resume["r"]
+            self._dispatches = resume["dispatches"]
+            self.sim = resume["sim"]
+            self._state_cache = None
+            gen = resume["gen"]
+            history = History(resume["history"])
+            pending = dict(resume["pending"])
+            free = set(resume["free"])
+            self.intern = resume["intern"]
+            if nemesis and resume.get("nemesis_rng") is not None:
+                nemesis.rng.setstate(resume["nemesis_rng"])
+            log.info("resumed at virtual round %d (%d history ops, "
+                     "%d in flight)", r, len(history), len(pending))
+            if self.journal is not None:
+                log.warning(
+                    "resume with journaling: net-journal rows and the "
+                    "Lamport diagram cover only rounds >= %d; "
+                    "history/results cover the whole run", r)
+        next_ckpt = (r + self.checkpoint_every_rounds
+                     if self.checkpoint_every_rounds else None)
         exhausted = False
         while r < max_rounds:
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
@@ -297,39 +373,63 @@ class TpuRunner:
 
             # fast-forward quiescent stretches (nothing in flight, nothing
             # to inject, program idle)
-            if (not inject_rows and not pending
-                    and self._pool_empty() and self._channels_empty()
-                    and self._program_quiescent()):
-                self.sim = self._bump(self.sim, jnp.int32(skip_chunk))
-                r += skip_chunk
+            if not inject_rows and not pending and self._quiet():
+                # land exactly on the generator's next interesting round
+                # (never overshoot: the scan path stops there too, and the
+                # two must stay observationally identical)
+                k = min(skip_chunk,
+                        self._scan_bound(gen, ctx, pending, r, next_ckpt,
+                                         max_rounds))
+                self.sim = self._bump(self.sim, jnp.int32(k))
+                r += k
+                if next_ckpt is not None and r >= next_ckpt:
+                    self._save_checkpoint(gen, history, pending, free, r)
+                    next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
-            inject = T.Msgs.empty(max(C, 1))
-            if inject_rows:
-                M = len(inject_rows)
-                proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
-                inject = inject.replace(
-                    valid=jnp.arange(max(C, 1)) < M,
-                    src=jnp.asarray(
-                        list(np.array(proc) + N) + [0] * (max(C, 1) - M),
-                        T.I32),
-                    dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
-                                     T.I32),
-                    type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
-                                     T.I32),
-                    a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M), T.I32),
-                    b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M), T.I32),
-                    c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M), T.I32))
-                base_mid = int(self.sim.net.next_mid)
-                for j, (p, o, ni, *_rest) in enumerate(inject_rows):
-                    pending[base_mid + j] = (p, o, ni,
-                                             r + self.timeout_rounds)
+            if inject_rows or self.journal is not None:
+                inject = T.Msgs.empty(max(C, 1))
+                if inject_rows:
+                    M = len(inject_rows)
+                    proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
+                    inject = inject.replace(
+                        valid=jnp.arange(max(C, 1)) < M,
+                        src=jnp.asarray(
+                            list(np.array(proc) + N) + [0] * (max(C, 1) - M),
+                            T.I32),
+                        dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
+                                         T.I32),
+                        type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
+                                         T.I32),
+                        a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M),
+                                      T.I32),
+                        b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M),
+                                      T.I32),
+                        c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
+                                      T.I32))
+                    base_mid = int(self.sim.net.next_mid)
+                    for j, (p, o, ni, *_rest) in enumerate(inject_rows):
+                        pending[base_mid + j] = (p, o, ni,
+                                                 r + self.timeout_rounds)
 
-            self.sim, client_msgs, io = self.round_fn(self.sim, inject)
-            self._state_cache = None
-            if self.journal is not None:
-                self._journal_round(io, client_msgs, r)
-            r += 1
+                self.sim, client_msgs, io = self.round_fn(self.sim, inject)
+                self._state_cache = None
+                if self.journal is not None:
+                    self._journal_round(io, client_msgs, r)
+                r += 1
+            else:
+                # nothing to inject and no journal: cross the idle stretch
+                # in one compiled dispatch (early exit on any client reply)
+                if self._scan_fn is None:
+                    from ..sim import make_scan_fn
+                    self._scan_fn = make_scan_fn(program, cfg)
+                k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+                                         max_rounds)
+                self.sim, client_msgs, k = self._scan_fn(
+                    self.sim, jnp.int32(k_max))
+                self._state_cache = None
+                client_msgs, k = jax.device_get((client_msgs, k))
+                r += int(k)
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
@@ -365,6 +465,10 @@ class TpuRunner:
                 completed = {**op, "type": "info", "error": "net-timeout"}
                 gen = self._complete(history, gen, ctx, process, completed,
                                      free)
+
+            if next_ckpt is not None and r >= next_ckpt:
+                self._save_checkpoint(gen, history, pending, free, r)
+                next_ckpt = r + self.checkpoint_every_rounds
 
         if r >= max_rounds:
             log.warning("TPU runner hit max_rounds=%d", max_rounds)
@@ -442,20 +546,23 @@ class TpuRunner:
                 senders.astype(np.int32), m_i.astype(np.int32),
                 node_names=self.node_names)
 
-    def _pool_empty(self) -> bool:
-        return not bool(self.sim.net.pool.valid.any())
+    def _quiet(self) -> bool:
+        """Fused quiescence probe, one jitted dispatch: pool empty (no
+        in-flight messages) AND edge channels drained (ring cells are
+        addressed by round % ring, so rings must empty before virtual time
+        may skip) AND the node program reports itself idle."""
+        if self._quiet_fn is None:
+            prog_q = getattr(self.program, "quiescent", None)
 
-    def _channels_empty(self) -> bool:
-        """Edge rings must drain before virtual time may skip ahead
-        (ring cells are addressed by round % ring)."""
-        ch = self.sim.channels
-        return ch is None or not bool(ch.valid.any())
-
-    def _program_quiescent(self) -> bool:
-        q = getattr(self.program, "quiescent", None)
-        if q is None:
-            return True
-        return bool(q(self.sim.nodes))
+            def quiet(sim):
+                q = ~sim.net.pool.valid.any()
+                if sim.channels is not None:
+                    q = q & ~sim.channels.valid.any()
+                if prog_q is not None:
+                    q = q & prog_q(sim.nodes)
+                return q
+            self._quiet_fn = jax.jit(quiet)
+        return bool(self._quiet_fn(self.sim))
 
 
 def run_tpu_test(test: dict, test_dir: str) -> dict:
@@ -468,8 +575,16 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
         else None
 
-    history = runner.run()
+    resume = None
+    if test.get("resume"):
+        from .. import checkpoint as cp
+        resume = cp.load(test["resume"])
+        cp.check_fingerprint(resume, test)
+
+    history = runner.run(resume=resume)
     results = test["checker"].check(test, history, {})
+    if resume is not None:
+        results["resumed-at-round"] = resume["r"]
     if runner.journal is not None:
         runner.journal.close()
     store.write_history(test_dir, history)

@@ -35,3 +35,15 @@ def sort_clients(node_ids):
 def majority(n: int) -> int:
     """Smallest majority of n."""
     return n // 2 + 1
+
+
+def honor_jax_platforms():
+    """Re-asserts the JAX_PLATFORMS env var as jax config. Some images
+    register an experimental backend from sitecustomize and programmatically
+    override the env var (e.g. tunneled-TPU 'axon'); calling this makes the
+    user's choice win again. The CLI calls it at startup; library users
+    embedding maelstrom_tpu can call it before building simulations."""
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

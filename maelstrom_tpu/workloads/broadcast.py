@@ -7,7 +7,6 @@ surface) and, for the TPU path, as dense neighbor index arrays."""
 
 from __future__ import annotations
 
-import itertools
 import math
 
 from .. import generators as g
@@ -161,7 +160,7 @@ def workload(opts: dict) -> dict:
     return {
         "client": BroadcastClient(opts["net"]),
         "generator": g.mix([
-            g.Seq({"f": "broadcast", "value": x} for x in itertools.count()),
+            g.Counting("broadcast"),
             g.Repeat({"f": "read"})]),
         "final_generator": g.each_thread({"f": "read", "final": True}),
         "checker": BroadcastChecker(),

@@ -29,11 +29,19 @@ class EchoClient(BaseClient):
         return with_errors(op, set(), go)
 
 
+class EchoOpGen:
+    """Picklable op source (generator trees checkpoint/resume)."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+
+    def __call__(self):
+        return {"f": "echo", "value": f"Please echo {self.rng.randrange(128)}"}
+
+
 def workload(opts: dict) -> dict:
-    rng = random.Random(opts.get("seed", 0))
     return {
         "client": EchoClient(opts["net"]),
-        "generator": g.Fn(lambda: {"f": "echo",
-                                   "value": f"Please echo {rng.randrange(128)}"}),
+        "generator": g.Fn(EchoOpGen(opts.get("seed", 0))),
         "checker": EchoChecker(),
     }

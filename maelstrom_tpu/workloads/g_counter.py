@@ -10,9 +10,12 @@ from .. import generators as g
 from . import pn_counter
 
 
+def non_negative(op: dict) -> bool:
+    """Drop negative-delta adds (picklable Filter predicate)."""
+    return not (op.get("f") == "add" and op.get("value", 0) < 0)
+
+
 def workload(opts: dict) -> dict:
     w = pn_counter.workload(opts)
-    w["generator"] = g.Filter(
-        lambda op: not (op.get("f") == "add" and op.get("value", 0) < 0),
-        w["generator"])
+    w["generator"] = g.Filter(non_negative, w["generator"])
     return w

@@ -2,8 +2,6 @@
 
 from __future__ import annotations
 
-import itertools
-
 from .. import generators as g
 from .. import schema as S
 from ..client import defrpc, with_errors
@@ -43,7 +41,7 @@ def workload(opts: dict) -> dict:
     return {
         "client": GSetClient(opts["net"]),
         "generator": g.mix([
-            g.Seq({"f": "add", "value": x} for x in itertools.count()),
+            g.Counting("add"),
             g.Repeat({"f": "read"})]),
         "final_generator": g.each_thread({"f": "read", "final": True}),
         "checker": SetFullChecker(),

@@ -78,25 +78,31 @@ class LinKVClient(BaseClient):
         return with_errors(op, {"read"}, go)
 
 
-def generator(opts):
+class KVOpGen:
     """Independent per-key register ops, rotating through keys like
     jepsen.independent/concurrent-generator: each key sees a bounded number
-    of ops, then a fresh key starts."""
-    rng = random.Random(opts.get("seed", 0))
-    ops_per_key = opts.get("ops_per_key", 40)
-    counter = {"n": 0}
+    of ops, then a fresh key starts. Picklable (checkpoint/resume)."""
 
-    def gen_op():
-        key = counter["n"] // ops_per_key
-        counter["n"] += 1
-        r = rng.random()
+    def __init__(self, seed: int, ops_per_key: int):
+        self.rng = random.Random(seed)
+        self.ops_per_key = ops_per_key
+        self.n = 0
+
+    def __call__(self):
+        key = self.n // self.ops_per_key
+        self.n += 1
+        r = self.rng.random()
         if r < 0.5:
             return {"f": "read", "value": [key, None]}
         if r < 0.8:
-            return {"f": "write", "value": [key, rng.randrange(5)]}
+            return {"f": "write", "value": [key, self.rng.randrange(5)]}
         return {"f": "cas",
-                "value": [key, [rng.randrange(5), rng.randrange(5)]]}
-    return g.Fn(gen_op)
+                "value": [key, [self.rng.randrange(5),
+                                self.rng.randrange(5)]]}
+
+
+def generator(opts):
+    return g.Fn(KVOpGen(opts.get("seed", 0), opts.get("ops_per_key", 40)))
 
 
 def workload(opts: dict) -> dict:

@@ -40,12 +40,22 @@ class PNCounterClient(BaseClient):
         return with_errors(op, {"read"}, go)
 
 
+class AddOpGen:
+    """Picklable op source: add with delta in [-5, 4]
+    (reference `pn_counter.clj:127-133`)."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+
+    def __call__(self):
+        return {"f": "add", "value": self.rng.randint(-5, 4)}
+
+
 def workload(opts: dict) -> dict:
-    rng = random.Random(opts.get("seed", 0))
     return {
         "client": PNCounterClient(opts["net"]),
         "generator": g.mix([
-            g.Fn(lambda: {"f": "add", "value": rng.randint(-5, 4)}),
+            g.Fn(AddOpGen(opts.get("seed", 0))),
             g.Repeat({"f": "read"})]),
         "final_generator": g.each_thread({"f": "read", "final": True}),
         "checker": PNCounterChecker(),

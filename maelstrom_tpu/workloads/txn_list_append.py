@@ -48,35 +48,42 @@ class TxnClient(BaseClient):
         return with_errors(op, set(), go)
 
 
-def generator(opts):
+class TxnOpGen:
     """Random transactions over a sliding window of keys, honoring
     --key-count, --max-txn-length, --max-writes-per-key
-    (reference `txn_list_append.clj:112-124` via jepsen append/test)."""
-    rng = random.Random(opts.get("seed", 0))
-    key_count = opts.get("key_count") or 10
-    max_txn_length = opts.get("max_txn_length", 4)
-    min_txn_length = opts.get("min_txn_length", 1)
-    max_writes = opts.get("max_writes_per_key", 16)
-    state = {"base": 0, "appends": {}}
+    (reference `txn_list_append.clj:112-124` via jepsen append/test).
+    Picklable (checkpoint/resume)."""
 
-    def next_value(k):
-        state["appends"][k] = state["appends"].get(k, 0) + 1
-        if state["appends"][k] >= max_writes:
+    def __init__(self, opts: dict):
+        self.rng = random.Random(opts.get("seed", 0))
+        self.key_count = opts.get("key_count") or 10
+        self.max_txn_length = opts.get("max_txn_length", 4)
+        self.min_txn_length = opts.get("min_txn_length", 1)
+        self.max_writes = opts.get("max_writes_per_key", 16)
+        self.base = 0
+        self.appends: dict = {}
+
+    def _next_value(self, k):
+        self.appends[k] = self.appends.get(k, 0) + 1
+        if self.appends[k] >= self.max_writes:
             # retire the oldest active key by advancing the window
-            state["base"] += 1
-        return state["appends"][k]
+            self.base += 1
+        return self.appends[k]
 
-    def gen_op():
-        length = rng.randint(min_txn_length, max_txn_length)
+    def __call__(self):
+        length = self.rng.randint(self.min_txn_length, self.max_txn_length)
         txn = []
         for _ in range(length):
-            k = state["base"] + rng.randrange(key_count)
-            if rng.random() < 0.5:
+            k = self.base + self.rng.randrange(self.key_count)
+            if self.rng.random() < 0.5:
                 txn.append(["r", k, None])
             else:
-                txn.append(["append", k, next_value(k)])
+                txn.append(["append", k, self._next_value(k)])
         return {"f": "txn", "value": txn}
-    return g.Fn(gen_op)
+
+
+def generator(opts):
+    return g.Fn(TxnOpGen(opts))
 
 
 def workload(opts: dict) -> dict:

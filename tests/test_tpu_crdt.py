@@ -6,8 +6,10 @@ from maelstrom_tpu import core
 
 
 def run(opts):
+    # journal_rows off: engages the compiled scan-ahead fast path (the
+    # journal needs per-round io; Lamport viz is covered by other tests)
     base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=11,
-                rate=20.0, time_limit=2.0)
+                rate=20.0, time_limit=2.0, journal_rows=False)
     return core.run({**base, **opts})
 
 
@@ -23,7 +25,7 @@ def test_g_set_tpu_fanout_with_loss():
     """BASELINE config shape: gossip fanout 3 + 5% message loss."""
     res = run({"workload": "g-set", "node": "tpu:g-set", "node_count": 20,
                "gossip_fanout": 3, "p_loss": 0.05, "time_limit": 2.0,
-               "recovery_s": 3})
+               "recovery_s": 3, "ms_per_round": 5.0})
     assert res["valid"] is True, res["workload"]
     assert res["workload"]["lost-count"] == 0
 

@@ -9,8 +9,10 @@ from maelstrom_tpu import core
 
 
 def run(opts):
+    # journal_rows off by default: engages the compiled scan-ahead fast
+    # path. The grid test below keeps it on to cover TPU-path journaling.
     base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=7,
-                rate=20.0, time_limit=2.0)
+                rate=20.0, time_limit=2.0, journal_rows=False)
     return core.run({**base, **opts})
 
 
@@ -25,14 +27,18 @@ def test_echo_tpu_e2e():
 
 
 def test_broadcast_tpu_e2e_grid():
+    import os
     res = run({"workload": "broadcast", "node": "tpu:broadcast",
-               "node_count": 5, "topology": "grid"})
+               "node_count": 5, "topology": "grid", "journal_rows": True})
     assert res["valid"] is True, res["workload"]
     w = res["workload"]
     assert w["valid"] is True
     assert w["stable-count"] > 0 and w["lost-count"] == 0
     # gossip happened between servers
     assert res["net"]["servers"]["send-count"] > 0
+    # TPU-path journaling produced a Lamport diagram
+    latest = "/tmp/maelstrom-tpu-test-store/latest"
+    assert os.path.exists(os.path.join(latest, "messages.svg"))
 
 
 def test_broadcast_tpu_e2e_line_with_latency():

@@ -13,7 +13,7 @@ from maelstrom_tpu.net import tpu as T
 
 def run(opts):
     base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=3,
-                rate=10.0, time_limit=3.0)
+                rate=10.0, time_limit=3.0, journal_rows=False)
     return core.run({**base, **opts})
 
 

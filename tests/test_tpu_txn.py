@@ -23,7 +23,7 @@ def test_txn_list_append_tpu_raft():
     res = core.run({"workload": "txn-list-append",
                     "node": "tpu:txn-list-append",
                     "node_count": 5, "rate": 10.0, "time_limit": 3.0,
-                    "seed": 9,
+                    "seed": 9, "journal_rows": False,
                     "store_root": "/tmp/maelstrom-tpu-test-store"})
     assert res["valid"] is True, res["workload"]
     assert res["workload"]["valid"] is True
@@ -35,7 +35,7 @@ def test_txn_list_append_tpu_raft_partition():
                     "node": "tpu:txn-list-append",
                     "node_count": 5, "rate": 10.0, "time_limit": 4.0,
                     "nemesis": {"partition"}, "nemesis_interval": 1.0,
-                    "seed": 9,
+                    "seed": 9, "journal_rows": False,
                     "store_root": "/tmp/maelstrom-tpu-test-store"})
     assert res["valid"] is True, res["workload"]
     assert res["workload"]["valid"] is True
