@@ -103,8 +103,8 @@ def main():
     N = int(os.environ.get("BENCH_NODES", 100_000))
     V = int(os.environ.get("BENCH_VALUES", 64))
     # 700 rounds: injections end at round 128 and the deterministic
-    # zero-latency grid flood completes before 700 (converged is asserted
-    # in the output); more rounds only add idle tail to the wall clock
+    # zero-latency grid flood completes before 700 (the run exits nonzero
+    # if convergence is ever lost); more rounds only add idle tail
     R = int(os.environ.get("BENCH_ROUNDS", 700))
     # rounds per scan dispatch: long single dispatches (>~60 s device time)
     # are killed by the remote-TPU tunnel, so the scan is chunked
@@ -190,6 +190,10 @@ def main():
         "eager_resend": eager,
         "dropped_overflow": st["dropped_overflow"],
     }))
+    # a non-converged or lossy run is not a valid benchmark: fail loudly
+    # (after emitting the JSON record)
+    if not converged or st["dropped_overflow"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -11,7 +11,10 @@ Wraps a Node around any CRDT value exposing:
 and serves:
 
   {type: "read"}               -> {type: "read_ok", value: <read()>}
-  {type: "merge", value: <j>}  -> {type: "merge_ok"}   (gossip ingest)
+  {type: "merge", value: <j>}  -> merges into local state; acked with
+                                  {type: "merge_ok"} only when the request
+                                  carries a msg_id (gossip replication is
+                                  fire-and-forget and gets no reply)
 
 replicating the full state to every other node every `interval_s` seconds.
 Ships three value types: GSet, GCounter, PNCounter.

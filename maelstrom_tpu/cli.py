@@ -90,6 +90,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _check_models(spec: str) -> list[str]:
+    """Validates --consistency-models against the checker's known model
+    names (the reference validates against elle's, `core.clj:126-131`)."""
+    from .checkers.elle import MODELS
+    models = [m.strip() for m in spec.split(",") if m.strip()]
+    unknown = [m for m in models if m not in MODELS]
+    if unknown or not models:
+        raise SystemExit(
+            f"unknown consistency model(s) {unknown or [spec]}; expected "
+            f"any of {MODELS}")
+    return models
+
+
 def opts_from_args(args) -> dict:
     opts = {
         "workload": args.workload,
@@ -108,7 +121,7 @@ def opts_from_args(args) -> dict:
         "key_count": args.key_count,
         "max_txn_length": args.max_txn_length,
         "max_writes_per_key": args.max_writes_per_key,
-        "consistency_models": args.consistency_models.split(","),
+        "consistency_models": _check_models(args.consistency_models),
         "log_stderr": args.log_stderr,
         "log_net_send": args.log_net_send,
         "log_net_recv": args.log_net_recv,

@@ -147,5 +147,6 @@ def run(opts: dict) -> dict:
     store.write_history(test_dir, history)
     store.write_results(test_dir, results)
     store.write_test(test_dir, {k: test[k] for k in DEFAULTS if k in test})
+    store.mark_complete(test_dir)
     log.info("Results valid? %s (store: %s)", results["valid"], test_dir)
     return results

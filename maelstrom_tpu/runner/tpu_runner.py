@@ -592,5 +592,6 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     from ..core import DEFAULTS
     store.write_test(test_dir, {k: str(test[k]) for k in DEFAULTS
                                 if k in test})
+    store.mark_complete(test_dir)
     log.info("Results valid? %s (store: %s)", results["valid"], test_dir)
     return results

@@ -28,9 +28,21 @@ def make_test_dir(root: str, test_name: str) -> str:
     ts = datetime.now().strftime("%Y%m%dT%H%M%S.%f")[:-3]
     d = os.path.join(root, test_name, ts)
     os.makedirs(d, exist_ok=True)
-    _relink(os.path.join(root, test_name, "latest"), ts)
-    _relink(os.path.join(root, "latest"), os.path.join(test_name, ts))
+    # store/current points at the run in progress; the `latest` links only
+    # move when a run completes (mark_complete), mirroring the reference's
+    # current/latest distinction (doc/results.md:4-5)
+    _relink(os.path.join(root, "current"), os.path.join(test_name, ts))
     return d
+
+
+def mark_complete(d: str):
+    """Repoints the `latest` symlinks at a finished run. `d` is the dir
+    make_test_dir returned (root/<test-name>/<timestamp>)."""
+    d = os.path.normpath(d)
+    test_dir, ts = os.path.split(d)
+    root, test_name = os.path.split(test_dir)
+    _relink(os.path.join(test_dir, "latest"), ts)
+    _relink(os.path.join(root, "latest"), os.path.join(test_name, ts))
 
 
 def _relink(link: str, target: str):
@@ -45,6 +57,12 @@ def _relink(link: str, target: str):
 def write_history(d: str, history):
     with open(os.path.join(d, "history.jsonl"), "w") as f:
         f.write(history.to_jsonl() + "\n")
+    # condensed human-readable view (reference history.txt,
+    # doc/results.md:23-25): process, type, f, value, error
+    with open(os.path.join(d, "history.txt"), "w") as f:
+        for o in history:
+            err = "" if o.error is None else f"\t{o.error}"
+            f.write(f"{o.process}\t{o.type}\t{o.f}\t{o.value}{err}\n")
 
 
 def write_results(d: str, results: dict):

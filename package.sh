@@ -1,0 +1,18 @@
+#!/bin/bash
+# Builds a distribution tarball (the counterpart of the reference's
+# package.sh: clean, regenerate docs, run the test suite, package).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+VERSION="${1:-0.1.0}"
+OUT="maelstrom-tpu-${VERSION}"
+
+python3 -m maelstrom_tpu doc
+python3 -m pytest tests/ -q
+
+rm -rf "dist/$OUT" "dist/$OUT.tar.bz2"
+mkdir -p "dist/$OUT"
+cp -r maelstrom_tpu demo doc pkg README.md bench.py "dist/$OUT/"
+find "dist/$OUT" -name __pycache__ -type d -exec rm -rf {} +
+tar -C dist -cjf "dist/$OUT.tar.bz2" "$OUT"
+echo "dist/$OUT.tar.bz2"
