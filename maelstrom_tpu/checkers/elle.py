@@ -237,30 +237,86 @@ def analyze(history) -> dict:
                 strongconnect(v)
         return sccs
 
-    def classify(scc, edge_set):
+    def txn_ids(scc):
+        return sorted(x for x in scc if not isinstance(x, tuple))
+
+    txn_by_id = {t["id"]: t for t in txns}
+    _KIND_PRIO = {"rw": 0, "wr": 1, "ww": 2, "rt": 3}
+
+    def explain(scc, edge_set):
+        """Renders one concrete cycle through the SCC, Elle-style:
+        'T1 -[ww]-> T2 -[rw]-> T1', plus each txn's micro-ops — the
+        human-readable evidence for the anomaly. The walk prefers rw >
+        wr > ww > rt edges so the rarest dependency kinds (the ones that
+        drive the classification) appear in the witness; runs of realtime
+        barrier hops are collapsed into single '-[rt]->' steps. Returns
+        (text, ops, kinds-on-the-cycle) so the caller can classify the
+        *rendered* cycle — the label always matches the evidence."""
         ids = set(scc)
-        kinds = {kind for a, b, kind in edge_set
-                 if a in ids and b in ids}
-        inner = kinds - {"rt"}
+        adj: dict = {}
+        kinds: dict = {}
+        for a, b, k in edge_set:
+            if a in ids and b in ids:
+                adj.setdefault(a, []).append(b)
+                # prefer data edges over rt when parallel edges exist
+                if (a, b) not in kinds or _KIND_PRIO[k] < _KIND_PRIO[
+                        kinds[(a, b)]]:
+                    kinds[(a, b)] = k
+
+        def choice_key(u):
+            def key(v):
+                return (_KIND_PRIO[kinds[(u, v)]], repr(v))
+            return key
+
+        # greedy walk until a node repeats: yields a simple cycle
+        cur = next((x for x in scc if not isinstance(x, tuple)), scc[0])
+        path, seen = [cur], {cur: 0}
+        while True:
+            cur = sorted(adj[cur], key=choice_key(cur))[0]
+            if cur in seen:
+                cyc = path[seen[cur]:] + [cur]
+                break
+            seen[cur] = len(path)
+            path.append(cur)
+
+        # collapse barrier nodes: Ta -> (barriers...) -> Tb == Ta -[rt]-> Tb
+        steps = []
+        last_txn = cyc[0]
+        via_rt = False
+        for u, v in zip(cyc, cyc[1:]):
+            if isinstance(v, tuple):
+                via_rt = True
+                continue
+            kind = "rt" if via_rt else kinds[(u, v)]
+            steps.append((last_txn, v, kind))
+            last_txn, via_rt = v, False
+        text = "  ".join(f"T{a} -[{k}]-> T{b}" for a, b, k in steps)
+        ops = {f"T{i}": txn_by_id[i]["micro"]
+               for i in txn_ids(cyc) if i in txn_by_id}
+        return text, ops, [k for _a, _b, k in steps]
+
+    def classify_steps(kinds_used):
+        inner = set(kinds_used) - {"rt"}
         if inner <= {"ww"}:
             return "G0"
         if inner <= {"ww", "wr"}:
             return "G1c"
-        rw_count = sum(1 for a, b, k in edge_set
-                       if a in ids and b in ids and k == "rw")
-        return "G-single" if rw_count == 1 else "G2"
-
-    def txn_ids(scc):
-        return sorted(x for x in scc if not isinstance(x, tuple))
+        rw = sum(1 for k in kinds_used if k == "rw")
+        return "G-single" if rw == 1 else "G2"
 
     base_sccs = cycles_with(edges)
     for scc in base_sccs:
-        add_anom(classify(scc, edges), {"txns": txn_ids(scc)})
+        text, ops, kinds_used = explain(scc, edges)
+        add_anom(classify_steps(kinds_used),
+                 {"txns": txn_ids(scc), "cycle": text, "txn-ops": ops})
     base_cycle_ids = {frozenset(txn_ids(s)) for s in base_sccs}
     for scc in cycles_with(edges | rt_edges):
         if frozenset(txn_ids(scc)) not in base_cycle_ids:
-            add_anom(classify(scc, edges | rt_edges) + "-realtime",
-                     {"txns": txn_ids(scc)})
+            text, ops, kinds_used = explain(scc, edges | rt_edges)
+            if "rt" not in kinds_used:
+                continue    # a pure data cycle is a base anomaly, not rt
+            add_anom(classify_steps(kinds_used) + "-realtime",
+                     {"txns": txn_ids(scc), "cycle": text, "txn-ops": ops})
 
     return anomalies
 

@@ -87,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
     b = sub.add_parser("bench", help="Run the TPU benchmark")
     b.add_argument("--nodes", type=int, default=100_000)
     b.add_argument("--rounds", type=int, default=200)
+
+    f = sub.add_parser("fuzz", help="Broadcast fuzz: partitions + latency "
+                                    "sweep at scale (BASELINE config 5)")
+    f.add_argument("--nodes", type=int, default=4096)
+    f.add_argument("--values", type=int, default=32)
+    f.add_argument("--seed", type=int, default=0)
     return p
 
 
@@ -230,6 +236,10 @@ def main(argv=None) -> int:
         return subprocess.call([sys.executable, "bench.py",
                                 "--nodes", str(args.nodes),
                                 "--rounds", str(args.rounds)])
+
+    if args.cmd == "fuzz":
+        from .fuzz import main as fuzz_main
+        return fuzz_main(args.nodes, args.values, args.seed)
     return 1
 
 

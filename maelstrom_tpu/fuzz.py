@@ -1,0 +1,152 @@
+"""Broadcast fuzz harness: partitions + latency sweep at scale.
+
+The last BASELINE.json graded config ("broadcast fuzz: 100k nodes, random
+partitions + latency sweep") and SURVEY.md build step 9: drive the
+compiled broadcast simulation through a sweep of fault configurations —
+latency distributions, message loss, random partitions injected
+mid-broadcast and healed — and verify the workload's safety property
+directly on the final state: every node saw every value (the essence of
+the set-full checker: lost-count == 0), with zero silent drops.
+
+Each config runs entirely in `lax.scan` chunks; partitions flip between
+chunks (the nemesis acting at chunk boundaries). Usage:
+
+    python -m maelstrom_tpu fuzz --nodes 100000          # full sweep
+    python -m maelstrom_tpu fuzz --nodes 4096 --seed 7   # quick
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+DEFAULT_SWEEP = [
+    {"name": "zero-latency+partition", "latency": 0, "dist": "constant",
+     "p_loss": 0.0, "partition": True},
+    {"name": "latency2+loss5%+partition", "latency": 2, "dist": "constant",
+     "p_loss": 0.05, "partition": True},
+    {"name": "uniform-latency+partition", "latency": 2, "dist": "uniform",
+     "p_loss": 0.0, "partition": True},
+    {"name": "exponential-latency+loss2%", "latency": 2,
+     "dist": "exponential", "p_loss": 0.02, "partition": False},
+]
+
+
+def fuzz_broadcast(n_nodes: int = 4096, values: int = 32,
+                   sweep=None, seed: int = 0, chunk: int = 100,
+                   max_rounds: int = 20_000, log=print) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from .net import tpu as T
+    from .nodes import get_program
+    from .nodes.broadcast import T_BCAST
+    from .sim import make_run_fn, make_sim
+
+    rng = np.random.default_rng(seed)
+    results = []
+    for ci, c in enumerate(sweep or DEFAULT_SWEEP):
+        nodes = [f"n{i}" for i in range(n_nodes)]
+        program = get_program(
+            "broadcast",
+            {"topology": "grid", "max_values": values,
+             "latency": {"mean": c["latency"], "dist": c["dist"]},
+             "ms_per_round": 1.0},
+            nodes)
+        cfg = T.NetConfig(
+            n_nodes=n_nodes, n_clients=1, pool_cap=max(64, 2 * values),
+            inbox_cap=program.inbox_cap, client_cap=0,
+            latency_mean_rounds=float(c["latency"]),
+            latency_dist=c["dist"])
+        run_fn = make_run_fn(program, cfg)
+        sim = make_sim(program, cfg, seed=seed + ci)
+        if c["p_loss"]:
+            sim = sim.replace(net=T.flaky(sim.net, c["p_loss"]))
+
+        # injections target a 4-chunk span (step clamps at one per round,
+        # so large value counts extend it); the partition covers chunks
+        # 1-2, so values born inside the partitioned cluster must cross
+        # after healing (the nemesis flips at chunk boundaries, where the
+        # host regains control of the scan). Convergence may only be
+        # declared once the LAST injection round has passed.
+        step = max(1, 4 * chunk // values)
+        inj_rounds = step * values
+        inj_span = -(-inj_rounds // chunk) * chunk
+
+        def make_chunk(r0):
+            rr = np.arange(r0, r0 + chunk)
+            on = (rr % step == 0) & (rr // step < values)
+            val = (rr // step) % values
+            dest = (val.astype(np.int64) * 2654435761) % n_nodes
+            return T.Msgs.empty((chunk, 1)).replace(
+                valid=jnp.asarray(on[:, None]),
+                src=jnp.full((chunk, 1), n_nodes, T.I32),
+                dest=jnp.asarray(dest.astype(np.int32)[:, None]),
+                type=jnp.full((chunk, 1), T_BCAST, T.I32),
+                a=jnp.asarray(val.astype(np.int32)[:, None]))
+
+        # partition window: cuts the cluster into 2 random components
+        # while values are still being injected, heals afterwards
+        part_from, part_until = chunk, 3 * chunk
+        labels = rng.integers(0, 2, size=n_nodes).tolist()
+
+        t0 = time.perf_counter()
+        r = 0
+        converged_at = None
+        partitioned = False
+        while r < max_rounds:
+            want = c["partition"] and part_from <= r < part_until
+            if want != partitioned:      # flip fault state at boundaries
+                sim = sim.replace(
+                    net=(T.partition_components(sim.net, labels) if want
+                         else T.heal(sim.net)))
+                partitioned = want
+            sim, _counts = run_fn(sim, make_chunk(r))
+            r += chunk
+            if r >= inj_span:
+                seen = jax.device_get(sim.nodes["seen"][:, :values])
+                # like the set-full checker, a value whose *injection* was
+                # eaten by message loss is indeterminate (no node ever saw
+                # it) and doesn't count against convergence; every value
+                # that was born must reach every node
+                born = seen.any(axis=0)
+                if ((seen.all(axis=0) == born).all()
+                        and not (c["partition"] and r < part_until)):
+                    converged_at = r
+                    n_born = int(born.sum())
+                    break
+        dt = time.perf_counter() - t0
+
+        st = T.stats_dict(sim.net)
+        ch = sim.channels
+        overwrites = int(jax.device_get(ch.overwrites)) if ch is not None \
+            else 0
+        ok = (converged_at is not None and st["dropped_overflow"] == 0)
+        res = {
+            "config": c["name"], "nodes": n_nodes, "values": values,
+            "values_born": n_born if converged_at is not None else None,
+            "ok": bool(ok), "converged_at_round": converged_at,
+            "wall_s": round(dt, 2),
+            "delivered": st["recv_all"], "lost": st["lost"],
+            "dropped_partition": st["dropped_partition"],
+            "dropped_overflow": st["dropped_overflow"],
+            "channel_overwrites": overwrites,
+        }
+        results.append(res)
+        log(json.dumps(res))
+    return results
+
+
+def main(n_nodes: int, values: int, seed: int) -> int:
+    results = fuzz_broadcast(n_nodes=n_nodes, values=values, seed=seed)
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({"fuzz": "broadcast", "configs": len(results),
+                      "all_ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 4096, 32, 0))

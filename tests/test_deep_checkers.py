@@ -173,3 +173,19 @@ def test_elle_g1b_intermediate_read():
     _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 4, 5)
     r = ElleListAppendChecker().check({}, h)
     assert r["valid"] is False and "G1b" in r["anomalies"]
+
+
+def test_elle_cycle_explanation_rendered():
+    """Anomalies carry a concrete rendered cycle and the evidence ops
+    (the Elle-style human-readable explanation)."""
+    h = []
+    _txn_pair(h, [["append", 8, 1], ["append", 9, 2]],
+              [["append", 8, 1], ["append", 9, 2]], 0, 1, proc=0)
+    _txn_pair(h, [["r", 8, None], ["r", 9, None]],
+              [["r", 8, [1]], ["r", 9, []]], 0, 1, proc=1)
+    _txn_pair(h, [["r", 9, None]], [["r", 9, [2]]], 2, 3, proc=0)
+    r = ElleListAppendChecker().check({}, h)
+    assert r["valid"] is False
+    (anom,) = r["anomalies"]["G-single"]
+    assert "-[rw]->" in anom["cycle"] and "-[wr]->" in anom["cycle"]
+    assert anom["txn-ops"]["T0"] == [["append", 8, 1], ["append", 9, 2]]

@@ -1,0 +1,85 @@
+"""GSPMD sharding correctness: running the vmapped cluster round over a
+("dp", "sp") device mesh must produce bit-identical results to running it
+unsharded on one device. Sharding annotations change *placement*, never
+semantics — XLA inserts the collectives; this pins that the spec choices
+(cluster axis over dp, node/pool axes over sp) don't silently alter the
+simulation. Runs on the 8 virtual CPU devices from conftest."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from maelstrom_tpu.net import tpu as T
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.parallel import (make_cluster_round_fn, make_cluster_sims,
+                                    mesh_for, sim_shardings)
+
+
+def _build(n_nodes=8, n_clusters=4):
+    nodes = [f"n{i}" for i in range(n_nodes)]
+    program = get_program(
+        "broadcast",
+        {"topology": "grid", "max_values": 8, "latency": {"mean": 0}},
+        nodes)
+    cfg = T.NetConfig(n_nodes=n_nodes, n_clients=1, pool_cap=64,
+                      inbox_cap=program.inbox_cap, client_cap=0)
+    return program, cfg
+
+
+def _inject(n_clusters, n_nodes, value, dest):
+    from maelstrom_tpu.nodes.broadcast import T_BCAST
+    inj = T.Msgs.empty((n_clusters, 2))
+    return inj.replace(
+        valid=inj.valid.at[:, 0].set(True),
+        src=jnp.full_like(inj.src, n_nodes),
+        dest=inj.dest.at[:, 0].set(dest),
+        type=jnp.full_like(inj.type, T_BCAST),
+        a=inj.a.at[:, 0].set(value))
+
+
+def test_mesh_for_factorizations():
+    mesh = mesh_for(8)
+    assert mesh.shape["dp"] * mesh.shape["sp"] == 8
+    mesh2 = mesh_for(8, dp=4)
+    assert mesh2.shape["dp"] == 4 and mesh2.shape["sp"] == 2
+
+
+def test_sharded_cluster_round_matches_unsharded():
+    n_nodes, n_clusters, rounds = 8, 4, 6
+    program, cfg = _build(n_nodes, n_clusters)
+
+    def run(round_fn, sims, put=None):
+        for r in range(rounds):
+            inj = _inject(n_clusters, n_nodes, value=r % 8, dest=r % n_nodes)
+            if put is not None:
+                inj = jax.device_put(inj, put(inj))
+            sims, _cm, _io = round_fn(sims, inj)
+        return jax.device_get(sims)
+
+    # unsharded reference
+    sims0 = make_cluster_sims(program, cfg, n_clusters, seed=3)
+    ref = run(make_cluster_round_fn(program, cfg), sims0)
+
+    # sharded over the full 8-device mesh
+    mesh = mesh_for(8)
+    sims1 = make_cluster_sims(program, cfg, n_clusters, seed=3)
+    example_inj = _inject(n_clusters, n_nodes, 0, 0)
+    sims1 = jax.device_put(sims1, sim_shardings(mesh, sims1))
+    round_fn = make_cluster_round_fn(program, cfg, mesh=mesh,
+                                     example=sims1,
+                                     example_inject=example_inj)
+    with mesh:
+        got = run(round_fn, sims1,
+                  put=lambda inj: sim_shardings(mesh, inj))
+
+    flat_ref, treedef_ref = jax.tree.flatten(ref)
+    flat_got, treedef_got = jax.tree.flatten(got)
+    assert treedef_ref == treedef_got
+    for a, b in zip(flat_ref, flat_got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # sanity: the simulation did something (values seen, messages counted)
+    assert np.asarray(got.nodes["seen"]).any()
+    assert np.asarray(got.net.stats.sent_all).sum() >= 0
