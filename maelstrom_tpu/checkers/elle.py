@@ -243,15 +243,7 @@ def analyze(history) -> dict:
     txn_by_id = {t["id"]: t for t in txns}
     _KIND_PRIO = {"rw": 0, "wr": 1, "ww": 2, "rt": 3}
 
-    def explain(scc, edge_set):
-        """Renders one concrete cycle through the SCC, Elle-style:
-        'T1 -[ww]-> T2 -[rw]-> T1', plus each txn's micro-ops — the
-        human-readable evidence for the anomaly. The walk prefers rw >
-        wr > ww > rt edges so the rarest dependency kinds (the ones that
-        drive the classification) appear in the witness; runs of realtime
-        barrier hops are collapsed into single '-[rt]->' steps. Returns
-        (text, ops, kinds-on-the-cycle) so the caller can classify the
-        *rendered* cycle — the label always matches the evidence."""
+    def _scc_graph(scc, edge_set):
         ids = set(scc)
         adj: dict = {}
         kinds: dict = {}
@@ -262,6 +254,41 @@ def analyze(history) -> dict:
                 if (a, b) not in kinds or _KIND_PRIO[k] < _KIND_PRIO[
                         kinds[(a, b)]]:
                     kinds[(a, b)] = k
+        return adj, kinds
+
+    def _render(cyc, kinds):
+        """cyc is a closed node list (first == last). Rotates it to start
+        at a transaction node, then collapses runs of realtime barrier
+        hops into single '-[rt]->' steps. Returns (text, ops, kinds)."""
+        body = cyc[:-1]
+        start = next(i for i, x in enumerate(body)
+                     if not isinstance(x, tuple))
+        body = body[start:] + body[:start]
+        cyc = body + [body[0]]
+        steps = []
+        last_txn = cyc[0]
+        via_rt = False
+        for u, v in zip(cyc, cyc[1:]):
+            if isinstance(v, tuple):
+                via_rt = True
+                continue
+            kind = "rt" if via_rt else kinds[(u, v)]
+            steps.append((last_txn, v, kind))
+            last_txn, via_rt = v, False
+        text = "  ".join(f"T{a} -[{k}]-> T{b}" for a, b, k in steps)
+        ops = {f"T{i}": txn_by_id[i]["micro"]
+               for i in txn_ids(cyc) if i in txn_by_id}
+        return text, ops, [k for _a, _b, k in steps]
+
+    def explain(scc, edge_set):
+        """Renders one concrete cycle through the SCC, Elle-style:
+        'T1 -[ww]-> T2 -[rw]-> T1', plus each txn's micro-ops — the
+        human-readable evidence for the anomaly. The walk prefers rw >
+        wr > ww > rt edges so the rarest dependency kinds (the ones that
+        drive the classification) appear in the witness. The caller
+        classifies the *rendered* cycle, so the label always matches the
+        evidence."""
+        adj, kinds = _scc_graph(scc, edge_set)
 
         def choice_key(u):
             def key(v):
@@ -278,22 +305,42 @@ def analyze(history) -> dict:
                 break
             seen[cur] = len(path)
             path.append(cur)
+        return _render(cyc, kinds)
 
-        # collapse barrier nodes: Ta -> (barriers...) -> Tb == Ta -[rt]-> Tb
-        steps = []
-        last_txn = cyc[0]
-        via_rt = False
-        for u, v in zip(cyc, cyc[1:]):
-            if isinstance(v, tuple):
-                via_rt = True
-                continue
-            kind = "rt" if via_rt else kinds[(u, v)]
-            steps.append((last_txn, v, kind))
-            last_txn, via_rt = v, False
-        text = "  ".join(f"T{a} -[{k}]-> T{b}" for a, b, k in steps)
-        ops = {f"T{i}": txn_by_id[i]["micro"]
-               for i in txn_ids(cyc) if i in txn_by_id}
-        return text, ops, [k for _a, _b, k in steps]
+    def explain_realtime(scc, edge_set):
+        """A witness for a realtime anomaly must actually traverse an rt
+        edge; the greedy walk can close a pure data subcycle instead (an
+        SCC may contain both). Anchor on an rt edge inside the SCC and
+        close the cycle with a BFS path back to its tail — guaranteed to
+        exist since the SCC is strongly connected. Returns None when the
+        SCC has no rt edge at all."""
+        adj, kinds = _scc_graph(scc, edge_set)
+        anchor = next(((a, b) for (a, b), k in kinds.items()
+                       if k == "rt"), None)
+        if anchor is None:
+            return None
+        a, b = anchor
+        # BFS shortest path b -> a
+        from collections import deque
+        prev = {b: None}
+        q = deque([b])
+        while q:
+            u = q.popleft()
+            if u == a:
+                break
+            for v in sorted(adj.get(u, ()), key=repr):
+                if v not in prev:
+                    prev[v] = u
+                    q.append(v)
+        # reconstruct b..a then orient as a -> b -> ... -> a
+        back = [a]
+        u = a
+        while u != b:
+            u = prev[u]
+            back.append(u)
+        back.reverse()                      # b ... a
+        cyc = [a] + back                    # a -> b -> ... -> a
+        return _render(cyc, kinds)
 
     def classify_steps(kinds_used):
         inner = set(kinds_used) - {"rt"}
@@ -312,9 +359,12 @@ def analyze(history) -> dict:
     base_cycle_ids = {frozenset(txn_ids(s)) for s in base_sccs}
     for scc in cycles_with(edges | rt_edges):
         if frozenset(txn_ids(scc)) not in base_cycle_ids:
-            text, ops, kinds_used = explain(scc, edges | rt_edges)
-            if "rt" not in kinds_used:
-                continue    # a pure data cycle is a base anomaly, not rt
+            rendered = explain_realtime(scc, edges | rt_edges)
+            if rendered is None:
+                # no rt edge in the SCC: it's a data anomaly whose SCC
+                # boundary merely shifted; the base pass covers its cycles
+                continue
+            text, ops, kinds_used = rendered
             add_anom(classify_steps(kinds_used) + "-realtime",
                      {"txns": txn_ids(scc), "cycle": text, "txn-ops": ops})
 

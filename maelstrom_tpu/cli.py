@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Log packets as they're received")
     t.add_argument("--seed", type=int, default=0, help="PRNG seed")
     t.add_argument("--store", default="store", help="Store directory root")
+    t.add_argument("--ms-per-round", type=float, default=1.0,
+                   help="Virtual milliseconds per simulation round "
+                        "(TPU path; coarser = faster, less latency "
+                        "resolution)")
     t.add_argument("--checkpoint-every", type=float,
                    help="Checkpoint the run every N virtual seconds "
                         "(TPU path only)")
@@ -133,6 +137,7 @@ def opts_from_args(args) -> dict:
         "log_net_recv": args.log_net_recv,
         "seed": args.seed,
         "store_root": args.store,
+        "ms_per_round": args.ms_per_round,
         "checkpoint_every": args.checkpoint_every,
         "resume": args.resume,
     }

@@ -189,3 +189,23 @@ def test_elle_cycle_explanation_rendered():
     (anom,) = r["anomalies"]["G-single"]
     assert "-[rw]->" in anom["cycle"] and "-[wr]->" in anom["cycle"]
     assert anom["txn-ops"]["T0"] == [["append", 8, 1], ["append", 9, 2]]
+
+
+def test_elle_realtime_anomaly_survives_data_subcycle():
+    """An SCC mixing a pure data cycle (T0<->T1) with a realtime cycle
+    through a later txn must still report the realtime anomaly, with a
+    witness that actually traverses an rt edge (regression: the greedy
+    walk used to close the data subcycle and drop the anomaly)."""
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["r", 2, None]],
+              [["append", 1, 1], ["r", 2, [2]]], 0, 5, proc=0)
+    _txn_pair(h, [["append", 2, 2], ["r", 1, None]],
+              [["append", 2, 2], ["r", 1, [1]]], 0, 5, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, []]], 10, 11, proc=0)
+    r = ElleListAppendChecker(["strict-serializable"]).check({}, h)
+    assert r["valid"] is False
+    rt_keys = [k for k in r["anomalies"] if k.endswith("-realtime")]
+    assert rt_keys, r["anomalies"]
+    (anom,) = r["anomalies"][rt_keys[0]]
+    assert 2 in anom["txns"]
+    assert "-[rt]->" in anom["cycle"]
