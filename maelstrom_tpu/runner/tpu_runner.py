@@ -196,9 +196,11 @@ class TpuRunner:
             self.sim = self.sim.replace(
                 net=T.flaky(self.sim.net, float(test["p_loss"])))
         self.round_fn = make_round_fn(self.program, self.cfg)
-        self._scan_fn = None     # built lazily (only journal-less runs)
+        self._scan_fn = None         # built lazily
+        self._scan_journal_fn = None  # journaled variant (io-collecting)
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
+        self.journal_scan_cap = int(test.get("journal_scan_cap", 64))
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
@@ -387,7 +389,7 @@ class TpuRunner:
                     next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
-            if inject_rows or self.journal is not None:
+            if inject_rows:
                 inject = T.Msgs.empty(max(C, 1))
                 if inject_rows:
                     M = len(inject_rows)
@@ -417,6 +419,29 @@ class TpuRunner:
                 if self.journal is not None:
                     self._journal_round(io, client_msgs, r)
                 r += 1
+            elif self.journal is not None:
+                # journaled scan-ahead: same early-exit semantics, but
+                # every scanned round's io is collected for the journal
+                if self._scan_journal_fn is None:
+                    from ..sim import make_scan_fn
+                    self._scan_journal_fn = make_scan_fn(
+                        program, cfg, journal_cap=self.journal_scan_cap)
+                k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+                                         max_rounds)
+                self.sim, client_msgs, k, buf = self._scan_journal_fn(
+                    self.sim, jnp.int32(k_max))
+                self._state_cache = None
+                k = int(jax.device_get(k))
+                # transfer only the executed rows (cap may be much larger)
+                client_msgs, buf = jax.device_get(
+                    (client_msgs, jax.tree.map(lambda b: b[:k], buf)))
+                quiet_cm = jax.tree.map(np.zeros_like, client_msgs)
+                for i in range(k):
+                    io_i = jax.tree.map(lambda b, i=i: b[i], buf)
+                    self._journal_round(
+                        io_i, client_msgs if i == k - 1 else quiet_cm,
+                        r + i)
+                r += k
             else:
                 # nothing to inject and no journal: cross the idle stretch
                 # in one compiled dispatch (early exit on any client reply)

@@ -137,7 +137,7 @@ def make_round_fn(program, cfg: NetConfig):
     return jax.jit(partial(_round, program, cfg))
 
 
-def make_scan_fn(program, cfg: NetConfig):
+def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None):
     """Jitted scan-ahead: runs up to k_max injection-free rounds in ONE
     dispatch, stopping early at the first round that produces a client
     reply (lax.while_loop). The interactive runner uses this to cross the
@@ -147,25 +147,46 @@ def make_scan_fn(program, cfg: NetConfig):
 
     scan_fn(sim, k_max) -> (sim', client_msgs_of_last_round, k_executed),
     k_executed >= 1. Observable behavior matches k_executed sequential
-    `_round` calls exactly (same PRNG stream, same reply round)."""
+    `_round` calls exactly (same PRNG stream, same reply round).
+
+    With `journal_cap` set, every scanned round's journal io is also
+    collected into [cap, ...] buffers and returned as a fourth element
+    (rows beyond k_executed are zeros); the cap bounds k_max. The
+    interactive runner uses this variant when a journal is attached, so
+    journaling no longer forces one dispatch per round. Client replies
+    only appear in the final executed round (the loop exits on the first
+    reply), so per-round client message buffers are unnecessary."""
 
     empty = Msgs.empty(max(cfg.n_clients, 1))
+    cap = None if journal_cap is None else max(1, int(journal_cap))
 
     def cond(st):
-        _sim, cm, k, k_max = st
+        _sim, cm, k, k_max, _buf = st
         return (~cm.valid.any()) & (k < k_max)
 
     def body(st):
-        sim, _cm, k, k_max = st
-        sim2, cm2, _io = _round(program, cfg, sim, empty)
-        return (sim2, cm2, k + jnp.int32(1), k_max)
+        sim, _cm, k, k_max, buf = st
+        sim2, cm2, io = _round(program, cfg, sim, empty)
+        if cap is not None:
+            buf = jax.tree.map(lambda b, x: b.at[k].set(x), buf, io)
+        return (sim2, cm2, k + jnp.int32(1), k_max, buf)
 
     @jax.jit
     def scan_fn(sim: SimState, k_max):
-        sim1, cm1, _io = _round(program, cfg, sim, empty)
-        st = (sim1, cm1, jnp.int32(1), jnp.int32(k_max))
-        sim2, cm, k, _ = jax.lax.while_loop(cond, body, st)
-        return sim2, cm, k
+        sim1, cm1, io1 = _round(program, cfg, sim, empty)
+        k_max = jnp.int32(k_max)
+        if cap is None:
+            buf = ()
+        else:
+            buf = jax.tree.map(
+                lambda x: jnp.zeros((cap,) + x.shape, x.dtype), io1)
+            buf = jax.tree.map(lambda b, x: b.at[0].set(x), buf, io1)
+            k_max = jnp.minimum(k_max, cap)
+        st = (sim1, cm1, jnp.int32(1), k_max, buf)
+        sim2, cm, k, _, buf = jax.lax.while_loop(cond, body, st)
+        if cap is None:
+            return sim2, cm, k
+        return sim2, cm, k, buf
 
     return scan_fn
 

@@ -53,3 +53,27 @@ def test_scan_equivalence_under_worker_saturation(tmp_path):
     h2 = r2.run()
     assert len(h1) > 20
     assert _ops(h1) == _ops(h2)
+
+
+def test_journaled_scan_matches_per_round_journal(tmp_path):
+    """With a journal attached, the io-collecting scan must produce the
+    same history AND the same journal events as per-round dispatch."""
+    from maelstrom_tpu.net.journal import Journal
+
+    def run_with_journal(path, **over):
+        r, t = _run(path, **over)
+        r.journal = Journal()
+        h = r.run()
+        return r, h
+
+    r1, h1 = run_with_journal(tmp_path / "a", max_scan=1)
+    r2, h2 = run_with_journal(tmp_path / "b")
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
+
+    from collections import Counter
+    ev1 = Counter((e.type, e.id, e.time, e.src, e.dest)
+                  for e in r1.journal.all_events())
+    ev2 = Counter((e.type, e.id, e.time, e.src, e.dest)
+                  for e in r2.journal.all_events())
+    assert ev1 == ev2 and sum(ev1.values()) > 0
