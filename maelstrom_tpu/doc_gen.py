@@ -71,9 +71,14 @@ def _schema_block(sch) -> str:
                                     default=str) + "\n```"
 
 
+def _title(ns: str) -> str:
+    """lin_kv -> Lin-kv (the reference's heading style)."""
+    return ns.replace("_", "-").capitalize()
+
+
 def render_workloads() -> str:
-    """One section per workload namespace, one subsection per RPC
-    (reference `doc.clj:23-64`)."""
+    """One section per workload namespace, one subsection per RPC, with a
+    table of contents (reference `doc.clj:23-64`)."""
     by_ns: dict = {}
     for r in RPC_REGISTRY:
         by_ns.setdefault(r.ns.split(".")[-1], []).append(r)
@@ -84,9 +89,15 @@ def render_workloads() -> str:
            "the system, what those requests mean, what kind of responses "
            "are expected, which errors can occur, and how to check the "
            "resulting history for safety.",
+           "",
+           "## Table of Contents",
            ""]
     for ns in sorted(by_ns):
-        out.append(f"## Workload: {ns}")
+        t = _title(ns)
+        out.append(f"- [{t}](#workload-{t.lower()})")
+    out.append("")
+    for ns in sorted(by_ns):
+        out.append(f"## Workload: {_title(ns)}")
         out.append("")
         for r in by_ns[ns]:
             out.append(f"### RPC: {r.name}")

@@ -30,6 +30,49 @@ from ..net import tpu as T
 from ..sim import SimState, _round, make_sim
 
 
+_dist_initialized = False
+
+# Environment markers that mean "this process is part of a multi-host
+# cluster": an explicit coordinator, or a Cloud TPU pod slice (where
+# jax.distributed.initialize auto-detects everything from TPU metadata).
+_CLUSTER_ENV_MARKERS = ("JAX_COORDINATOR_ADDRESS",
+                        "MEGASCALE_COORDINATOR_ADDRESS",
+                        "TPU_WORKER_HOSTNAMES")
+
+
+def multihost_mesh(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None,
+                   dp: int | None = None) -> Mesh:
+    """Multi-host scale-out over DCN (SURVEY.md section 5.8): initializes
+    `jax.distributed` so every host sees the global device set, then
+    builds the ("dp", "sp") mesh over ALL devices. Within a host's slice
+    the sharded round's collectives ride ICI; across hosts XLA routes
+    them over DCN — no application code changes, the same
+    `make_cluster_round_fn(..., mesh=...)` call scales out.
+
+    Distributed setup runs when a coordinator is passed explicitly or a
+    cluster environment marker is present (JAX_COORDINATOR_ADDRESS,
+    MEGASCALE_COORDINATOR_ADDRESS, or a Cloud TPU pod's
+    TPU_WORKER_HOSTNAMES — on pods `jax.distributed.initialize`
+    auto-detects everything, so the arguments can stay None). Without
+    either, this is simply `mesh_for()` over local devices.
+
+    Call this before any other JAX API: `jax.distributed.initialize`
+    must run before the XLA backend comes up (this function deliberately
+    avoids touching the backend itself before initializing)."""
+    import os
+    global _dist_initialized
+    want_dist = (coordinator_address is not None
+                 or any(os.environ.get(k) for k in _CLUSTER_ENV_MARKERS))
+    if want_dist and not _dist_initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _dist_initialized = True
+    return mesh_for(dp=dp)
+
+
 def mesh_for(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     """A ("dp", "sp") mesh over the first n_devices. dp defaults to the
     largest power-of-two divisor <= sqrt(n)."""

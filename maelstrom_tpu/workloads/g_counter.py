@@ -7,7 +7,29 @@ doc-only copies, `g_counter.clj:13-28`); the live RPCs are pn-counter's."""
 from __future__ import annotations
 
 from .. import generators as g
+from .. import schema as S
+from ..client import defrpc
 from . import pn_counter
+
+# Doc-only RPC registrations (reference `g_counter.clj:13-28`): the live
+# client uses pn-counter's add/read; these document the workload's
+# non-negative-delta contract in doc/workloads.md.
+defrpc(
+    "add",
+    "Adds a non-negative integer, called `delta`, to the counter. Servers "
+    "should respond with an `add_ok` message.",
+    {"type": S.Eq("add"), "delta": int},
+    {"type": S.Eq("add_ok")},
+    ns="maelstrom_tpu.workloads.g_counter")
+
+defrpc(
+    "read",
+    "Reads the current value of the counter. Servers respond with a "
+    "`read_ok` message containing a `value`, which should be the sum of "
+    "all (known) added deltas.",
+    {"type": S.Eq("read")},
+    {"type": S.Eq("read_ok"), "value": int},
+    ns="maelstrom_tpu.workloads.g_counter")
 
 
 def non_negative(op: dict) -> bool:

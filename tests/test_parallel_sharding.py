@@ -83,3 +83,30 @@ def test_sharded_cluster_round_matches_unsharded():
     # sanity: the simulation did something (values seen, messages counted)
     assert np.asarray(got.nodes["seen"]).any()
     assert np.asarray(got.net.stats.recv_all).sum() > 0
+
+
+def test_multihost_mesh_initializes_distributed(monkeypatch):
+    """With a cluster marker set, multihost_mesh must call
+    jax.distributed.initialize (before touching the backend); without
+    one it must not, and must fall back to the local mesh."""
+    import maelstrom_tpu.parallel as par
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address=None, num_processes=None,
+        process_id=None: calls.append(coordinator_address))
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setattr(par, "_dist_initialized", False)
+    mesh = par.multihost_mesh()
+    assert calls == [] and mesh.shape["dp"] * mesh.shape["sp"] == 8
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    mesh = par.multihost_mesh()
+    assert calls == [None]
+    # idempotent: a second call must not re-initialize
+    par.multihost_mesh()
+    assert calls == [None]

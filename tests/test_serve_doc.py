@@ -1,0 +1,55 @@
+"""Coverage for the docs generator and the store web server."""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import threading
+import urllib.request
+from functools import partial
+
+from maelstrom_tpu.doc_gen import write_docs
+from maelstrom_tpu.serve import StoreHandler
+
+
+def test_doc_generation(tmp_path):
+    paths = write_docs(str(tmp_path))
+    assert sorted(os.path.basename(p) for p in paths) == [
+        "protocol.md", "workloads.md"]
+    protocol = (tmp_path / "protocol.md").read_text()
+    # the error table is rendered from the registry
+    assert "timeout" in protocol and "precondition-failed" in protocol
+    assert "| 22" in protocol
+    workloads = (tmp_path / "workloads.md").read_text()
+    for w in ("## Workload: Broadcast", "## Workload: G-counter",
+              "## Workload: Lin-kv", "## Workload: Txn-list-append",
+              "## Table of Contents"):
+        assert w in workloads, w
+    # RPC schemas include request/response types
+    assert '"type": "echo_ok"' in workloads
+
+
+def test_serve_renders_validity_badges(tmp_path):
+    for name, valid in (("a", True), ("b", False), ("c", "unknown")):
+        d = tmp_path / "lin-kv" / name
+        d.mkdir(parents=True)
+        (d / "results.json").write_text(json.dumps({"valid": valid}))
+
+    handler = partial(StoreHandler, directory=str(tmp_path))
+    httpd = socketserver.TCPServer(("127.0.0.1", 0), handler)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/lin-kv/") as resp:
+            body = resp.read().decode()
+        assert "[valid: True]" in body
+        assert "[valid: False]" in body
+        assert "[valid: unknown]" in body
+        # green for valid, red for invalid, orange for unknown
+        assert "#2ca02c" in body and "#d62728" in body and "#ff7f0e" in body
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
