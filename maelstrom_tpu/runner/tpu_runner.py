@@ -198,6 +198,7 @@ class TpuRunner:
         self.round_fn = make_round_fn(self.program, self.cfg)
         self._scan_fn = None         # built lazily
         self._scan_journal_fn = None  # journaled variant (io-collecting)
+        self._pack_fn = None          # io-buffer single-array packer
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
         self.journal_scan_cap = int(test.get("journal_scan_cap", 64))
@@ -304,7 +305,6 @@ class TpuRunner:
         pending: dict[int, tuple] = {}   # mid -> (process, op, node_idx, deadline_round)
         history = History()
         max_rounds = int(test.get("max_rounds", 2_000_000))
-        skip_chunk = max(int(10.0 / self.ms_per_round), 1)
 
         r = 0
         if resume is not None:
@@ -328,6 +328,9 @@ class TpuRunner:
                     "history/results cover the whole run", r)
         next_ckpt = (r + self.checkpoint_every_rounds
                      if self.checkpoint_every_rounds else None)
+        # host mirror of the device message-id counter (refreshed by every
+        # dispatch's combined fetch)
+        self._next_mid = int(jax.device_get(self.sim.net.next_mid))
         exhausted = False
         while r < max_rounds:
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
@@ -374,14 +377,16 @@ class TpuRunner:
                 break
 
             # fast-forward quiescent stretches (nothing in flight, nothing
-            # to inject, program idle)
+            # to inject, program idle): jump straight to the generator's
+            # next interesting round in ONE bump — never overshoot (the
+            # scan path stops there too, and the two must stay
+            # observationally identical; fruitless generator polls are
+            # side-effect-free, so skipping them is equivalent). Jumping
+            # the full bound matters on remote devices, where every bump
+            # is a host<->device round trip.
             if not inject_rows and not pending and self._quiet():
-                # land exactly on the generator's next interesting round
-                # (never overshoot: the scan path stops there too, and the
-                # two must stay observationally identical)
-                k = min(skip_chunk,
-                        self._scan_bound(gen, ctx, pending, r, next_ckpt,
-                                         max_rounds))
+                k = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+                                     max_rounds)
                 self.sim = self._bump(self.sim, jnp.int32(k))
                 r += k
                 if next_ckpt is not None and r >= next_ckpt:
@@ -409,13 +414,19 @@ class TpuRunner:
                                       T.I32),
                         c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
                                       T.I32))
-                    base_mid = int(self.sim.net.next_mid)
+                    # next_mid is mirrored on the host (refreshed in every
+                    # dispatch's combined fetch) — reading it from the
+                    # device here would cost a round trip per injection
+                    base_mid = self._next_mid
                     for j, (p, o, ni, *_rest) in enumerate(inject_rows):
                         pending[base_mid + j] = (p, o, ni,
                                                  r + self.timeout_rounds)
 
                 self.sim, client_msgs, io = self.round_fn(self.sim, inject)
                 self._state_cache = None
+                client_msgs, self._next_mid = jax.device_get(
+                    (client_msgs, self.sim.net.next_mid))
+                self._next_mid = int(self._next_mid)
                 if self.journal is not None:
                     self._journal_round(io, client_msgs, r)
                 r += 1
@@ -431,10 +442,27 @@ class TpuRunner:
                 self.sim, client_msgs, k, buf = self._scan_journal_fn(
                     self.sim, jnp.int32(k_max))
                 self._state_cache = None
-                k = int(jax.device_get(k))
-                # transfer only the executed rows (cap may be much larger)
-                client_msgs, buf = jax.device_get(
-                    (client_msgs, jax.tree.map(lambda b: b[:k], buf)))
+                if self._pack_fn is None:
+                    # ship the whole io buffer as ONE int32 array per
+                    # dispatch: remote backends pay a round trip per
+                    # fetched array, and the buffer has ~50 leaves
+                    self._pack_fn = jax.jit(lambda b: jnp.concatenate(
+                        [x.astype(jnp.int32).reshape(-1)
+                         for x in jax.tree.leaves(b)]))
+                    leaves, self._io_treedef = jax.tree.flatten(buf)
+                    self._io_shapes = [(x.shape, np.dtype(x.dtype))
+                                       for x in leaves]
+                packed = self._pack_fn(buf)
+                client_msgs, k, flat, self._next_mid = jax.device_get(
+                    (client_msgs, k, packed, self.sim.net.next_mid))
+                k, self._next_mid = int(k), int(self._next_mid)
+                out, off = [], 0
+                for shape, dt in self._io_shapes:
+                    n_el = int(np.prod(shape))
+                    out.append(flat[off:off + n_el].reshape(shape)
+                               .astype(dt))
+                    off += n_el
+                buf = jax.tree.unflatten(self._io_treedef, out)
                 quiet_cm = jax.tree.map(np.zeros_like, client_msgs)
                 for i in range(k):
                     io_i = jax.tree.map(lambda b, i=i: b[i], buf)
@@ -453,12 +481,14 @@ class TpuRunner:
                 self.sim, client_msgs, k = self._scan_fn(
                     self.sim, jnp.int32(k_max))
                 self._state_cache = None
-                client_msgs, k = jax.device_get((client_msgs, k))
+                client_msgs, k, self._next_mid = jax.device_get(
+                    (client_msgs, k, self.sim.net.next_mid))
+                self._next_mid = int(self._next_mid)
                 r += int(k)
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
-            cm = jax.device_get(client_msgs)
+            cm = client_msgs      # already numpy (fetched by each branch)
             for i in np.nonzero(cm.valid)[0]:
                 rt = int(cm.reply_to[i])
                 entry = pending.pop(rt, None)
