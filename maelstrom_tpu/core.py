@@ -114,6 +114,21 @@ def run(opts: dict) -> dict:
     test["store_dir"] = test_dir
     net.journal = Journal(dir=os.path.join(test_dir, "net-journal"))
 
+    # persist the console log alongside the results (the reference's
+    # jepsen.log, doc/results.md:17)
+    log_handler = logging.FileHandler(os.path.join(test_dir, "run.log"))
+    log_handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s"))
+    logging.getLogger().addHandler(log_handler)
+    try:
+        return _run(test, net, test_dir)
+    finally:
+        logging.getLogger().removeHandler(log_handler)
+        log_handler.close()
+
+
+def _run(test: dict, net: HostNet, test_dir: str) -> dict:
+
     node_spec = test.get("node")
     if node_spec and str(node_spec).startswith("tpu:"):
         from .runner.tpu_runner import run_tpu_test

@@ -198,7 +198,10 @@ class TpuRunner:
         self.round_fn = make_round_fn(self.program, self.cfg)
         self._scan_fn = None         # built lazily
         self._scan_journal_fn = None  # journaled variant (io-collecting)
-        self._pack_fn = None          # io-buffer single-array packer
+        self._pack_buf = None         # single-array packers (remote
+        self._pack_round = None       # backends pay a RT per array)
+        self._lat_scale_host = None   # cached net.latency_scale mirror;
+        # any future host-side slow!/fast! op must reset this to None
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
         self.journal_scan_cap = int(test.get("journal_scan_cap", 64))
@@ -250,6 +253,25 @@ class TpuRunner:
 
     def _free_rotated(self, free, history):
         return g.rotate_free(free, self._dispatches)
+
+    @staticmethod
+    def _make_packer(example):
+        """(pack_fn, unpack) shipping a bool/int32 pytree as ONE int32
+        array: remote backends pay a round trip per fetched array, and
+        journal io trees have ~50 leaves."""
+        pack = jax.jit(lambda t: jnp.concatenate(
+            [x.astype(jnp.int32).reshape(-1) for x in jax.tree.leaves(t)]))
+        leaves, treedef = jax.tree.flatten(example)
+        shapes = [(x.shape, np.dtype(x.dtype)) for x in leaves]
+
+        def unpack(flat: np.ndarray):
+            out, off = [], 0
+            for shape, dt in shapes:
+                n_el = int(np.prod(shape))
+                out.append(flat[off:off + n_el].reshape(shape).astype(dt))
+                off += n_el
+            return jax.tree.unflatten(treedef, out)
+        return pack, unpack
 
     def _scan_bound(self, gen, ctx, pending, r, next_ckpt,
                     max_rounds) -> int:
@@ -424,8 +446,16 @@ class TpuRunner:
 
                 self.sim, client_msgs, io = self.round_fn(self.sim, inject)
                 self._state_cache = None
-                client_msgs, self._next_mid = jax.device_get(
-                    (client_msgs, self.sim.net.next_mid))
+                if self.journal is not None:
+                    if self._pack_round is None:
+                        self._pack_round = self._make_packer(io)
+                    pack, unpack = self._pack_round
+                    client_msgs, flat, self._next_mid = jax.device_get(
+                        (client_msgs, pack(io), self.sim.net.next_mid))
+                    io = unpack(flat)
+                else:
+                    client_msgs, self._next_mid = jax.device_get(
+                        (client_msgs, self.sim.net.next_mid))
                 self._next_mid = int(self._next_mid)
                 if self.journal is not None:
                     self._journal_round(io, client_msgs, r)
@@ -442,27 +472,13 @@ class TpuRunner:
                 self.sim, client_msgs, k, buf = self._scan_journal_fn(
                     self.sim, jnp.int32(k_max))
                 self._state_cache = None
-                if self._pack_fn is None:
-                    # ship the whole io buffer as ONE int32 array per
-                    # dispatch: remote backends pay a round trip per
-                    # fetched array, and the buffer has ~50 leaves
-                    self._pack_fn = jax.jit(lambda b: jnp.concatenate(
-                        [x.astype(jnp.int32).reshape(-1)
-                         for x in jax.tree.leaves(b)]))
-                    leaves, self._io_treedef = jax.tree.flatten(buf)
-                    self._io_shapes = [(x.shape, np.dtype(x.dtype))
-                                       for x in leaves]
-                packed = self._pack_fn(buf)
+                if self._pack_buf is None:
+                    self._pack_buf = self._make_packer(buf)
+                pack, unpack = self._pack_buf
                 client_msgs, k, flat, self._next_mid = jax.device_get(
-                    (client_msgs, k, packed, self.sim.net.next_mid))
+                    (client_msgs, k, pack(buf), self.sim.net.next_mid))
                 k, self._next_mid = int(k), int(self._next_mid)
-                out, off = [], 0
-                for shape, dt in self._io_shapes:
-                    n_el = int(np.prod(shape))
-                    out.append(flat[off:off + n_el].reshape(shape)
-                               .astype(dt))
-                    off += n_el
-                buf = jax.tree.unflatten(self._io_treedef, out)
+                buf = unpack(flat)
                 quiet_cm = jax.tree.map(np.zeros_like, client_msgs)
                 for i in range(k):
                     io_i = jax.tree.map(lambda b, i=i: b[i], buf)
@@ -573,8 +589,13 @@ class TpuRunner:
         base = 1 << 40
         # mirror the device-side draw exactly: scale by the live
         # latency_scale (slow!/fast!) and clip to the ring as edge_write
-        # does, or recv ids desync from their sends
-        scale = float(jax.device_get(self.sim.net.latency_scale))
+        # does, or recv ids desync from their sends. The scale only
+        # changes through host-side fault ops, so it is cached — a device
+        # fetch here would cost a round trip per journaled round.
+        if self._lat_scale_host is None:
+            self._lat_scale_host = float(
+                jax.device_get(self.sim.net.latency_scale))
+        scale = self._lat_scale_host
         lat = min(int(round(self.cfg.latency_mean_rounds * scale)),
                   prog.ring - 2)
 
