@@ -16,3 +16,35 @@ reference.
 """
 
 __version__ = "0.1.0"
+
+# Lazy public API: resolving on first access keeps `import maelstrom_tpu`
+# free of jax/numpy imports (several entry points re-pin the platform
+# before touching jax, and the CLI wants fast --help).
+_EXPORTS = {
+    "run": ".core",
+    "build_test": ".core",
+    "History": ".history",
+    "Op": ".history",
+    "Journal": ".net.journal",
+    "HostNet": ".net.host",
+    "SyncClient": ".client",
+    "fuzz_broadcast": ".fuzz",
+    "honor_jax_platforms": ".util",
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    obj = getattr(importlib.import_module(mod, __name__), name)
+    globals()[name] = obj       # cache: later accesses skip __getattr__
+    return obj
+
+
+def __dir__():
+    return __all__

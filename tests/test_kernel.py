@@ -232,3 +232,16 @@ def test_history_pairs():
     # JSON round-trip
     h2 = History.from_jsonl(h.to_jsonl())
     assert [o.to_dict() for o in h2] == [o.to_dict() for o in h]
+
+
+def test_public_api_lazy_exports():
+    import maelstrom_tpu as m
+    assert callable(m.run) and callable(m.build_test)
+    assert m.History and m.Op and m.Journal and m.SyncClient
+    assert m.HostNet
+    assert set(m._EXPORTS) <= set(dir(m))
+    assert callable(m.fuzz_broadcast) and callable(m.honor_jax_platforms)
+    assert m.__version__
+    import pytest
+    with pytest.raises(AttributeError):
+        m.no_such_thing
