@@ -120,22 +120,44 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
     arrival = (round_ + 1 + lat) % cfg.ring          # [N, D, LANES]
     ok = out.valid & deliver_mask
     clipped = jnp.sum((ok & (latency_rounds > cfg.ring - 2)).astype(I32))
-    new_overwrites = jnp.zeros((), I32)
-    # unrolled over the (small, static) ring: pure elementwise selects
-    for s in range(cfg.ring):
-        m = ok & (arrival == s)                      # [N, D, LANES]
-        new_overwrites = new_overwrites + jnp.sum(
-            (m & ch.valid[:, :, s, :]).astype(I32))
 
-        def upd(chf, of, m=m, s=s):
-            return chf.at[:, :, s, :].set(jnp.where(m, of, chf[:, :, s, :]))
+    if cfg.ring <= 4:
+        # tiny rings (constant latency): unrolled per-slot selects beat
+        # the broadcast form — no [N, D, ring, L] mask materialization
+        # (measured 2.85M vs 1.89M msgs/s on the 100k-node bench)
+        new_overwrites = jnp.zeros((), I32)
+        for s in range(cfg.ring):
+            m = ok & (arrival == s)                  # [N, D, LANES]
+            new_overwrites = new_overwrites + jnp.sum(
+                (m & ch.valid[:, :, s, :]).astype(I32))
 
-        ch = ch.replace(
-            valid=ch.valid.at[:, :, s, :].set(ch.valid[:, :, s, :] | m),
-            type=upd(ch.type, out.type), a=upd(ch.a, out.a),
-            b=upd(ch.b, out.b), c=upd(ch.c, out.c))
-    return ch.replace(overwrites=ch.overwrites + new_overwrites,
-                      lat_clipped=ch.lat_clipped + clipped)
+            def upd(chf, of, m=m, s=s):
+                return chf.at[:, :, s, :].set(
+                    jnp.where(m, of, chf[:, :, s, :]))
+
+            ch = ch.replace(
+                valid=ch.valid.at[:, :, s, :].set(ch.valid[:, :, s, :] | m),
+                type=upd(ch.type, out.type), a=upd(ch.a, out.a),
+                b=upd(ch.b, out.b), c=upd(ch.c, out.c))
+        return ch.replace(overwrites=ch.overwrites + new_overwrites,
+                          lat_clipped=ch.lat_clipped + clipped)
+
+    # large rings (randomized latency: ring ~ 8x mean): one broadcast
+    # select over the whole ring — the unrolled loop emitted ring x 5
+    # update kernels and dominated the round cost (10-15x slower)
+    slots = jnp.arange(cfg.ring, dtype=I32)[None, None, :, None]
+    m = ok[:, :, None, :] & (arrival[:, :, None, :] == slots)  # [N,D,R,L]
+    new_overwrites = jnp.sum((m & ch.valid).astype(I32))
+
+    def upd(chf, of):
+        return jnp.where(m, of[:, :, None, :], chf)
+
+    return ch.replace(
+        valid=ch.valid | m,
+        type=upd(ch.type, out.type), a=upd(ch.a, out.a),
+        b=upd(ch.b, out.b), c=upd(ch.c, out.c),
+        overwrites=ch.overwrites + new_overwrites,
+        lat_clipped=ch.lat_clipped + clipped)
 
 
 def edge_read(cfg: EdgeConfig, ch: EdgeChannels, neighbors, rev,

@@ -245,3 +245,16 @@ def test_public_api_lazy_exports():
     import pytest
     with pytest.raises(AttributeError):
         m.no_such_thing
+
+
+def test_package_import_is_lazy():
+    """`import maelstrom_tpu` must not pull in jax/numpy (-S bypasses the
+    image's sitecustomize, which preloads jax and would mask this)."""
+    import subprocess, sys
+    code = ("import sys; sys.path.insert(0, '.'); import maelstrom_tpu; "
+            "assert 'jax' not in sys.modules, 'jax imported eagerly'; "
+            "assert 'numpy' not in sys.modules, 'numpy imported eagerly'")
+    subprocess.run([sys.executable, "-S", "-c", code], check=True,
+                   cwd=__import__('os').path.dirname(
+                       __import__('os').path.dirname(
+                           __import__('os').path.abspath(__file__))))
