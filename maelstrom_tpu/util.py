@@ -47,3 +47,39 @@ def honor_jax_platforms():
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def force_virtual_cpu_mesh(n_devices: int):
+    """Puts this process on n_devices virtual CPU devices, defeating any
+    sitecustomize backend override: env vars must be set before jax's
+    first backend creation, and jax.config must be re-asserted after
+    import (the env var alone cannot win against a programmatic
+    override). One-way switch for the whole process — call it before any
+    jax work, never before TPU work. Used by tests/conftest.py and the
+    driver's `dryrun_multichip` entry."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       opt, flags)
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    honor_jax_platforms()
+
+    import jax
+    try:
+        n_got = len(jax.devices("cpu"))
+    except RuntimeError as e:  # backends cached without a cpu entry
+        n_got, cause = 0, e
+    else:
+        cause = None
+    if n_got < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} cpu devices, got {n_got}: jax was "
+            "initialized before force_virtual_cpu_mesh could set "
+            "xla_force_host_platform_device_count") from cause

@@ -6,12 +6,9 @@ before the first `import jax` anywhere in the test process.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # XLA compiles cost ~1 s each in this environment, so cache them across
 # test runs (first run pays, reruns are fast).
@@ -21,14 +18,15 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
-# This image's sitecustomize registers the tunneled TPU backend and
-# programmatically sets jax_platforms — the env var alone cannot win.
-# jax.config.update after import does: force genuinely-local CPU devices
-# (remote-TPU dispatch has ~100 ms round-trip latency, which would make
-# the lockstep runner unusably slow under pytest).
-import jax  # noqa: E402
+# Force genuinely-local CPU devices: remote-TPU dispatch has ~100 ms
+# round-trip latency, which would make the lockstep runner unusably slow
+# under pytest. The helper beats this image's sitecustomize override.
+# The mesh is pinned to exactly 8 devices (any pre-set
+# xla_force_host_platform_device_count is overridden): sharding tests
+# assert factorizations of 8.
+from maelstrom_tpu.util import force_virtual_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_mesh(8)
 
 
 def ops_projection(history):
