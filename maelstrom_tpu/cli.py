@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 
@@ -89,8 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     doc.add_argument("--dir", default="doc")
 
     b = sub.add_parser("bench", help="Run the TPU benchmark")
-    b.add_argument("--nodes", type=int, default=100_000)
-    b.add_argument("--rounds", type=int, default=200)
+    b.add_argument("--nodes", type=int, default=None,
+                   help="Node count (default: bench.py's BENCH_NODES)")
+    b.add_argument("--rounds", type=int, default=None,
+                   help="Round count (default: bench.py's BENCH_ROUNDS)")
 
     f = sub.add_parser("fuzz", help="Broadcast fuzz: partitions + latency "
                                     "sweep at scale (BASELINE config 5)")
@@ -200,7 +203,6 @@ def main(argv=None) -> int:
 
     if args.cmd == "demo":
         from . import core
-        import os
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         failures = []
         for demo in DEMOS:
@@ -238,9 +240,14 @@ def main(argv=None) -> int:
 
     if args.cmd == "bench":
         import subprocess
-        return subprocess.call([sys.executable, "bench.py",
-                                "--nodes", str(args.nodes),
-                                "--rounds", str(args.rounds)])
+        # bench.py is configured through BENCH_* env vars; explicit flags
+        # override them, unset flags leave the user's env alone
+        env = dict(os.environ)
+        if args.nodes is not None:
+            env["BENCH_NODES"] = str(args.nodes)
+        if args.rounds is not None:
+            env["BENCH_ROUNDS"] = str(args.rounds)
+        return subprocess.call([sys.executable, "bench.py"], env=env)
 
     if args.cmd == "fuzz":
         from .fuzz import main as fuzz_main

@@ -113,8 +113,10 @@ def fuzz_broadcast(n_nodes: int = 4096, values: int = 32,
                 # it) and doesn't count against convergence; every value
                 # that was born must reach every node
                 born = seen.any(axis=0)
-                if ((seen.all(axis=0) == born).all()
-                        and not (c["partition"] and r < part_until)):
+                # probe convergence only with the network healed (gate on
+                # the live fault flag; the heal is applied at loop-top, so
+                # comparing r to part_until would probe one chunk early)
+                if (seen.all(axis=0) == born).all() and not partitioned:
                     converged_at = r
                     n_born = int(born.sum())
                     break

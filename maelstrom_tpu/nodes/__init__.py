@@ -113,6 +113,14 @@ class NodeProgram:
         """Completes a HOST-routed op from device state."""
         raise NotImplementedError
 
+    def invalid_counters(self, state) -> dict:
+        """Program-state counters that invalidate the run when nonzero,
+        surfaced by the net-stats checker next to `dropped_overflow`: a
+        node that silently sheds work because a static capacity was hit
+        degrades results as badly as a silently dropped message. Returns
+        {stat-name: int array} (summed and reported per counter)."""
+        return {}
+
 
 def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
     """Shared edge-channel sizing: (ring, retry_rounds, lat_rounds).

@@ -117,6 +117,13 @@ class RaftProgram(NodeProgram):
             "log_overflow": z(N),
         }
 
+    def invalid_counters(self, state):
+        # a leader whose log hit `log_cap` silently sheds client requests
+        # (the client sees only a timeout); that is a static-capacity
+        # failure of the simulation, not of the protocol, so it must
+        # invalidate the run the way pool overflow does
+        return {"log-overflow": state["log_overflow"]}
+
     # --- packing helpers ---
 
     @staticmethod
@@ -398,7 +405,8 @@ class RaftProgram(NodeProgram):
 
         # proxied requests arriving at the leader: append (one per edge)
         for d in range(D):
-            pk = is_prx[:, d] & is_leader & (s["log_len"] < C)
+            full = s["log_len"] >= C
+            pk = is_prx[:, d] & is_leader & ~full
             key_d = (prx.a[:, d] >> 4) & 0xFFF
             op_d = prx.a[:, d] & 0xF
             ea = (s["term"] << 16) | (key_d << 4) | op_d
@@ -407,6 +415,8 @@ class RaftProgram(NodeProgram):
             s["log_b"] = jnp.where(at, prx.b[:, d, None], s["log_b"])
             s["log_c"] = jnp.where(at, prx.c[:, d, None], s["log_c"])
             s["log_len"] = jnp.where(pk, s["log_len"] + 1, s["log_len"])
+            s["log_overflow"] = s["log_overflow"] + (
+                is_prx[:, d] & is_leader & full).astype(I32)
 
         # ------------------------------------------------ apply + replies
         A = K                                    # replies share client slots

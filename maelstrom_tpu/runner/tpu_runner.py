@@ -159,6 +159,13 @@ class TpuNetStats(Checker):
                                 "tolerates_channel_overwrites", False))
         ok = (c["dropped_overflow"] == 0
               and (overwrites == 0 or tolerated))
+        # program-state capacity failures (e.g. raft log-overflow) are the
+        # same class of silent degradation as pool overflow
+        for name, arr in self.runner.program.invalid_counters(
+                self.runner.sim.nodes).items():
+            n_bad = int(np.sum(jax.device_get(arr)))
+            out[name] = n_bad
+            ok = ok and n_bad == 0
         out["valid"] = bool(ok)
         return out
 

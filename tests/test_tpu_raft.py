@@ -52,6 +52,19 @@ def test_lin_kv_raft_with_message_loss():
     assert ok > 0
 
 
+def test_raft_log_overflow_invalidates_run():
+    """A run that busts `log_cap` must be flagged, not silently degraded:
+    the leader sheds requests the client only sees as timeouts."""
+    # short client timeout so ops keep retrying into the full log (an
+    # in-flight op that will never be answered otherwise outlives the run)
+    res = run({"workload": "lin-kv", "node": "tpu:lin-kv",
+               "node_count": 3, "log_cap": 4, "rate": 20.0,
+               "time_limit": 4.0, "timeout_ms": 500})
+    assert res["net"]["log-overflow"] > 0
+    assert res["net"]["valid"] is False
+    assert res["valid"] is False
+
+
 def test_raft_many_clusters_vmap():
     """64 independent 5-node raft clusters under one vmap: each elects
     exactly one leader."""
