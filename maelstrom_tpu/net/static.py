@@ -52,6 +52,10 @@ class EdgeMsgs:
     a: jnp.ndarray
     b: jnp.ndarray
     c: jnp.ndarray
+    # send round of each delivered message, present only when the
+    # channels track it (journaled runs): the journal pairs every recv
+    # row to its exact send row even under randomized latency draws
+    sent: object = None
 
     @classmethod
     def empty(cls, shape) -> "EdgeMsgs":
@@ -70,6 +74,7 @@ class EdgeChannels:
     c: jnp.ndarray
     overwrites: jnp.ndarray     # i32 scalar: bounded-channel collisions
     lat_clipped: jnp.ndarray    # i32 scalar: latency draws clipped to ring
+    sent: object = None         # [N, D, ring, LANES] write round, opt-in
 
 
 @dataclass(frozen=True)
@@ -82,12 +87,17 @@ class EdgeConfig:
     ring: int = 2
 
 
-def make_channels(cfg: EdgeConfig) -> EdgeChannels:
+def make_channels(cfg: EdgeConfig,
+                  track_send_round: bool = False) -> EdgeChannels:
+    """`track_send_round` adds a per-cell send-round plane so journal
+    recv rows pair exactly to their sends; off by default — the bench
+    path pays nothing for it."""
     shape = (cfg.n_nodes, cfg.degree, cfg.ring, cfg.lanes)
     z = jnp.zeros(shape, I32)
     return EdgeChannels(valid=jnp.zeros(shape, bool), type=z, a=z, b=z, c=z,
                         overwrites=jnp.zeros((), I32),
-                        lat_clipped=jnp.zeros((), I32))
+                        lat_clipped=jnp.zeros((), I32),
+                        sent=z if track_send_round else None)
 
 
 def reverse_index(neighbors: np.ndarray) -> np.ndarray:
@@ -138,7 +148,10 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
             ch = ch.replace(
                 valid=ch.valid.at[:, :, s, :].set(ch.valid[:, :, s, :] | m),
                 type=upd(ch.type, out.type), a=upd(ch.a, out.a),
-                b=upd(ch.b, out.b), c=upd(ch.c, out.c))
+                b=upd(ch.b, out.b), c=upd(ch.c, out.c),
+                sent=(None if ch.sent is None
+                      else upd(ch.sent, jnp.broadcast_to(
+                          round_, m.shape).astype(I32))))
         return ch.replace(overwrites=ch.overwrites + new_overwrites,
                           lat_clipped=ch.lat_clipped + clipped)
 
@@ -157,7 +170,9 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
         type=upd(ch.type, out.type), a=upd(ch.a, out.a),
         b=upd(ch.b, out.b), c=upd(ch.c, out.c),
         overwrites=ch.overwrites + new_overwrites,
-        lat_clipped=ch.lat_clipped + clipped)
+        lat_clipped=ch.lat_clipped + clipped,
+        sent=(None if ch.sent is None
+              else jnp.where(m, jnp.asarray(round_, I32), ch.sent)))
 
 
 def edge_read(cfg: EdgeConfig, ch: EdgeChannels, neighbors, rev,
@@ -178,7 +193,8 @@ def edge_read(cfg: EdgeConfig, ch: EdgeChannels, neighbors, rev,
 
     inbox = EdgeMsgs(
         valid=route(ch.valid) & edge_ok[:, :, None],
-        type=route(ch.type), a=route(ch.a), b=route(ch.b), c=route(ch.c))
+        type=route(ch.type), a=route(ch.a), b=route(ch.b), c=route(ch.c),
+        sent=None if ch.sent is None else route(ch.sent))
     # clear the consumed cell
     ch = ch.replace(valid=ch.valid.at[:, :, s, :].set(False))
     return ch, inbox

@@ -198,7 +198,16 @@ class TpuRunner:
             latency_mean_rounds=mean_rounds,
             latency_dist=lat.get("dist", "constant"),
             ms_per_round=self.ms_per_round)
-        self.sim = make_sim(self.program, self.cfg, seed=test.get("seed", 0))
+        # per-message journal rows: on by default for small clusters, where
+        # Lamport diagrams are readable and the per-round device pull is
+        # cheap; large runs keep only the on-device counters. Tracking is
+        # keyed off the config (not an attached journal object) so a
+        # journal attached after construction still pairs exactly.
+        self.journal_rows = bool(test.get("journal_rows", n <= 64))
+        self.journal = (getattr(test.get("net"), "journal", None)
+                        if self.journal_rows else None)
+        self.sim = make_sim(self.program, self.cfg, seed=test.get("seed", 0),
+                            track_edge_send_round=self.journal_rows)
         if test.get("p_loss"):
             self.sim = self.sim.replace(
                 net=T.flaky(self.sim.net, float(test["p_loss"])))
@@ -207,21 +216,12 @@ class TpuRunner:
         self._scan_journal_fn = None  # journaled variant (io-collecting)
         self._pack_buf = None         # single-array packers (remote
         self._pack_round = None       # backends pay a RT per array)
-        self._lat_scale_host = None   # cached net.latency_scale mirror;
-        # any future host-side slow!/fast! op must reset this to None
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
         self.journal_scan_cap = int(test.get("journal_scan_cap", 64))
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
-        # per-message journal rows: on by default for small clusters, where
-        # Lamport diagrams are readable and the per-round device pull is
-        # cheap; large runs keep only the on-device counters
-        self.journal = None
-        if test.get("journal_rows", n <= 64):
-            journal = getattr(test.get("net"), "journal", None)
-            self.journal = journal
         self.node_names = list(nodes) + [f"c{i}"
                                          for i in range(self.concurrency)]
         self._dispatches = 0
@@ -579,11 +579,13 @@ class TpuRunner:
 
     def _journal_edges(self, edge_out, edge_in, r: int):
         """Synthesizes journal rows for static edge-channel traffic. Ids
-        are deterministic functions of (send round, edge, lane), so the
-        receive side reconstructs its send id and Lamport pairing works —
-        exact for constant latency; under randomized draws receive rows
-        pair approximately (ids use the mean delay). High id bit space
-        keeps them disjoint from pool message ids."""
+        are deterministic functions of (send round, edge, lane): the send
+        side stamps its round, the channels carry it with the message
+        (`EdgeChannels.sent`, tracked on journaled runs), so every recv
+        row pairs exactly to its send — under any latency distribution or
+        live slow!/fast! scale (the reference's journal is exact too,
+        `net/journal.clj:225-239`). High id bit space keeps edge ids
+        disjoint from pool message ids."""
         import numpy as np
         prog = self.program
         N, D = self.cfg.n_nodes, prog.D
@@ -594,17 +596,6 @@ class TpuRunner:
                                np.asarray(prog.rev))
         nb, rev = self._edge_topo
         base = 1 << 40
-        # mirror the device-side draw exactly: scale by the live
-        # latency_scale (slow!/fast!) and clip to the ring as edge_write
-        # does, or recv ids desync from their sends. The scale only
-        # changes through host-side fault ops, so it is cached — a device
-        # fetch here would cost a round trip per journaled round.
-        if self._lat_scale_host is None:
-            self._lat_scale_host = float(
-                jax.device_get(self.sim.net.latency_scale))
-        scale = self._lat_scale_host
-        lat = min(int(round(self.cfg.latency_mean_rounds * scale)),
-                  prog.ring - 2)
 
         ov = np.asarray(edge_out.valid)              # [N, D, L]
         if ov.any():
@@ -620,8 +611,8 @@ class TpuRunner:
             m_i, e_i, l_i = np.nonzero(iv)
             senders = nb[m_i, e_i]
             send_d = rev[m_i, e_i]
-            send_round = r - 1 - lat
-            ids = base + (send_round * (N * D * L)
+            send_round = np.asarray(edge_in.sent)[m_i, e_i, l_i]
+            ids = base + (send_round.astype(np.int64) * (N * D * L)
                           + (senders * D + send_d) * L + l_i
                           ).astype(np.int64)
             self.journal.log_batch(

@@ -33,8 +33,10 @@ class SimState:
     channels: object = None   # EdgeChannels for edge programs, else None
 
 
-def make_sim(program, cfg: NetConfig, seed: int = 0) -> SimState:
-    channels = (static.make_channels(program.edge_cfg)
+def make_sim(program, cfg: NetConfig, seed: int = 0,
+             track_edge_send_round: bool = False) -> SimState:
+    channels = (static.make_channels(program.edge_cfg,
+                                     track_send_round=track_edge_send_round)
                 if getattr(program, "is_edge", False) else None)
     return SimState(net=T.make_net(cfg), nodes=program.init_state(),
                     key=jax.random.PRNGKey(seed), channels=channels)

@@ -62,6 +62,36 @@ def test_broadcast_tpu_partition_recovery():
     assert w["stable-count"] > 0
 
 
+@pytest.mark.parametrize("dist", ["uniform", "exponential"])
+def test_edge_journal_exact_pairing_random_latency(dist):
+    """Every edge-channel journal recv must pair to its true send (same
+    id, send strictly earlier, same endpoints) — under randomized latency
+    draws, not just constant. The channels carry each message's send
+    round (`EdgeChannels.sent`), matching the reference journal's
+    exactness (`net/journal.clj:225-239`)."""
+    from maelstrom_tpu.net.journal import Journal
+
+    res = run({"workload": "broadcast", "node": "tpu:broadcast",
+               "node_count": 5, "topology": "grid", "journal_rows": True,
+               "latency": {"mean": 3, "dist": dist}, "time_limit": 2.0})
+    assert res["valid"] is True, res["workload"]
+    jr = Journal.load("/tmp/maelstrom-tpu-test-store/latest/net-journal")
+    EDGE = 1 << 40
+    events = jr.all_events()
+    sends = {e.id: e for e in events if e.id >= EDGE and e.type == "send"}
+    recvs = [e for e in events if e.id >= EDGE and e.type == "recv"]
+    assert recvs, "no edge traffic journaled"
+    delays = set()
+    for e in recvs:
+        s = sends.get(e.id)
+        assert s is not None, f"recv {e.id} has no matching send"
+        assert s.time < e.time, (s, e)
+        assert (s.src, s.dest) == (e.src, e.dest), (s, e)
+        delays.add(e.time - s.time)
+    # the draws actually varied (otherwise this test is the constant case)
+    assert len(delays) > 1, delays
+
+
 def test_broadcast_tpu_with_loss_is_lossless_to_checker():
     """5% message loss: acks + retransmission keep the workload valid."""
     res = run({"workload": "broadcast", "node": "tpu:broadcast",
