@@ -150,8 +150,7 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
                 type=upd(ch.type, out.type), a=upd(ch.a, out.a),
                 b=upd(ch.b, out.b), c=upd(ch.c, out.c),
                 sent=(None if ch.sent is None
-                      else upd(ch.sent, jnp.broadcast_to(
-                          round_, m.shape).astype(I32))))
+                      else upd(ch.sent, jnp.asarray(round_, I32))))
         return ch.replace(overwrites=ch.overwrites + new_overwrites,
                           lat_clipped=ch.lat_clipped + clipped)
 

@@ -201,8 +201,10 @@ class TpuRunner:
         # per-message journal rows: on by default for small clusters, where
         # Lamport diagrams are readable and the per-round device pull is
         # cheap; large runs keep only the on-device counters. Tracking is
-        # keyed off the config (not an attached journal object) so a
-        # journal attached after construction still pairs exactly.
+        # keyed off the config (not an attached journal object) so
+        # assigning `runner.journal` after construction still pairs
+        # exactly (the net's journal is only snapshotted here, not
+        # re-read later).
         self.journal_rows = bool(test.get("journal_rows", n <= 64))
         self.journal = (getattr(test.get("net"), "journal", None)
                         if self.journal_rows else None)
@@ -395,9 +397,20 @@ class TpuRunner:
                         gen = self._complete(history, gen, ctx, process,
                                              completed, free)
                     else:
-                        t, a, b, c = program.encode_body(body, self.intern)
-                        inject_rows.append((process, op, node_idx, t, a, b,
-                                            c))
+                        try:
+                            t, a, b, c = program.encode_body(body,
+                                                             self.intern)
+                        except ValueError as e:
+                            # encode-capacity exhaustion (e.g. the txn
+                            # command table) fails the op definitely
+                            # instead of crashing the run
+                            completed = {**op, "type": "fail",
+                                         "error": ["encode-error", str(e)]}
+                            gen = self._complete(history, gen, ctx,
+                                                 process, completed, free)
+                        else:
+                            inject_rows.append((process, op, node_idx, t,
+                                                a, b, c))
                 ctx = {"time": self._time_ns(r),
                        "free": self._free_rotated(free, history),
                        "processes": processes}
