@@ -37,6 +37,13 @@ T_ERROR = 1     # error reply: a = error code, b = interned text
 HOST = "host"   # sentinel: op handled host-side, no message injected
 
 
+class EncodeCapacityError(ValueError):
+    """A static encode capacity (value table, command table) is
+    exhausted. The runner completes the op as a definite fail instead of
+    crashing the run; any other exception from encode_body still
+    propagates (a programming error must not be swallowed)."""
+
+
 class Intern:
     """Bidirectional value <-> int32 table for opaque payloads crossing the
     host/device boundary (SURVEY.md section 7 'hard parts')."""
@@ -53,6 +60,16 @@ class Intern:
             self._fwd[key] = i
             self._rev.append(value)
         return i
+
+    def peek(self, value):
+        """Existing id for a value, or None — without growing the
+        table (capacity checks must not leak entries for ops that are
+        about to fail)."""
+        return self._fwd.get(json.dumps(value, sort_keys=True,
+                                        default=str))
+
+    def __len__(self):
+        return len(self._rev)
 
     def value(self, i: int):
         return self._rev[i]

@@ -41,7 +41,8 @@ import numpy as np
 from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
 from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from . import NodeProgram, edge_timing, register
+from . import (EncodeCapacityError, NodeProgram, edge_timing,
+               register)
 
 T_BCAST = 10      # client -> node: a = value index
 T_BCAST_OK = 11
@@ -251,7 +252,7 @@ class BroadcastProgram(NodeProgram):
         if body["type"] == "broadcast":
             i = intern.id(body["message"])
             if i >= self.V:
-                raise ValueError(
+                raise EncodeCapacityError(
                     f"broadcast value table full ({self.V}); raise "
                     f"--max-values")
             return (T_BCAST, i, 0, 0)

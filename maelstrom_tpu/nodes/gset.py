@@ -19,7 +19,7 @@ import random
 
 from .broadcast import (BroadcastProgram, T_BCAST, T_BCAST_OK, T_READ,
                         T_READ_OK)
-from . import register
+from . import EncodeCapacityError, register
 
 
 def fanout_topology(nodes, k: int, seed: int = 0):
@@ -77,7 +77,7 @@ class GSetProgram(BroadcastProgram):
         if body["type"] == "add":
             i = intern.id(body["element"])
             if i >= self.V:
-                raise ValueError(f"g-set value table full ({self.V}); "
+                raise EncodeCapacityError(f"g-set value table full ({self.V}); "
                                  f"raise --max-values")
             return (T_BCAST, i, 0, 0)
         return (T_READ, 0, 0, 0)

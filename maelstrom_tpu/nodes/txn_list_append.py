@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import register
+from . import EncodeCapacityError, register
 from .raft import OP_TXN, RaftProgram, T_TXN, T_TXN_OK
 
 
@@ -63,9 +63,12 @@ class TxnRaftProgram(RaftProgram):
         return {"type": "txn", "txn": op["value"]}
 
     def encode_body(self, body, intern):
-        tid = intern.id(body["txn"])
-        if tid > 0xFFFF:
-            raise ValueError("txn command table full (65536 commands)")
+        tid = intern.peek(body["txn"])
+        if tid is None:
+            if len(intern) > 0xFFFF:
+                raise EncodeCapacityError(
+                    "txn command table full (65536 commands)")
+            tid = intern.id(body["txn"])
         return (T_TXN, tid, 0, 0)
 
     def decode_body(self, t, a, b, c, intern):

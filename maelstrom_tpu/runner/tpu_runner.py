@@ -32,7 +32,7 @@ from ..errors import ERROR_REGISTRY
 from ..history import History, Op
 from ..nemesis import GRUDGES
 from ..net import tpu as T
-from ..nodes import HOST, Intern, get_program
+from ..nodes import HOST, EncodeCapacityError, Intern, get_program
 from ..sim import SimState, make_round_fn, make_sim
 
 log = logging.getLogger("maelstrom.tpu")
@@ -400,10 +400,11 @@ class TpuRunner:
                         try:
                             t, a, b, c = program.encode_body(body,
                                                              self.intern)
-                        except ValueError as e:
+                        except EncodeCapacityError as e:
                             # encode-capacity exhaustion (e.g. the txn
                             # command table) fails the op definitely
-                            # instead of crashing the run
+                            # instead of crashing the run; any other
+                            # exception is a bug and propagates
                             completed = {**op, "type": "fail",
                                          "error": ["encode-error", str(e)]}
                             gen = self._complete(history, gen, ctx,
