@@ -11,8 +11,9 @@ every in-flight edge message one hop — no sort, no scatter, pure HBM
 bandwidth. This is the discrete-event analogue of a halo exchange.
 
 Latency is a small ring of per-edge cells indexed by arrival round; a
-message sent at round r with latency L lands in cell (r+1+L) % ring_depth
-and is read (and cleared) when the receiver's round pointer passes it.
+message sent at round r with latency L lands in cell (r+max(L,1)) %
+ring_depth and is read (and cleared) when the receiver's round pointer
+passes it.
 Randomized latencies are supported up to ring_depth-1 rounds (clipped);
 two messages on the same (edge, lane) arriving the same round overwrite —
 bounded-channel loss, counted, and absent entirely under constant latency.
@@ -80,7 +81,8 @@ class EdgeChannels:
 @dataclass(frozen=True)
 class EdgeConfig:
     """Static shape of the edge exchange. ring must exceed the maximum
-    latency draw in rounds (+1 for the send->arrival hop)."""
+    latency draw in rounds (arrival offsets 1..ring-1 are
+    representable; larger draws are clipped and counted)."""
     n_nodes: int
     degree: int
     lanes: int
@@ -124,12 +126,16 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
     """Writes this round's outgoing edge messages into the rings.
 
     latency_rounds: i32 [N, D, LANES] per-message delay (>= 0, clipped to
-    ring-2); deliver_mask: bool broadcastable to [N, D, LANES] (False =
+    ring-1); deliver_mask: bool broadcastable to [N, D, LANES] (False =
     lost or partitioned, applied at send like `net.clj:213`)."""
-    lat = jnp.clip(latency_rounds, 0, cfg.ring - 2)
-    arrival = (round_ + 1 + lat) % cfg.ring          # [N, D, LANES]
+    # deadline = now + latency with a one-round causal floor, matching
+    # the pool path (`net/tpu.py _send`) and the reference's wall-clock
+    # deadlines (`net.clj:201-204`). Offset ring-1 is safe: the cell it
+    # targets was read (and cleared) the previous round.
+    lat = jnp.maximum(jnp.clip(latency_rounds, 0, cfg.ring - 1), 1)
+    arrival = (round_ + lat) % cfg.ring              # [N, D, LANES]
     ok = out.valid & deliver_mask
-    clipped = jnp.sum((ok & (latency_rounds > cfg.ring - 2)).astype(I32))
+    clipped = jnp.sum((ok & (latency_rounds > cfg.ring - 1)).astype(I32))
 
     if cfg.ring <= 4:
         # tiny rings (constant latency): unrolled per-slot selects beat

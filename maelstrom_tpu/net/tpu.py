@@ -173,7 +173,11 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     client = involves_client(cfg, out.src, out.dest)
     lat = jnp.where(client, 0,
                     draw_latency_rounds(cfg, k_lat, net.latency_scale, (M,)))
-    due = net.round + 1 + lat
+    # deadline = now + latency (reference `net.clj:201-204`), with a
+    # one-round causal floor: a message can never arrive in its own
+    # send round. (+1+lat would inflate every hop by one round and bias
+    # stable-latency quantiles vs the reference's wall-clock deadlines.)
+    due = net.round + jnp.maximum(1, lat)
 
     lost = new & (jax.random.uniform(k_loss, (M,)) < net.p_loss)
     keep = new & ~lost

@@ -72,8 +72,8 @@ def test_latency_rounds_delay_delivery():
     net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 9)]), jax.random.PRNGKey(0))
     net, inboxes, _ = pump(cfg, net, rounds=5)
     per_round = [ib.valid.sum() for ib in inboxes]
-    # due = 0 + 1 + 3 = 4 -> delivered in round 4
-    assert per_round == [0, 0, 0, 0, 1]
+    # deadline = now + latency (net.clj:201-204): due = 0 + 3 -> round 3
+    assert per_round == [0, 0, 0, 1, 0]
 
 
 def test_client_zero_latency_and_extraction():
@@ -193,11 +193,11 @@ def test_slow_fast_latency_scale():
     net = T.slow(net, 3.0)
     net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 1)]), jax.random.PRNGKey(0))
     pool = jax.device_get(net.pool)
-    assert pool.due[pool.valid].tolist() == [7]     # 0 + 1 + 2*3
+    assert pool.due[pool.valid].tolist() == [6]     # 0 + 2*3
     net = T.fast(net)
     net, _ = T.send(cfg, net, mk(cfg, [(0, 1, 1, 2)]), jax.random.PRNGKey(1))
     pool = jax.device_get(net.pool)
-    assert sorted(pool.due[pool.valid].tolist()) == [3, 7]
+    assert sorted(pool.due[pool.valid].tolist()) == [2, 6]
 
 
 def test_uniform_and_exponential_latency_distributions():

@@ -3,9 +3,11 @@
 SURVEY.md section 4 calls for exactly this: the batched device network is
 validated against a tiny queue model implementing the documented
 semantics (constant latency L => a message sent in round r is delivered
-in round r + 1 + L; per-node inboxes take the earliest-due messages
-first, capacity losers stay pooled; partitions consume cross-component
-messages; nothing is ever silently dropped while the pool has room).
+in round r + max(1, L) — deadline = now + latency with a one-round
+causal floor, reference `net.clj:201-204`; per-node inboxes take the
+earliest-due messages first, capacity losers stay pooled; partitions
+consume cross-component messages; nothing is ever silently dropped
+while the pool has room).
 Randomized schedules come from hypothesis; failures shrink to minimal
 message schedules."""
 
@@ -51,7 +53,7 @@ def oracle(cfg, schedule, rounds, lat):
     delivered = []
     for r in range(rounds):
         for s, d, a in schedule.get(r, []):
-            in_flight.append((r + 1 + lat, d, a))
+            in_flight.append((r + max(1, lat), d, a))
         got = set()
         by_dest = defaultdict(list)
         for m in in_flight:
