@@ -42,6 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--latency-dist", default="constant",
                    choices=["constant", "uniform", "exponential"],
                    help="Latency distribution shape")
+    t.add_argument("--p-loss", type=float, default=0.0,
+                   help="Probability each message is lost in transit")
     t.add_argument("--nemesis", default="",
                    help="Comma-separated faults (partition)")
     t.add_argument("--nemesis-interval", type=float, default=10.0,
@@ -100,6 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--nodes", type=int, default=4096)
     f.add_argument("--values", type=int, default=32)
     f.add_argument("--seed", type=int, default=0)
+
+    pa = sub.add_parser(
+        "parity", help="Reproduce the reference's protocol-efficiency "
+                       "numbers (msgs-per-op, stable latencies)")
+    pa.add_argument("--quick", action="store_true",
+                    help="CI-sized subset of configs")
     return p
 
 
@@ -128,6 +136,7 @@ def opts_from_args(args) -> dict:
         "time_limit": args.time_limit,
         "concurrency": args.concurrency,
         "latency": {"mean": args.latency, "dist": args.latency_dist},
+        "p_loss": args.p_loss,
         "nemesis": set(filter(None, args.nemesis.split(","))),
         "nemesis_interval": args.nemesis_interval,
         "topology": args.topology,
@@ -159,6 +168,7 @@ DEMOS = [
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
     {"workload": "g-counter", "bin": "demo/python/g_counter.py"},
+    {"workload": "g-counter", "bin": "demo/python/g_counter_seq_kv.py"},
     {"workload": "pn-counter", "bin": "demo/python/pn_counter.py"},
     {"workload": "lin-kv", "bin": "demo/python/lin_kv_proxy.py",
      "concurrency": 10},
@@ -252,6 +262,10 @@ def main(argv=None) -> int:
     if args.cmd == "fuzz":
         from .fuzz import main as fuzz_main
         return fuzz_main(args.nodes, args.values, args.seed)
+
+    if args.cmd == "parity":
+        from .parity import main as parity_main
+        return parity_main(["--quick"] if args.quick else [])
     return 1
 
 

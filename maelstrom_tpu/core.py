@@ -60,6 +60,8 @@ def build_test(opts: dict) -> dict:
 
     net = HostNet(latency=opts["latency"], log_send=opts["log_net_send"],
                   log_recv=opts["log_net_recv"], seed=opts["seed"])
+    if opts.get("p_loss"):
+        net.p_loss = float(opts["p_loss"])
     opts["net"] = net
     workload = registry()[opts["workload"]](opts)
 

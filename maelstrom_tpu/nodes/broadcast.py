@@ -78,7 +78,17 @@ class BroadcastProgram(NodeProgram):
         # round; used by the throughput benchmark. Default is the
         # efficient send-once-plus-retry protocol.
         self.eager_resend = bool(opts.get("eager_resend", False))
-        self.lanes = self.per_nb + 1                  # +1 digest lane
+        # naive mode: forward each new value once per edge (optionally
+        # skipping the arrival edge), no digests, no retransmission —
+        # the exact protocol of the reference's non-retrying
+        # `demo/ruby/broadcast.rb`, whose message economics the tutorial
+        # measurements are built on
+        # (`doc/03-broadcast/02-performance.md:22-260`). Values CAN be
+        # lost under partitions/loss, exactly as the reference
+        # demonstrates — that's the teaching point.
+        self.naive = bool(opts.get("naive_broadcast", False))
+        self.skip_sender = bool(opts.get("skip_sender", True))
+        self.lanes = self.per_nb + (0 if self.naive else 1)  # +digest lane
         self.ring, retry, _lat = edge_timing(opts, len(nodes))
         # a digest for any window returns within the round-trip plus one
         # full window rotation
@@ -99,6 +109,22 @@ class BroadcastProgram(NodeProgram):
                 "inflight_old": jnp.zeros((N, D, V), bool),
                 # digest windows owed per edge (set by gossip arrivals)
                 "owed": jnp.zeros((N, D, self.n_windows), bool)}
+
+    def _select_gossip(self, pending, round_):
+        """Rotating top_k gossip selection per edge: up to `per_nb`
+        pending values, window rotated by round so a slow round trip
+        cannot starve newer values. Returns (sel [N,D,per_nb] bool,
+        topi value indices, sent [N,D,V] one-hot union)."""
+        V = self.V
+        vee = jnp.arange(V, dtype=I32)
+        rot = (vee - round_ * self.per_nb) % V
+        prio = jnp.where(pending, V - rot, 0)
+        topv, topi = jax.lax.top_k(prio, self.per_nb)
+        sel = topv > 0
+        sent = jnp.zeros(pending.shape, bool)
+        for j in range(self.per_nb):
+            sent |= sel[:, :, j, None] & (topi[:, :, j, None] == vee)
+        return sel, topi, sent
 
     def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
         """(state, edge_in [N,D,L], client_in Msgs [N,K]) ->
@@ -128,6 +154,41 @@ class BroadcastProgram(NodeProgram):
 
         new = (arrived.any(axis=1) | cb) & ~seen            # [N, V]
         seen = seen | arrived.any(axis=1) | cb
+
+        # --- client replies (shared by both protocols) ---
+        reply_type = jnp.where(is_cb, T_BCAST_OK,
+                               jnp.where(is_read, T_READ_OK, 0))
+        client_out = client_in.replace(
+            valid=is_cb | is_read, dest=client_in.src,
+            reply_to=client_in.mid, type=reply_type,
+            a=jnp.zeros_like(client_in.a))
+
+        if self.naive:
+            # forward each new value once per edge; skip-sender drops the
+            # FIRST arrival edge only (reference
+            # `doc/03-broadcast/02-performance.md:73-76`: the node
+            # processes one message at a time, so it forwards deg-1
+            # copies on first receipt even when duplicates arrive
+            # concurrently); nothing is retransmitted or acknowledged
+            first_arrival = arrived & (
+                jnp.cumsum(arrived.astype(I32), axis=1) == 1)
+            known = (first_arrival if self.skip_sender
+                     else jnp.zeros((N, D, V), bool))
+            pending = ((pending | (new[:, None, :] & edge_ok[:, :, None]))
+                       & ~known)
+            sel, topi, sent = self._select_gossip(pending, ctx["round"])
+            pending = pending & ~sent
+            edge_out = EdgeMsgs(
+                valid=sel & edge_ok[:, :, None],
+                type=jnp.full((N, D, self.per_nb), T_GOSSIP, I32),
+                a=topi.astype(I32),
+                b=jnp.zeros((N, D, self.per_nb), I32),
+                c=jnp.zeros((N, D, self.per_nb), I32))
+            return ({"seen": seen, "pending": pending,
+                     "inflight": state["inflight"],
+                     "inflight_old": state["inflight_old"],
+                     "owed": state["owed"]},
+                    edge_out, client_out)
 
         # --- digests clear pending for values the neighbor has ---
         d_in = edge_in.valid & (edge_in.type == T_DIGEST)
@@ -164,15 +225,8 @@ class BroadcastProgram(NodeProgram):
         inflight = inflight & ~known & ~requeue
 
         # --- pick gossip to send: rotating top_k per edge ---
-        rot = (vee - ctx["round"] * self.per_nb) % V
-        prio = jnp.where(pending, V - rot, 0)
-        topv, topi = jax.lax.top_k(prio, self.per_nb)       # [N, D, per_nb]
-        sel = topv > 0
+        sel, topi, sent = self._select_gossip(pending, ctx["round"])
         if not self.eager_resend:
-            sent = jnp.zeros((N, D, V), bool)
-            for j in range(self.per_nb):
-                sent |= sel[:, :, j, None] & (topi[:, :, j, None]
-                                              == jnp.arange(V, dtype=I32))
             pending = pending & ~sent
             inflight = inflight | sent
 
@@ -223,14 +277,6 @@ class BroadcastProgram(NodeProgram):
         edge_out = EdgeMsgs(valid=e_valid, type=e_type, a=e_a, b=e_b,
                             c=e_c)
 
-        # --- client replies ---
-        reply_type = jnp.where(is_cb, T_BCAST_OK,
-                               jnp.where(is_read, T_READ_OK, 0))
-        client_out = client_in.replace(
-            valid=is_cb | is_read, dest=client_in.src,
-            reply_to=client_in.mid, type=reply_type,
-            a=jnp.zeros_like(client_in.a))
-
         return ({"seen": seen, "pending": pending, "inflight": inflight,
                  "inflight_old": inflight_old, "owed": owed},
                 edge_out, client_out)
@@ -250,11 +296,15 @@ class BroadcastProgram(NodeProgram):
 
     def encode_body(self, body, intern):
         if body["type"] == "broadcast":
-            i = intern.id(body["message"])
-            if i >= self.V:
-                raise EncodeCapacityError(
-                    f"broadcast value table full ({self.V}); raise "
-                    f"--max-values")
+            i = intern.peek(body["message"])
+            if i is None:
+                if len(intern) >= self.V:
+                    # capacity check before interning: the failure path
+                    # is survivable, so it must not grow the table
+                    raise EncodeCapacityError(
+                        f"broadcast value table full ({self.V}); raise "
+                        f"--max-values")
+                i = intern.id(body["message"])
             return (T_BCAST, i, 0, 0)
         return (T_READ, 0, 0, 0)
 

@@ -75,10 +75,15 @@ class GSetProgram(BroadcastProgram):
 
     def encode_body(self, body, intern):
         if body["type"] == "add":
-            i = intern.id(body["element"])
-            if i >= self.V:
-                raise EncodeCapacityError(f"g-set value table full ({self.V}); "
-                                 f"raise --max-values")
+            i = intern.peek(body["element"])
+            if i is None:
+                if len(intern) >= self.V:
+                    # capacity check before interning (survivable
+                    # failure must not grow the table)
+                    raise EncodeCapacityError(
+                        f"g-set value table full ({self.V}); "
+                        f"raise --max-values")
+                i = intern.id(body["element"])
             return (T_BCAST, i, 0, 0)
         return (T_READ, 0, 0, 0)
 
