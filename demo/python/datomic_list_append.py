@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""A strict-serializable transactional list-append store built on the
+Datomic transactor model, over the built-in services (counterpart of the
+reference's `demo/ruby/datomic_list_append.rb`):
+
+  - the database is a map of key -> *thunk id*; thunks are immutable
+    lists stored in the eventually-consistent `lww-kv` service (safe
+    because a thunk, once written, never changes — last-write-wins
+    can't disagree about a value that's only written once);
+  - the root map itself lives behind a single well-known key in the
+    linearizable `lin-kv` service, advanced by compare-and-set — every
+    transaction serializes through that one CAS, which is what makes
+    the whole store strict-serializable;
+  - thunk ids must be globally unique: each takes a sequence number
+    from the `seq-kv` service (a CAS-bumped counter — sequential
+    consistency suffices for uniqueness) combined with this node's id,
+    amortized by claiming blocks of ids at a time;
+  - immutable thunks are cached forever after first read or write,
+    which is the reference's "caching thunks" optimization
+    (`doc/05-datomic/04-optimization.md`): it removes ~3 messages per
+    transaction.
+
+A CAS race aborts the transaction with error 30 (txn-conflict,
+definite); the checker treats it as a clean abort."""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+
+ROOT = "root"            # well-known key in lin-kv
+VALUE_SVC = "lww-kv"     # immutable thunk storage
+SEQ_SVC = "seq-kv"       # unique-id sequence
+ID_BLOCK = 32            # ids claimed per seq-kv round trip
+
+
+class Ids:
+    """Globally-unique thunk ids: blocks claimed from a seq-kv counter,
+    suffixed with the node id for readability/debugging."""
+
+    def __init__(self):
+        self.next = 0
+        self.limit = 0
+        self.lock = threading.Lock()   # handlers run threaded
+
+    def fresh(self) -> str:
+        with self.lock:
+            return self._fresh()
+
+    def _fresh(self) -> str:
+        if self.next >= self.limit:
+            while True:
+                try:
+                    cur = node.sync_rpc(SEQ_SVC, {"type": "read",
+                                                  "key": "thunk-seq"})
+                    base = cur["value"]
+                except RPCError as e:
+                    if e.code != 20:
+                        raise
+                    base = 0
+                try:
+                    node.sync_rpc(SEQ_SVC, {
+                        "type": "cas", "key": "thunk-seq",
+                        "from": base, "to": base + ID_BLOCK,
+                        "create_if_not_exists": True})
+                except RPCError as e:
+                    if e.code in (20, 22):
+                        continue         # raced another claimant; retry
+                    raise
+                self.next, self.limit = base, base + ID_BLOCK
+                break
+        i = self.next
+        self.next += 1
+        return f"{i}-{node.node_id}"
+
+
+ids = Ids()
+thunk_cache: dict[str, list] = {}      # immutable: cache forever
+
+
+def thunk_read(ptr: str) -> list:
+    """Loads an immutable thunk, retrying while lww-kv replicas catch up
+    (a thunk referenced by the root has been written somewhere; eventual
+    consistency only delays visibility)."""
+    got = thunk_cache.get(ptr)
+    if got is not None:
+        return got
+    while True:
+        try:
+            value = node.sync_rpc(VALUE_SVC,
+                                  {"type": "read", "key": ptr})["value"]
+            thunk_cache[ptr] = value
+            return value
+        except RPCError as e:
+            if e.code != 20:
+                raise
+            time.sleep(0.01)
+
+
+def thunk_write(ptr: str, value: list):
+    node.sync_rpc(VALUE_SVC, {"type": "write", "key": ptr, "value": value})
+    thunk_cache[ptr] = value
+
+
+@node.on("txn")
+def handle_txn(msg):
+    txn = msg["body"]["txn"]
+
+    # load the current root (key -> thunk id)
+    try:
+        root = node.sync_rpc("lin-kv", {"type": "read", "key": ROOT})
+        root = root["value"] or {}
+    except RPCError as e:
+        if e.code != 20:
+            raise
+        root = {}
+
+    # apply micro-ops functionally: reads load thunks, appends create
+    # fresh ones (written before the root moves, so no reader can ever
+    # follow a dangling pointer)
+    root2 = dict(root)
+    completed = []
+    for f, k, v in txn:
+        key = str(k)
+        if f == "r":
+            ptr = root2.get(key)
+            completed.append([f, k, list(thunk_read(ptr)) if ptr else None])
+        elif f == "append":
+            cur = thunk_read(root2[key]) if key in root2 else []
+            ptr = ids.fresh()
+            thunk_write(ptr, list(cur) + [v])
+            root2[key] = ptr
+            completed.append([f, k, v])
+        else:
+            raise RPCError.not_supported(f"unknown micro-op {f!r}")
+
+    # commit: advance the root pointer map iff nobody else did
+    if root2 != root:
+        try:
+            node.sync_rpc("lin-kv", {"type": "cas", "key": ROOT,
+                                     "from": root, "to": root2,
+                                     "create_if_not_exists": True})
+        except RPCError as e:
+            if e.code in (20, 22):
+                raise RPCError.txn_conflict(
+                    "CAS of the database root failed; txn aborted")
+            raise
+    node.reply(msg, {"type": "txn_ok", "txn": completed})
+
+
+if __name__ == "__main__":
+    node.run()

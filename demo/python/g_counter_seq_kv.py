@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""A grow-only counter on the `seq-kv` service (counterpart of the
+reference's `demo/clojure/gcounter.clj`, its only seq-kv client).
+
+The whole counter lives in one seq-kv key, advanced by a CAS loop.
+Sequential consistency means reads can be stale — a node may observe an
+old total — but that's exactly what the g-counter/pn-counter checker
+tolerates: every final read must land in the interval of defensible
+sums, and a monotone counter behind by in-flight adds still does.
+What seq-kv does guarantee (per-key total order + per-client
+monotonicity) makes the CAS loop lose-and-retry rather than fork."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+KEY = "counter"
+
+
+def read_total() -> int:
+    try:
+        return node.sync_rpc("seq-kv", {"type": "read", "key": KEY})["value"]
+    except RPCError as e:
+        if e.code != 20:
+            raise
+        return 0
+
+
+@node.on("add")
+def add(msg):
+    delta = msg["body"]["delta"]
+    if delta != 0:
+        while True:
+            cur = read_total()
+            try:
+                node.sync_rpc("seq-kv", {
+                    "type": "cas", "key": KEY, "from": cur,
+                    "to": cur + delta, "create_if_not_exists": True})
+                break
+            except RPCError as e:
+                if e.code in (20, 22):
+                    continue       # raced another add; retry on fresher state
+                raise
+    node.reply(msg, {"type": "add_ok"})
+
+
+@node.on("read")
+def read(msg):
+    node.reply(msg, {"type": "read_ok", "value": read_total()})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -75,6 +75,7 @@ def become_candidate():
     votes = {node.node_id}
     leader = None
     reset_deadline()
+    node.log(f"became candidate for term {term}")
     li, lt = last_log()
     for peer in other_nodes():
         node.rpc(peer, {"type": "request_vote", "term": term,

@@ -100,14 +100,19 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
         """Walks a chunk's collected client messages, appending ok ops
         for each ack in arrival order (at most one op is ever in flight,
         so FIFO pairing is exact). Each op gets its own process so
-        History.pairs() matches invoke to completion unambiguously."""
+        History.pairs() matches invoke to completion unambiguously.
+        Guards raise (not assert): the docstring's honesty contract must
+        survive python -O."""
         valid = np.asarray(cm_chunk.valid)         # [chunk, CC]
         types = np.asarray(cm_chunk.type)
         for i in range(valid.shape[0]):
             for j in np.nonzero(valid[i])[0]:
                 t = int(types[i, j])
-                assert t == expect_type, (t, expect_type)
-                assert outstanding, "ack with nothing in flight"
+                if t != expect_type:
+                    raise RuntimeError(
+                        f"unexpected reply type {t} (want {expect_type})")
+                if not outstanding:
+                    raise RuntimeError("ack with nothing in flight")
                 kind, val, inv_r, proc = outstanding.pop(0)
                 value = (read_values[val] if read_values is not None
                          else val)
@@ -131,10 +136,11 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
         if r >= 2 * V and bool(jax.device_get(conv_fn(sim))):
             converged_at = r
             break
-    assert not outstanding, f"{len(outstanding)} broadcasts never acked"
     if converged_at is None:
         raise SystemExit(f"graded run did not converge in {max_rounds} "
                          f"rounds")
+    if outstanding:
+        raise RuntimeError(f"{len(outstanding)} broadcasts never acked")
     if verbose:
         print(f"graded: converged at round {converged_at} "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
@@ -168,7 +174,8 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
         r += chunk
         if r > last_read_round + 4 * chunk:
             break
-    assert not outstanding, f"{len(outstanding)} reads never acked"
+    if outstanding:
+        raise RuntimeError(f"{len(outstanding)} reads never acked")
 
     # --- grade with the stock checker ---
     ops.sort(key=lambda o: (o.time, o.type != "invoke"))

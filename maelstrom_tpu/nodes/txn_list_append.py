@@ -12,8 +12,9 @@ point. Total order through a single log => strict serializability, the
 default consistency model the checker demands (`core.clj:126-131`).
 
 The reference reaches the same guarantee differently (CAS on a root
-pointer in lin-kv, `demo/ruby/datomic_list_append.rb` — see
-`demo/python/datomic_list_append.py` for that design on the host path);
+pointer in lin-kv over immutable thunks, `demo/ruby/datomic_list_append.rb`
+— see `demo/python/datomic_list_append.py` for that design on the host
+path);
 running the data plane through raft instead exercises the batched
 consensus machinery end to end."""
 

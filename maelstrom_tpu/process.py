@@ -57,9 +57,16 @@ class NodeProcess:
         self.log_writer = open(log_file, "w")
         bin_path = os.path.abspath(bin)
         log.info("launching %s %r", bin_path, args)
+        # Node binaries are plain protocol speakers: strip accelerator
+        # hookup vars so images whose sitecustomize registers a remote
+        # backend (e.g. the tunneled-TPU 'axon' one, ~2 s of import per
+        # interpreter) don't tax every spawned node — at 5 nodes on one
+        # core that serialized past the 10 s init handshake.
+        child_env = {k: v for k, v in os.environ.items()
+                     if not k.startswith(("PALLAS_AXON_", "AXON_"))}
         self.process = subprocess.Popen(
             [bin_path] + list(args),
-            cwd=dir or None,
+            cwd=dir or None, env=child_env,
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, bufsize=1)
         self.log_stderr = log_stderr
