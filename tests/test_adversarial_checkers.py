@@ -1,0 +1,295 @@
+"""Adversarial checker corpus: for every Elle-lite anomaly class and the
+WGL register checker's edge cases, pin BOTH that the anomaly fires on a
+minimal bad history AND that it does not false-fire on the nearest legal
+neighbor of that history. This is the cross-validation discipline the
+reference outsources to Elle/Knossos's own suites
+(`workload/txn_list_append.clj:112-124`)."""
+
+from maelstrom_tpu.checkers.elle import ElleListAppendChecker, analyze
+from maelstrom_tpu.checkers.linearizable import check_register_history
+
+INF = float("inf")
+
+
+def op(f, value, inv, ret, ok=True):
+    return {"f": f, "value": value, "inv": inv, "ret": ret, "ok": ok}
+
+
+def _txn_pair(h, micro_in, micro_out, t0, t1, typ="ok", proc=0):
+    h.append({"type": "invoke", "f": "txn", "value": micro_in,
+              "process": proc, "time": t0})
+    h.append({"type": typ, "f": "txn",
+              "value": micro_out if typ == "ok" else micro_in,
+              "process": proc, "time": t1})
+
+
+def _check(h, models=("strict-serializable",)):
+    return ElleListAppendChecker(list(models)).check({}, h)
+
+
+# --- G0: pure write cycle ---
+
+def test_g0_fires():
+    # key 1 order says T0 < T1; key 2 order says T1 < T0: ww cycle
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 2]],
+              [["append", 1, 1], ["append", 2, 2]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [1, 2]]], 12, 13)
+    r = _check(h, ["read-uncommitted"])
+    assert r["valid"] is False and "G0" in r["anomalies"], r
+
+
+def test_g0_near_miss_consistent_orders():
+    # same structure, but both keys agree on the order: no cycle
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 1]],
+              [["append", 1, 1], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 2]],
+              [["append", 1, 2], ["append", 2, 2]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [1, 2]]], 12, 13)
+    assert _check(h)["valid"] is True
+
+
+def test_g0_near_miss_same_txn_multi_append():
+    # both versions of both keys written by ONE txn: succession inside a
+    # transaction is not a ww edge, so no cycle can form
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2],
+                  ["append", 2, 2], ["append", 2, 1]],
+              [["append", 1, 1], ["append", 1, 2],
+               ["append", 2, 2], ["append", 2, 1]], 0, 1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [2, 1]]], 2, 3)
+    assert _check(h)["valid"] is True
+
+
+# --- G1a: aborted read / near-miss: indeterminate read ---
+
+def test_g1a_near_miss_info_txn_observed():
+    # an *indeterminate* (info) append being observed is legal — the txn
+    # may well have committed; only a definite fail makes it G1a
+    h = []
+    _txn_pair(h, [["append", 1, 9]], None, 0, 1, typ="info")
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [9]]], 2, 3)
+    r = _check(h)
+    assert r["valid"] is True, r
+
+
+# --- G1b: intermediate read / near-miss: final-state read ---
+
+def test_g1b_fires():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2]],
+              [["append", 1, 1], ["append", 1, 2]], 0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 2, 3)
+    r = _check(h, ["read-committed"])
+    assert r["valid"] is False and "G1b" in r["anomalies"], r
+
+
+def test_g1b_near_miss_reads_final_state():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2]],
+              [["append", 1, 1], ["append", 1, 2]], 0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 2, 3)
+    assert _check(h)["valid"] is True
+
+
+# --- G1c: ww/wr cycle (no rw) / near-miss: chain without closure ---
+
+def test_g1c_fires():
+    # T0 appends 1:1 and reads key 2 seeing T1's write (wr: T1->T0);
+    # T1 appends 2:1 after observing... make T0 -[ww]-> T1 via key 1:
+    # T1 also appends 1:2. Cycle: T0 -[ww key1]-> T1 -[wr key2]-> T0.
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["r", 2, None]],
+              [["append", 1, 1], ["r", 2, [1]]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 12, 13)
+    r = _check(h, ["read-committed"])
+    assert r["valid"] is False
+    assert "G1c" in r["anomalies"], r
+
+
+def test_g1c_near_miss_open_chain():
+    # same edges minus the closing wr: T0 -[ww]-> T1 only
+    h = []
+    _txn_pair(h, [["append", 1, 1]],
+              [["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [1]]], 12, 13)
+    assert _check(h)["valid"] is True
+
+
+# --- G2: multiple rw edges (write skew) / near-miss G-single labeling ---
+
+def test_g2_write_skew_fires_and_is_not_g_single():
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 2, 1]],
+              [["r", 1, []], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 2, None], ["append", 1, 1]],
+              [["r", 2, []], ["append", 1, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1]], ["r", 2, [1]]], 12, 13)
+    r = _check(h, ["serializable"])
+    assert r["valid"] is False and "G2" in r["anomalies"], r
+    assert "G-single" not in (r["anomalies"] or {})
+
+
+def test_g_single_fires_with_one_rw():
+    # T0 -[wr]-> T1 -[rw]-> T0: exactly one anti-dependency
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 1]],
+              [["append", 1, 1], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1]], ["r", 2, []]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 2, None]], [["r", 2, [1]]], 12, 13)
+    r = _check(h, ["serializable"])
+    assert r["valid"] is False and "G-single" in r["anomalies"], r
+
+
+def test_g2_near_miss_reads_in_serial_order():
+    # the same two txns, but each observes the other's write: serial
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 2, 1]],
+              [["r", 1, []], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 2, None], ["append", 1, 1]],
+              [["r", 2, [1]], ["append", 1, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1]], ["r", 2, [1]]], 12, 13)
+    assert _check(h)["valid"] is True
+
+
+# --- phantom / duplicates ---
+
+def test_phantom_element_fires():
+    h = []
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [42]]], 0, 1)
+    r = _check(h)
+    assert r["valid"] is False and "phantom-element" in r["anomalies"]
+
+
+def test_duplicate_appends_fire():
+    h = []
+    _txn_pair(h, [["append", 1, 7]], [["append", 1, 7]], 0, 1, proc=0)
+    _txn_pair(h, [["append", 1, 7]], [["append", 1, 7]], 2, 3, proc=1)
+    r = _check(h)
+    assert r["valid"] is False and "duplicate-appends" in r["anomalies"]
+
+
+# --- realtime: long concurrent windows must NOT create rt edges ---
+
+def test_realtime_near_miss_concurrent_window():
+    # T1 misses T0's append, but their windows overlap: serializable
+    # order T1 < T0 is legal even under strict serializability
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, []]], 5, 15, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 16, 17, proc=0)
+    r = _check(h, ["strict-serializable"])
+    assert r["valid"] is True, r
+
+
+def test_realtime_fires_only_past_the_gap():
+    # shrink the overlap to nothing and the same reads become illegal
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 4, proc=0)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, []]], 5, 15, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 16, 17, proc=0)
+    r = _check(h, ["strict-serializable"])
+    assert r["valid"] is False, r
+    assert any(k.endswith("-realtime") for k in r["anomalies"]), r
+
+
+def test_cycle_witness_matches_classification():
+    # every reported cycle carries a witness whose edge kinds justify
+    # the label (the explain() contract)
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 2]],
+              [["append", 1, 1], ["append", 2, 2]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [1, 2]]], 12, 13)
+    anoms = analyze(h)
+    for kind, items in anoms.items():
+        for item in items:
+            if isinstance(item, dict) and "cycle" in item:
+                assert "-[" in item["cycle"] and "txn-ops" in item
+
+
+# --- WGL register checker edge cases ---
+
+def test_wgl_long_concurrent_window_is_not_a_free_pass():
+    # a slow write spans many fast reads. Old-then-new is legal (the
+    # write linearizes between them, inside its window)...
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, 100),          # the long window
+           op("read", 1, 10, 11),
+           op("read", 2, 20, 21),
+           op("read", 2, 30, 31)]
+    assert check_register_history(ops)["valid"] is True
+    # ...but new-then-old is NOT: once any read observed the write,
+    # later reads can't flip back, however wide the window still is
+    ops = [op("write", 1, 0, 1),
+           op("write", 2, 2, 100),
+           op("read", 2, 10, 11),
+           op("read", 1, 20, 21)]
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_wgl_indeterminate_cas_chain():
+    # two info cas ops form a chain 1->2->3; a later read of 3 is
+    # explainable only if BOTH took effect — the checker must find it
+    ops = [op("write", 1, 0, 1),
+           op("cas", [1, 2], 2, INF, ok=False),
+           op("cas", [2, 3], 3, INF, ok=False),
+           op("read", 3, 10, 11)]
+    assert check_register_history(ops)["valid"] is True
+    # but a read of 3 while the 2->3 cas could never have applied
+    # (its precondition 2 was never writable) is illegal
+    ops = [op("write", 1, 0, 1),
+           op("cas", [2, 3], 3, INF, ok=False),
+           op("read", 3, 10, 11)]
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_wgl_indeterminate_cas_applies_at_most_once():
+    # an info cas may apply 0 or 1 times — never twice. 1->2 then a
+    # read of 1 then a read of 2 would need it to apply after un-applying
+    ops = [op("write", 1, 0, 1),
+           op("cas", [1, 2], 2, INF, ok=False),
+           op("read", 2, 10, 11),
+           op("write", 1, 12, 13),
+           op("read", 2, 14, 15)]
+    # second read of 2 needs a SECOND application: illegal
+    assert check_register_history(ops)["valid"] is False
+
+
+def test_wgl_definite_fail_excluded_at_checker_level():
+    # a definite :fail cas must NOT be applied — the per-key checker
+    # drops it before the search (client.clj:214-233 semantics), so a
+    # later read of the would-be value is a real violation
+    from maelstrom_tpu.checkers.linearizable import \
+        LinearizableRegisterChecker
+
+    def hop(typ, f, value, proc, t):
+        return {"type": typ, "f": f, "value": value, "process": proc,
+                "time": t}
+
+    h = [hop("invoke", "write", [0, 1], 0, 0), hop("ok", "write", [0, 1], 0, 1),
+         hop("invoke", "cas", [0, [1, 2]], 0, 2),
+         hop("fail", "cas", [0, [1, 2]], 0, 3),
+         hop("invoke", "read", [0, None], 0, 4),
+         hop("ok", "read", [0, 1], 0, 5)]
+    assert LinearizableRegisterChecker().check({}, h)["valid"] is True
+    # had the failed cas's effect leaked, this read would be "fine";
+    # the checker must reject it because the cas definitely didn't run
+    h[-1] = hop("ok", "read", [0, 2], 0, 5)
+    assert LinearizableRegisterChecker().check({}, h)["valid"] is False

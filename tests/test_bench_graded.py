@@ -21,7 +21,7 @@ def test_graded_broadcast_small(tmp_path):
     assert c["stable-count"] == 16
     assert c["lost-count"] == 0 and c["stale-count"] == 0
     assert s["dropped_overflow"] == 0
-    # stable latencies are measured (ms from invoke to stability)
+    # stable latencies are measured (known -> last-absent lag)
     assert c["stable-latencies"]["0.5"] is not None
 
     # artifacts written and loadable
