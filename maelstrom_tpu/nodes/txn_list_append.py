@@ -46,6 +46,10 @@ def apply_txn(db: dict, txn) -> tuple[dict, list]:
 class TxnRaftProgram(RaftProgram):
     name = "txn-list-append"
     needs_state_reads = True
+    # completion() reads only committed log entries (final and
+    # replica-identical), so end-of-stretch state reads are exact and the
+    # runner's collect-replies scan mode stays sound
+    state_reads_final = True
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)

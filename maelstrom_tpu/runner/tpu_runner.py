@@ -33,7 +33,7 @@ from ..history import History, Op
 from ..nemesis import GRUDGES
 from ..net import tpu as T
 from ..nodes import HOST, EncodeCapacityError, Intern, get_program
-from ..sim import SimState, make_round_fn, make_sim
+from ..sim import SimState, make_sim
 
 log = logging.getLogger("maelstrom.tpu")
 
@@ -213,14 +213,25 @@ class TpuRunner:
         if test.get("p_loss"):
             self.sim = self.sim.replace(
                 net=T.flaky(self.sim.net, float(test["p_loss"])))
-        self.round_fn = make_round_fn(self.program, self.cfg)
         self._scan_fn = None         # built lazily
         self._scan_journal_fn = None  # journaled variant (io-collecting)
         self._pack_buf = None         # single-array packers (remote
-        self._pack_round = None       # backends pay a RT per array)
+        self._pack_replies = None     # backends pay a RT per array)
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
-        self.journal_scan_cap = int(test.get("journal_scan_cap", 64))
+        self.journal_scan_cap = int(test.get("journal_scan_cap", 256))
+        self.reply_log_cap = int(test.get("reply_log_cap", 256))
+        # collect-replies mode: scans cross whole reply-bearing stretches
+        # (the per-reply early exit costs ~3 dispatches per op; on remote
+        # backends each dispatch is a ~160 ms round trip). Requires reply
+        # completions not to read mutable device state: values
+        # materialized via read_state would otherwise reflect
+        # end-of-stretch state instead of reply-round state. Committed
+        # raft log prefixes are immutable, so txn opts back in via
+        # state_reads_final.
+        self.collect_replies = bool(test.get("collect_replies", True)) and (
+            not self.program.needs_state_reads
+            or getattr(self.program, "state_reads_final", False))
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
@@ -281,6 +292,27 @@ class TpuRunner:
                 off += n_el
             return jax.tree.unflatten(treedef, out)
         return pack, unpack
+
+    def _stop_on_reply(self, gen, ctx, pending, free) -> bool:
+        """True = the scan must EXIT at the first client reply; False =
+        it may cross whole reply-bearing stretches. Crossing is safe iff
+        a completion cannot move the generator's next emission earlier
+        than the scan bound. The `Gen.next_interesting_time` contract
+        encodes exactly this: a finite time means purely time-gated
+        (completions don't move it); +inf means only a completion event
+        can unblock (worker-starved emission, EachThread waiting on a
+        specific process, Phases waiting on quiescence). Worker
+        starvation is additionally checked directly, because a mixed
+        generator (e.g. a time-gated nemesis beside starved clients) can
+        report the finite branch's time."""
+        if not self.collect_replies:
+            return True
+        if not pending:
+            return False            # nothing in flight: no replies at all
+        if not (set(ctx["free"]) - {g.NEMESIS}):
+            return True             # starved: a completion enables emission
+        import math
+        return gen.next_interesting_time(ctx) == math.inf
 
     def _scan_bound(self, gen, ctx, pending, r, next_ckpt,
                     max_rounds) -> int:
@@ -437,104 +469,104 @@ class TpuRunner:
                     next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
+            # one fused dispatch: this round's injections (possibly none)
+            # plus the scan to the next host-relevant round, with every
+            # reply collected into a compact log. On remote backends each
+            # dispatch is a full round trip, so op count per dispatch is
+            # the whole performance story.
+            inject = T.Msgs.empty(max(C, 1))
             if inject_rows:
-                inject = T.Msgs.empty(max(C, 1))
-                if inject_rows:
-                    M = len(inject_rows)
-                    proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
-                    inject = inject.replace(
-                        valid=jnp.arange(max(C, 1)) < M,
-                        src=jnp.asarray(
-                            list(np.array(proc) + N) + [0] * (max(C, 1) - M),
-                            T.I32),
-                        dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
-                                         T.I32),
-                        type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
-                                         T.I32),
-                        a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M),
-                                      T.I32),
-                        b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M),
-                                      T.I32),
-                        c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
-                                      T.I32))
-                    # next_mid is mirrored on the host (refreshed in every
-                    # dispatch's combined fetch) — reading it from the
-                    # device here would cost a round trip per injection
-                    base_mid = self._next_mid
-                    for j, (p, o, ni, *_rest) in enumerate(inject_rows):
-                        pending[base_mid + j] = (p, o, ni,
-                                                 r + self.timeout_rounds)
+                M = len(inject_rows)
+                proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
+                inject = inject.replace(
+                    valid=jnp.arange(max(C, 1)) < M,
+                    src=jnp.asarray(
+                        list(np.array(proc) + N) + [0] * (max(C, 1) - M),
+                        T.I32),
+                    dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
+                                     T.I32),
+                    type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
+                                     T.I32),
+                    a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M),
+                                  T.I32),
+                    b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M),
+                                  T.I32),
+                    c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
+                                  T.I32))
+                # next_mid is mirrored on the host (refreshed in every
+                # dispatch's combined fetch) — reading it from the
+                # device here would cost a round trip per injection
+                base_mid = self._next_mid
+                for j, (p, o, ni, *_rest) in enumerate(inject_rows):
+                    pending[base_mid + j] = (p, o, ni,
+                                             r + self.timeout_rounds)
 
-                self.sim, client_msgs, io = self.round_fn(self.sim, inject)
-                self._state_cache = None
-                if self.journal is not None:
-                    if self._pack_round is None:
-                        self._pack_round = self._make_packer(io)
-                    pack, unpack = self._pack_round
-                    client_msgs, flat, self._next_mid = jax.device_get(
-                        (client_msgs, pack(io), self.sim.net.next_mid))
-                    io = unpack(flat)
-                else:
-                    client_msgs, self._next_mid = jax.device_get(
-                        (client_msgs, self.sim.net.next_mid))
-                self._next_mid = int(self._next_mid)
-                if self.journal is not None:
-                    self._journal_round(io, client_msgs, r)
-                r += 1
-            elif self.journal is not None:
-                # journaled scan-ahead: same early-exit semantics, but
-                # every scanned round's io is collected for the journal
+            # bound computed with the just-injected ops already pending,
+            # so their timeout deadlines cap the stretch
+            k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+                                     max_rounds)
+            stop = self._stop_on_reply(gen, ctx, pending, free)
+            if self.journal is not None:
                 if self._scan_journal_fn is None:
                     from ..sim import make_scan_fn
                     self._scan_journal_fn = make_scan_fn(
-                        program, cfg, journal_cap=self.journal_scan_cap)
-                k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
-                                         max_rounds)
-                self.sim, client_msgs, k, buf = self._scan_journal_fn(
-                    self.sim, jnp.int32(k_max))
+                        program, cfg, journal_cap=self.journal_scan_cap,
+                        reply_cap=self.reply_log_cap)
+                self.sim, _cm, k, rl, buf = self._scan_journal_fn(
+                    self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
                 if self._pack_buf is None:
-                    self._pack_buf = self._make_packer(buf)
+                    self._pack_buf = self._make_packer((buf, rl))
                 pack, unpack = self._pack_buf
-                client_msgs, k, flat, self._next_mid = jax.device_get(
-                    (client_msgs, k, pack(buf), self.sim.net.next_mid))
+                k, flat, self._next_mid = jax.device_get(
+                    (k, pack((buf, rl)), self.sim.net.next_mid))
                 k, self._next_mid = int(k), int(self._next_mid)
-                buf = unpack(flat)
-                quiet_cm = jax.tree.map(np.zeros_like, client_msgs)
+                buf, (rlog, rounds, rn) = unpack(flat)
+                quiet_cm = jax.tree.map(
+                    lambda a: np.zeros_like(a[:max(C, 1)]), rlog)
                 for i in range(k):
                     io_i = jax.tree.map(lambda b, i=i: b[i], buf)
-                    self._journal_round(
-                        io_i, client_msgs if i == k - 1 else quiet_cm,
-                        r + i)
-                r += k
+                    self._journal_round(io_i, quiet_cm, r + i)
+                rn = int(rn)
+                if rn:
+                    # reply recv rows at their true rounds (stamps are
+                    # post-round: the producing round is stamp-1)
+                    self.journal.log_batch(
+                        "recv", rlog.mid[:rn],
+                        np.asarray([self._time_ns(int(s) - 1)
+                                    for s in rounds[:rn]]),
+                        rlog.src[:rn], rlog.dest[:rn],
+                        node_names=self.node_names)
             else:
-                # nothing to inject and no journal: cross the idle stretch
-                # in one compiled dispatch (early exit on any client reply)
                 if self._scan_fn is None:
                     from ..sim import make_scan_fn
-                    self._scan_fn = make_scan_fn(program, cfg)
-                k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
-                                         max_rounds)
-                self.sim, client_msgs, k = self._scan_fn(
-                    self.sim, jnp.int32(k_max))
+                    self._scan_fn = make_scan_fn(
+                        program, cfg, reply_cap=self.reply_log_cap)
+                self.sim, _cm, k, rl = self._scan_fn(
+                    self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
-                client_msgs, k, self._next_mid = jax.device_get(
-                    (client_msgs, k, self.sim.net.next_mid))
-                self._next_mid = int(self._next_mid)
-                r += int(k)
+                if self._pack_replies is None:
+                    self._pack_replies = self._make_packer(rl)
+                pack, unpack = self._pack_replies
+                k, flat, self._next_mid = jax.device_get(
+                    (k, pack(rl), self.sim.net.next_mid))
+                k, self._next_mid = int(k), int(self._next_mid)
+                rlog, rounds, rn = unpack(flat)
+                rn = int(rn)
+            replies = [(int(rounds[j]), int(rlog.type[j]),
+                        int(rlog.a[j]), int(rlog.b[j]),
+                        int(rlog.c[j]), int(rlog.reply_to[j]))
+                       for j in range(rn)]
+            r += k
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
-            cm = client_msgs      # already numpy (fetched by each branch)
-            for i in np.nonzero(cm.valid)[0]:
-                rt = int(cm.reply_to[i])
+            for stamp, t_, a_, b_, c_, rt in replies:
                 entry = pending.pop(rt, None)
                 if entry is None:
                     continue        # stale reply (client.clj:167-168)
                 process, op, node_idx, _dl = entry
-                body = program.decode_body(int(cm.type[i]), int(cm.a[i]),
-                                           int(cm.b[i]), int(cm.c[i]),
-                                           self.intern)
+                body = program.decode_body(t_, a_, b_, c_, self.intern)
                 if body.get("type") == "error":
                     err = ERROR_REGISTRY.get(body.get("code"))
                     definite = err.definite if err else False
@@ -547,8 +579,11 @@ class TpuRunner:
                     completed = program.completion(
                         op, body, lambda i2=node_idx: self._read_state(i2),
                         self.intern)
-                gen = self._complete(history, gen, ctx, process, completed,
-                                     free)
+                cctx = {"time": self._time_ns(stamp),
+                        "free": self._free_rotated(free, history),
+                        "processes": processes}
+                gen = self._complete(history, gen, cctx, process,
+                                     completed, free)
 
             # timeouts -> indefinite :info (client.clj:214-233)
             expired = [m for m, (_, _, _, dl) in pending.items() if dl <= r]

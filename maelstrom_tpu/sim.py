@@ -139,44 +139,91 @@ def make_round_fn(program, cfg: NetConfig):
     return jax.jit(partial(_round, program, cfg))
 
 
-def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None):
+def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
+                 reply_cap: int | None = None):
     """Jitted scan-ahead: runs up to k_max injection-free rounds in ONE
-    dispatch, stopping early at the first round that produces a client
-    reply (lax.while_loop). The interactive runner uses this to cross the
-    idle stretches between generator events — e.g. at rate 5/s and 1 ms
-    rounds, ~200 rounds separate client ops; per-round dispatch would pay
-    ~200 host round-trips where this pays one.
+    dispatch (lax.while_loop). The interactive runner uses this to cross
+    the idle stretches between generator events — e.g. at rate 5/s and
+    1 ms rounds, ~200 rounds separate client ops; per-round dispatch
+    would pay ~200 host round-trips where this pays one.
 
-    scan_fn(sim, k_max) -> (sim', client_msgs_of_last_round, k_executed),
-    k_executed >= 1. Observable behavior matches k_executed sequential
-    `_round` calls exactly (same PRNG stream, same reply round).
+    scan_fn(sim, inject, k_max, stop_on_reply) -> (sim',
+    client_msgs_of_last_round, k_executed[, replies][, io_buf]),
+    k_executed >= 1. `inject` (a Msgs batch, possibly all-invalid) is
+    applied in the FIRST round, so an injection and the idle crossing
+    that follows it share one dispatch. Observable behavior matches an
+    injected `_round` followed by k_executed-1 empty rounds exactly
+    (same PRNG stream, same reply rounds).
 
-    With `journal_cap` set, every scanned round's journal io is also
-    collected into [cap, ...] buffers and returned as a fourth element
-    (rows beyond k_executed are zeros); the cap bounds k_max. The
-    interactive runner uses this variant when a journal is attached, so
-    journaling no longer forces one dispatch per round. Client replies
-    only appear in the final executed round (the loop exits on the first
-    reply), so per-round client message buffers are unnecessary."""
+    `stop_on_reply` (traced bool): when True the loop exits at the first
+    round producing a client reply — required when a completion may move
+    the generator's next event (worker-starved emission, phase
+    advancement on quiescence). When the host proves the next event is
+    purely time-gated, it passes False and the scan crosses whole
+    reply-bearing stretches in one dispatch, with every reply collected.
 
-    empty = Msgs.empty(max(cfg.n_clients, 1))
+    With `reply_cap` set, every client reply in the scanned stretch is
+    appended to a compact log (`replies` = Msgs [reply_cap] + a `rounds`
+    i32 array + a count) for the host to replay in order; the loop also
+    exits when the log could overflow on the next round. With
+    `journal_cap` set, every scanned round's journal io is additionally
+    collected into [cap, ...] buffers (rows beyond k_executed are
+    zeros); that cap bounds k_max."""
+
+    CC = max(cfg.n_clients, 1)
+    empty = Msgs.empty(CC)
     cap = None if journal_cap is None else max(1, int(journal_cap))
+    # the client-message batch a round produces can be wider than the
+    # inject width (reply buffers size by client_cap); the real width is
+    # read off the first round's output at trace time, and the log always
+    # reserves one full batch of headroom so a permitted round can never
+    # overflow it
+    rcap_req = None if reply_cap is None else max(1, int(reply_cap))
+    rcap = None
+    cw = None
+
+    def append_replies(rlog, rounds, rn, cm, round_i):
+        """Compacts this round's valid client msgs onto the reply log.
+        Invalid rows scatter to an out-of-bounds index and are dropped,
+        so duplicate-position writes cannot clobber real replies."""
+        offs = jnp.cumsum(cm.valid.astype(I32)) - cm.valid.astype(I32)
+        pos = jnp.where(cm.valid, rn + offs, rcap)      # OOB when invalid
+
+        def upd(dst, src):
+            return dst.at[pos].set(src, mode="drop")
+        rlog = jax.tree.map(upd, rlog, cm)
+        rounds = rounds.at[pos].set(
+            jnp.broadcast_to(round_i, pos.shape), mode="drop")
+        return rlog, rounds, rn + jnp.sum(cm.valid.astype(I32))
 
     def cond(st):
-        _sim, cm, k, k_max, _buf = st
-        return (~cm.valid.any()) & (k < k_max)
+        _sim, cm, k, k_max, stop, _buf, _rlog, _rounds, rn = st
+        go = k < k_max
+        go = go & ~(stop & cm.valid.any())
+        if rcap_req is not None:
+            go = go & (rn + cw <= rcap)
+        return go
 
     def body(st):
-        sim, _cm, k, k_max, buf = st
+        sim, _cm, k, k_max, stop, buf, rlog, rounds, rn = st
         sim2, cm2, io = _round(program, cfg, sim, empty)
         if cap is not None:
             buf = jax.tree.map(lambda b, x: b.at[k].set(x), buf, io)
-        return (sim2, cm2, k + jnp.int32(1), k_max, buf)
+        if rcap is not None:
+            # stamp with the post-round counter: the host processes a
+            # reply at the round after its producing dispatch, and the
+            # replay must use identical times
+            rlog, rounds, rn = append_replies(rlog, rounds, rn, cm2,
+                                              sim2.net.round)
+        return (sim2, cm2, k + jnp.int32(1), k_max, stop, buf, rlog,
+                rounds, rn)
 
     @jax.jit
-    def scan_fn(sim: SimState, k_max):
-        sim1, cm1, io1 = _round(program, cfg, sim, empty)
+    def scan_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply=True):
+        nonlocal rcap, cw
+        sim1, cm1, io1 = _round(program, cfg, sim, inject)
         k_max = jnp.int32(k_max)
+        stop = jnp.asarray(stop_on_reply, bool)
         if cap is None:
             buf = ()
         else:
@@ -184,11 +231,24 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None):
                 lambda x: jnp.zeros((cap,) + x.shape, x.dtype), io1)
             buf = jax.tree.map(lambda b, x: b.at[0].set(x), buf, io1)
             k_max = jnp.minimum(k_max, cap)
-        st = (sim1, cm1, jnp.int32(1), k_max, buf)
-        sim2, cm, k, _, buf = jax.lax.while_loop(cond, body, st)
-        if cap is None:
-            return sim2, cm, k
-        return sim2, cm, k, buf
+        if rcap_req is None:
+            rlog, rounds, rn = (), jnp.zeros(0, I32), jnp.int32(0)
+        else:
+            cw = int(cm1.valid.shape[0])
+            rcap = max(rcap_req, 2 * cw)
+            rlog = Msgs.empty(rcap)
+            rounds = jnp.zeros(rcap, I32)
+            rlog, rounds, rn = append_replies(rlog, rounds, jnp.int32(0),
+                                              cm1, sim1.net.round)
+        st = (sim1, cm1, jnp.int32(1), k_max, stop, buf, rlog, rounds, rn)
+        sim2, cm, k, _, _, buf, rlog, rounds, rn = jax.lax.while_loop(
+            cond, body, st)
+        out = (sim2, cm, k)
+        if rcap is not None:
+            out = out + ((rlog, rounds, rn),)
+        if cap is not None:
+            out = out + (buf,)
+        return out
 
     return scan_fn
 

@@ -55,6 +55,52 @@ def test_scan_equivalence_under_worker_saturation(tmp_path):
     assert _ops(h1) == _ops(h2)
 
 
+def test_collect_replies_scan_matches_per_round(tmp_path):
+    """lin-kv enables the collect-replies scan mode (no per-reply early
+    exit); histories must still match per-round dispatch exactly,
+    including completion times."""
+    over = {"workload": "lin-kv", "node": "tpu:lin-kv", "rate": 20.0,
+            "time_limit": 2.5}
+    r1, _ = _run(tmp_path / "a", max_scan=1, **over)
+    h1 = r1.run()
+    r2, t2 = _run(tmp_path / "b", **over)
+    assert r2.collect_replies is True
+    h2 = r2.run()
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
+    res = t2["workload_map"]["checker"].check(t2, h2, {})
+    assert res["valid"], res
+
+
+def test_collect_replies_saturated_matches_per_round(tmp_path):
+    """Worker starvation on a collect-enabled workload: every reply
+    enables the next emission, so the runner must fall back to
+    stop-on-reply (the starvation check in _stop_on_reply) and histories
+    must still match per-round dispatch exactly."""
+    over = {"workload": "echo", "node": "tpu:echo", "rate": 2000.0,
+            "concurrency": 2, "time_limit": 1.0, "nemesis": set()}
+    r1, _ = _run(tmp_path / "a", max_scan=1, **over)
+    h1 = r1.run()
+    r2, _ = _run(tmp_path / "b", **over)
+    assert r2.collect_replies is True
+    h2 = r2.run()
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
+
+
+def test_collect_replies_off_matches_too(tmp_path):
+    """The collect_replies=False escape hatch is observationally
+    identical as well."""
+    over = {"workload": "lin-kv", "node": "tpu:lin-kv", "rate": 20.0,
+            "time_limit": 2.0}
+    r1, _ = _run(tmp_path / "a", collect_replies=False, **over)
+    assert r1.collect_replies is False
+    h1 = r1.run()
+    r2, _ = _run(tmp_path / "b", **over)
+    h2 = r2.run()
+    assert _ops(h1) == _ops(h2)
+
+
 def test_journaled_scan_matches_per_round_journal(tmp_path):
     """With a journal attached, the io-collecting scan must produce the
     same history AND the same journal events as per-round dispatch."""
