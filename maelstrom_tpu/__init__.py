@@ -15,7 +15,7 @@ external node binaries over newline-delimited JSON stdio, exactly like the
 reference.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # Lazy public API: resolving on first access keeps `import maelstrom_tpu`
 # free of jax/numpy imports (several entry points re-pin the platform

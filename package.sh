@@ -4,7 +4,7 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-VERSION="${1:-0.1.0}"
+VERSION="${1:-0.2.0}"
 OUT="maelstrom-tpu-${VERSION}"
 
 python3 -m maelstrom_tpu doc
