@@ -1,0 +1,133 @@
+/* A Maelstrom-protocol echo node in C — proof that nodes are ordinary
+ * binaries in any language (doc/protocol.md; the counterpart of the
+ * reference's multi-language demo surface, demo/ruby + demo/clojure).
+ *
+ * Reads newline-delimited JSON messages on stdin, answers `init` with
+ * `init_ok` and `echo` with `echo_ok`, logs to stderr. No JSON library:
+ * a small string-aware scanner extracts the fields this protocol needs
+ * (msg_id, src, and the raw text of the "echo" value, spliced verbatim
+ * into the reply so any JSON payload round-trips exactly).
+ *
+ * Build: make -C demo/c     Run: ./maelstrom test -w echo --bin demo/c/echo
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* Skips a JSON string starting at s (s[0] == '"'); returns the index
+ * one past the closing quote, honoring backslash escapes. */
+static size_t skip_string(const char *s, size_t i) {
+    i++; /* opening quote */
+    while (s[i]) {
+        if (s[i] == '\\' && s[i + 1]) i += 2;
+        else if (s[i] == '"') return i + 1;
+        else i++;
+    }
+    return i;
+}
+
+/* Finds the start of the value for top-level-ish key `key` ("\"key\"")
+ * anywhere in the object text, skipping matches inside strings. Returns
+ * NULL if absent. Good enough for this protocol: the harness never
+ * nests an "echo"/"msg_id"/"src" key inside another object before the
+ * real one. */
+static const char *find_value(const char *s, const char *key) {
+    size_t klen = strlen(key);
+    size_t i = 0;
+    while (s[i]) {
+        if (s[i] == '"') {
+            size_t start = i;
+            i = skip_string(s, i);
+            if (i - start - 2 == klen && strncmp(s + start + 1, key, klen) == 0) {
+                while (s[i] == ' ' || s[i] == '\t') i++;
+                if (s[i] == ':') {
+                    i++;
+                    while (s[i] == ' ' || s[i] == '\t') i++;
+                    return s + i;
+                }
+            }
+        } else {
+            i++;
+        }
+    }
+    return NULL;
+}
+
+/* Length of the JSON value starting at v: a string, or a balanced
+ * object/array, or a bare literal (number/true/false/null). */
+static size_t value_len(const char *v) {
+    if (v[0] == '"') return skip_string(v, 0);
+    if (v[0] == '{' || v[0] == '[') {
+        char open = v[0], close = (open == '{') ? '}' : ']';
+        int depth = 0;
+        size_t i = 0;
+        while (v[i]) {
+            if (v[i] == '"') { i = skip_string(v, i); continue; }
+            if (v[i] == open) depth++;
+            else if (v[i] == close && --depth == 0) return i + 1;
+            i++;
+        }
+        return i;
+    }
+    size_t i = 0;
+    while (v[i] && !strchr(",}] \t\n", v[i])) i++;
+    return i;
+}
+
+int main(void) {
+    static char line[1 << 20];
+    char node_id[64] = "";
+    long next_id = 0;
+
+    while (fgets(line, sizeof line, stdin)) {
+        const char *src_v = find_value(line, "src");
+        const char *mid_v = find_value(line, "msg_id");
+        const char *type_v = find_value(line, "type");
+        if (!src_v || !type_v) continue;
+
+        char src[64] = "";
+        if (src_v[0] == '"') {
+            size_t n = value_len(src_v);
+            if (n >= 2 && n - 2 < sizeof src) {
+                memcpy(src, src_v + 1, n - 2);
+                src[n - 2] = '\0';
+            }
+        }
+        long in_reply_to = mid_v ? strtol(mid_v, NULL, 10) : -1;
+
+        if (strncmp(type_v, "\"init\"", 6) == 0) {
+            const char *nid = find_value(line, "node_id");
+            if (nid && nid[0] == '"') {
+                size_t n = value_len(nid);
+                if (n >= 2 && n - 2 < sizeof node_id) {
+                    memcpy(node_id, nid + 1, n - 2);
+                    node_id[n - 2] = '\0';
+                }
+            }
+            fprintf(stderr, "node %s initialized\n", node_id);
+            printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+                   "{\"type\": \"init_ok\", \"msg_id\": %ld, "
+                   "\"in_reply_to\": %ld}}\n",
+                   node_id, src, ++next_id, in_reply_to);
+            fflush(stdout);
+        } else if (strncmp(type_v, "\"echo\"", 6) == 0) {
+            const char *echo_v = find_value(line, "echo");
+            size_t n = echo_v ? value_len(echo_v) : 0;
+            printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+                   "{\"type\": \"echo_ok\", \"msg_id\": %ld, "
+                   "\"in_reply_to\": %ld, \"echo\": %.*s}}\n",
+                   node_id, src, ++next_id, in_reply_to,
+                   (int)n, echo_v ? echo_v : "null");
+            fflush(stdout);
+        } else if (mid_v) {
+            printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+                   "{\"type\": \"error\", \"code\": 10, \"msg_id\": %ld, "
+                   "\"in_reply_to\": %ld, "
+                   "\"text\": \"unsupported message type\"}}\n",
+                   node_id, src, ++next_id, in_reply_to);
+            fflush(stdout);
+        }
+    }
+    return 0;
+}

@@ -20,6 +20,13 @@ How the history is honest:
   - the run fails loudly if convergence is not reached, any ack goes
     missing, or the network dropped anything (`dropped_overflow`).
 
+Because reads are scheduled strictly after convergence, no read ever
+observes a value missing, so the checker's stable-latency quantiles are
+all 0 by construction (jepsen semantics: latency = known -> last-absent
+lag). The grade exercises the attempt/ack/lost/stable machinery; the
+latency machinery is exercised by the interactive runs and the parity
+suite (`maelstrom_tpu/parity.py`), whose reads race propagation.
+
 Used by bench.py (BENCH_GRADED) and unit-tested at small scale on CPU.
 """
 

@@ -165,6 +165,8 @@ def opts_from_args(args) -> dict:
 DEMOS = [
     {"workload": "echo", "bin": "demo/python/echo.py"},
     {"workload": "echo", "bin": "demo/python/echo_full.py"},
+    # compiled C node (make -C demo/c); skipped when not built
+    {"workload": "echo", "bin": "demo/c/echo"},
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
     {"workload": "g-counter", "bin": "demo/python/g_counter.py"},

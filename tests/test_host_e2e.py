@@ -39,6 +39,25 @@ def test_echo_e2e(tmp_path):
     assert os.path.exists(os.path.join(latest, "node-logs", "n0.log"))
 
 
+def test_c_echo_node_e2e(tmp_path):
+    """The protocol boundary is language-agnostic: a compiled C node
+    (demo/c/echo.c, no JSON library) passes the echo workload."""
+    import shutil
+    import subprocess
+
+    cc = shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    cdir = os.path.join(REPO, "demo", "c")
+    subprocess.run([cc, "-O2", "-o", os.path.join(cdir, "echo"),
+                    os.path.join(cdir, "echo.c")], check=True,
+                   capture_output=True)
+    res = run(tmp_path, workload="echo",
+              bin=os.path.join(cdir, "echo"), node_count=3, rate=10.0)
+    assert res["valid"] is True
+    assert res["workload"]["valid"] is True
+
+
 def test_broadcast_e2e(tmp_path):
     r = run(tmp_path, workload="broadcast",
             bin=os.path.join(DEMO, "broadcast.py"), topology="grid")
