@@ -113,7 +113,7 @@ int main(void) {
             fflush(stdout);
         } else if (strncmp(type_v, "\"echo\"", 6) == 0) {
             const char *echo_v = find_value(line, "echo");
-            size_t n = echo_v ? value_len(echo_v) : 0;
+            size_t n = echo_v ? value_len(echo_v) : 4;
             printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
                    "{\"type\": \"echo_ok\", \"msg_id\": %ld, "
                    "\"in_reply_to\": %ld, \"echo\": %.*s}}\n",

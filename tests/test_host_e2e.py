@@ -48,12 +48,13 @@ def test_c_echo_node_e2e(tmp_path):
     cc = shutil.which("cc")
     if cc is None:
         pytest.skip("no C compiler")
-    cdir = os.path.join(REPO, "demo", "c")
-    subprocess.run([cc, "-O2", "-o", os.path.join(cdir, "echo"),
-                    os.path.join(cdir, "echo.c")], check=True,
-                   capture_output=True)
-    res = run(tmp_path, workload="echo",
-              bin=os.path.join(cdir, "echo"), node_count=3, rate=10.0)
+    bin_path = str(tmp_path / "echo")
+    subprocess.run([cc, "-O2", "-Wall", "-Wextra", "-std=c99",
+                    "-o", bin_path,
+                    os.path.join(REPO, "demo", "c", "echo.c")],
+                   check=True, capture_output=True)
+    res = run(tmp_path, workload="echo", bin=bin_path,
+              node_count=3, rate=10.0)
     assert res["valid"] is True
     assert res["workload"]["valid"] is True
 
