@@ -78,7 +78,14 @@ class RaftProgram(NodeProgram):
         self.D = int(self.neighbors.shape[1])
         self.E = int(opts.get("ae_entries", 4))
         self.lanes = 3 + self.E
-        self.cap = int(opts.get("log_cap", 256))
+        # default log capacity scales with the expected operation count
+        # (every client op, reads included, appends an entry), so long
+        # runs don't hit the static bound; a run that does anyway is
+        # flagged invalid via invalid_counters
+        rate = float(opts.get("rate") or 0.0)
+        tl = float(opts.get("time_limit") or 0.0)
+        expected = int(2 * rate * tl) + 256
+        self.cap = int(opts.get("log_cap", min(max(256, expected), 0x7FFF)))
         self.keys = int(opts.get("kv_keys", 256))
         # packed wire-field widths (entry: term<<16|key<<4|op; AE header:
         # commit<<4|cnt with prev_idx in 16 bits)

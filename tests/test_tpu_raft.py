@@ -65,6 +65,20 @@ def test_raft_log_overflow_invalidates_run():
     assert res["valid"] is False
 
 
+def test_raft_log_cap_scales_with_workload():
+    """The default log capacity follows the expected op count, so a run
+    whose operations exceed the old fixed cap of 256 commits them all
+    with zero overflow."""
+    res = run({"workload": "lin-kv", "node": "tpu:lin-kv",
+               "node_count": 3, "rate": 30.0, "time_limit": 12.0,
+               "seed": 5})
+    assert res["valid"] is True, res["workload"]
+    ok = sum(res["stats"]["by-f"][f]["ok-count"]
+             for f in res["stats"]["by-f"])
+    assert ok > 256
+    assert res["net"]["log-overflow"] == 0
+
+
 def test_raft_many_clusters_vmap():
     """64 independent 5-node raft clusters under one vmap: each elects
     exactly one leader."""
