@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from maelstrom_tpu.net import tpu as T
 from maelstrom_tpu.nodes import get_program
@@ -17,26 +18,32 @@ from maelstrom_tpu.parallel import (make_cluster_round_fn, make_cluster_sims,
                                     mesh_for, sim_shardings)
 
 
-def _build(n_nodes=8, n_clusters=4):
+def _build(n_nodes=8, n_clusters=4, name="broadcast"):
     nodes = [f"n{i}" for i in range(n_nodes)]
     program = get_program(
-        "broadcast",
+        name,
         {"topology": "grid", "max_values": 8, "latency": {"mean": 0}},
         nodes)
     cfg = T.NetConfig(n_nodes=n_nodes, n_clients=1, pool_cap=64,
-                      inbox_cap=program.inbox_cap, client_cap=0)
+                      inbox_cap=program.inbox_cap, client_cap=4)
     return program, cfg
 
 
-def _inject(n_clusters, n_nodes, value, dest):
-    from maelstrom_tpu.nodes.broadcast import T_BCAST
+def _inject(n_clusters, n_nodes, value, dest, name="broadcast"):
+    if name == "broadcast":
+        from maelstrom_tpu.nodes.broadcast import T_BCAST
+        typ, a, b = T_BCAST, value, 0
+    else:
+        from maelstrom_tpu.nodes.raft import T_WRITE
+        typ, a, b = T_WRITE, value % 8, value % 200
     inj = T.Msgs.empty((n_clusters, 2))
     return inj.replace(
         valid=inj.valid.at[:, 0].set(True),
         src=jnp.full_like(inj.src, n_nodes),
         dest=inj.dest.at[:, 0].set(dest),
-        type=jnp.full_like(inj.type, T_BCAST),
-        a=inj.a.at[:, 0].set(value))
+        type=jnp.full_like(inj.type, typ),
+        a=inj.a.at[:, 0].set(a),
+        b=inj.b.at[:, 0].set(b))
 
 
 def test_mesh_for_factorizations():
@@ -46,13 +53,15 @@ def test_mesh_for_factorizations():
     assert mesh2.shape["dp"] == 4 and mesh2.shape["sp"] == 2
 
 
-def test_sharded_cluster_round_matches_unsharded():
-    n_nodes, n_clusters, rounds = 8, 4, 6
-    program, cfg = _build(n_nodes, n_clusters)
+@pytest.mark.parametrize("name,rounds", [("broadcast", 6), ("lin-kv", 30)])
+def test_sharded_cluster_round_matches_unsharded(name, rounds):
+    n_nodes, n_clusters = 8, 4
+    program, cfg = _build(n_nodes, n_clusters, name=name)
 
     def run(round_fn, sims, put=None):
         for r in range(rounds):
-            inj = _inject(n_clusters, n_nodes, value=r % 8, dest=r % n_nodes)
+            inj = _inject(n_clusters, n_nodes, value=r % 8,
+                          dest=r % n_nodes, name=name)
             if put is not None:
                 inj = jax.device_put(inj, put(inj))
             sims, _cm, _io = round_fn(sims, inj)
@@ -65,7 +74,7 @@ def test_sharded_cluster_round_matches_unsharded():
     # sharded over the full 8-device mesh
     mesh = mesh_for(8)
     sims1 = make_cluster_sims(program, cfg, n_clusters, seed=3)
-    example_inj = _inject(n_clusters, n_nodes, 0, 0)
+    example_inj = _inject(n_clusters, n_nodes, 0, 0, name=name)
     sims1 = jax.device_put(sims1, sim_shardings(mesh, sims1))
     round_fn = make_cluster_round_fn(program, cfg, mesh=mesh,
                                      example=sims1,
@@ -80,8 +89,11 @@ def test_sharded_cluster_round_matches_unsharded():
     for a, b in zip(flat_ref, flat_got):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-    # sanity: the simulation did something (values seen, messages counted)
-    assert np.asarray(got.nodes["seen"]).any()
+    # sanity: the simulation did something (state moved, messages counted)
+    if name == "broadcast":
+        assert np.asarray(got.nodes["seen"]).any()
+    else:
+        assert (np.asarray(got.nodes["term"]) >= 1).any()
     assert np.asarray(got.net.stats.recv_all).sum() > 0
 
 
