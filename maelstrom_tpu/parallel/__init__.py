@@ -89,17 +89,18 @@ def mesh_for(n_devices: int | None = None, dp: int | None = None) -> Mesh:
 
 def _spec_for(arr, mesh: Mesh, batched: bool) -> P:
     """Shard the cluster axis over dp and the first big per-cluster axis
-    over sp (when divisible); everything else replicated. Tiny axes
-    (like a PRNG key's trailing 2) are never worth an sp split — at
-    sp=2 sharding them only forces resharding churn between calls."""
+    over sp (when divisible); everything else replicated. Axes that
+    would shard to a single element per device (like a PRNG key's
+    trailing 2 at sp=2) stay replicated — splitting them buys nothing
+    and forces resharding churn between calls."""
     sp = mesh.shape["sp"]
     dims: list = []
     start = 0
     if batched:
         dims.append("dp")
         start = 1
-    if (arr.ndim > start and arr.shape[start] >= sp
-            and arr.shape[start] % sp == 0 and arr.shape[start] > 2):
+    if (arr.ndim > start and arr.shape[start] > sp
+            and arr.shape[start] % sp == 0):
         dims.append("sp")
     return P(*dims)
 

@@ -39,6 +39,46 @@ def test_echo_e2e(tmp_path):
     assert os.path.exists(os.path.join(latest, "node-logs", "n0.log"))
 
 
+def test_node_spawn_strips_accelerator_env(tmp_path, monkeypatch):
+    """Spawned node binaries must not inherit accelerator-hookup env
+    vars: this image's sitecustomize costs ~2s of backend registration
+    per interpreter when they're set, which serializes >=5-node clusters
+    past the init handshake on small hosts."""
+    from maelstrom_tpu.net.host import HostNet
+    from maelstrom_tpu.process import NodeProcess
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    probe = tmp_path / "envprobe.py"
+    probe.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, os, sys\n"
+        "for line in sys.stdin:\n"
+        "    m = json.loads(line)\n"
+        "    b = m['body']\n"
+        "    keys = [k for k in os.environ\n"
+        "            if k.startswith(('AXON_', 'PALLAS_AXON_'))]\n"
+        "    print(json.dumps({'src': b['node_id'], 'dest': m['src'],\n"
+        "        'body': {'type': 'init_ok', 'msg_id': 1,\n"
+        "                 'in_reply_to': b['msg_id'],\n"
+        "                 'axon_keys': keys}}), flush=True)\n")
+    probe.chmod(0o755)
+
+    net = HostNet(latency={"mean": 0})
+    h = NodeProcess("n0", str(probe), [], net,
+                    log_file=str(tmp_path / "n0.log"))
+    try:
+        net.add_node("c0")
+        net.send({"src": "c0", "dest": "n0",
+                  "body": {"type": "init", "msg_id": 1, "node_id": "n0",
+                           "node_ids": ["n0"]}})
+        msg = net.recv("c0", timeout_ms=10_000)
+        assert msg is not None
+        assert msg.body["axon_keys"] == []
+    finally:
+        h.stop()
+
+
 def test_c_echo_node_e2e(tmp_path):
     """The protocol boundary is language-agnostic: a compiled C node
     (demo/c/echo.c, no JSON library) passes the echo workload."""

@@ -35,7 +35,7 @@ def _inject(n_clusters, n_nodes, value, dest, name="broadcast"):
         typ, a, b = T_BCAST, value, 0
     else:
         from maelstrom_tpu.nodes.raft import T_WRITE
-        typ, a, b = T_WRITE, value % 8, value % 200
+        typ, a, b = T_WRITE, value, value
     inj = T.Msgs.empty((n_clusters, 2))
     return inj.replace(
         valid=inj.valid.at[:, 0].set(True),
