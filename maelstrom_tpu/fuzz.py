@@ -130,8 +130,17 @@ def fuzz_broadcast(n_nodes: int = 4096, values: int = 32,
         # only for programs that retransmit until acknowledged (mirrors
         # TpuNetStats's tolerated-overwrites contract)
         tolerated = getattr(program, "tolerates_channel_overwrites", False)
+        # randomized-dist configs accept clipped tail draws explicitly:
+        # at 100k nodes the ring is sized to 8x the mean (memory), the
+        # exponential tail beyond that is clipped shorter — which can
+        # only speed convergence, the property this harness checks. The
+        # toleration is recorded so no run hides it.
+        clipped = (int(jax.device_get(ch.lat_clipped))
+                   if ch is not None else 0)
+        clip_tolerated = c["dist"] != "constant"
         ok = (converged_at is not None and st["dropped_overflow"] == 0
-              and (overwrites == 0 or tolerated))
+              and (overwrites == 0 or tolerated)
+              and (clipped == 0 or clip_tolerated))
         res = {
             "config": c["name"], "nodes": n_nodes, "values": values,
             "values_born": n_born if converged_at is not None else None,
@@ -141,8 +150,8 @@ def fuzz_broadcast(n_nodes: int = 4096, values: int = 32,
             "dropped_partition": st["dropped_partition"],
             "dropped_overflow": st["dropped_overflow"],
             "channel_overwrites": overwrites,
-            "latency_clipped": (int(jax.device_get(ch.lat_clipped))
-                                if ch is not None else 0),
+            "latency_clipped": clipped,
+            "latency_clip_tolerated": bool(clip_tolerated),
         }
         results.append(res)
         log(json.dumps(res))

@@ -14,9 +14,25 @@ Latency is a small ring of per-edge cells indexed by arrival round; a
 message sent at round r with latency L lands in cell (r+max(L,1)) %
 ring_depth and is read (and cleared) when the receiver's round pointer
 passes it.
-Randomized latencies are supported up to ring_depth-1 rounds (clipped);
-two messages on the same (edge, lane) arriving the same round overwrite —
-bounded-channel loss, counted, and absent entirely under constant latency.
+Randomized latencies are supported up to ring_depth-1 rounds (clipped,
+counted, and gated by the net-stats checker unless tolerated). Two
+messages on the same (edge, arrival-round) cell can collide; what
+happens depends on the write mode:
+
+  - default (`spill=False`): a collision on the same lane overwrites —
+    bounded-channel loss, counted, absent entirely under constant
+    latency. Programs whose lanes carry positional meaning (raft:
+    lane 0 = request, 1 = reply, 2 = proxy) use this mode and tolerate
+    overwrites because every message retransmits until acknowledged.
+  - `spill=True`: the cell is repacked — existing messages keep
+    flowing, colliding writes probe free lanes of the same cell, and a
+    message is destroyed only when the whole cell is full (counted in
+    `overwrites`, gated). This matches the reference's guarantee that
+    the network never destroys a message except by explicit loss or
+    partition (`net.clj:188-246`, unbounded per-node queues), at the
+    cost that a message may be delivered on a different lane than it
+    was sent on — legal only for programs that dispatch on message
+    *type* across all inbox lanes (`NodeProgram.edge_lanes_symmetric`).
 
 Loss and partitions are masks applied at write time: a lost or blocked
 message never enters the ring (the reference consumes blocked messages at
@@ -73,20 +89,37 @@ class EdgeChannels:
     a: jnp.ndarray
     b: jnp.ndarray
     c: jnp.ndarray
-    overwrites: jnp.ndarray     # i32 scalar: bounded-channel collisions
+    overwrites: jnp.ndarray     # i32 scalar: messages destroyed by
+    #                             collision (spill=False) or cell
+    #                             exhaustion (spill=True)
     lat_clipped: jnp.ndarray    # i32 scalar: latency draws clipped to ring
-    sent: object = None         # [N, D, ring, LANES] write round, opt-in
+    # [N, D, ring, LANES] packed round * LANE_STRIDE + original send
+    # lane, opt-in (journaled runs): the journal reconstructs each
+    # message's send-side id even when spill moved it to another lane
+    sent: object = None
+
+
+# send-lane field width in the packed `sent` plane (lanes < 64 always;
+# rounds stay well under 2**25 so the pack fits i32)
+LANE_STRIDE = 64
 
 
 @dataclass(frozen=True)
 class EdgeConfig:
     """Static shape of the edge exchange. ring must exceed the maximum
     latency draw in rounds (arrival offsets 1..ring-1 are
-    representable; larger draws are clipped and counted)."""
+    representable; larger draws are clipped and counted).
+
+    `spill` selects the collision-free write (see module docstring); it
+    is decided ONCE, by the node program that builds this config — from
+    its latency opts, its lane semantics (`edge_lanes_symmetric`), and
+    the cluster's memory affordability — so the simulation loop, the
+    channels, and the lane headroom can never disagree about the mode."""
     n_nodes: int
     degree: int
     lanes: int
     ring: int = 2
+    spill: bool = False
 
 
 def make_channels(cfg: EdgeConfig,
@@ -125,17 +158,34 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
                round_, latency_rounds, deliver_mask) -> EdgeChannels:
     """Writes this round's outgoing edge messages into the rings.
 
-    latency_rounds: i32 [N, D, LANES] per-message delay (>= 0, clipped to
-    ring-1); deliver_mask: bool broadcastable to [N, D, LANES] (False =
-    lost or partitioned, applied at send like `net.clj:213`)."""
+    latency_rounds: i32 [N, D, LANES_out] per-message delay (>= 0, clipped
+    to ring-1); deliver_mask: bool broadcastable to [N, D, LANES_out]
+    (False = lost or partitioned, applied at send like `net.clj:213`).
+
+    `cfg.spill` repacks each targeted cell so colliding writes land in
+    free lanes instead of overwriting (see module docstring); it also
+    allows `out` to have fewer lanes than the channels (headroom lanes
+    exist purely as spill capacity)."""
     # deadline = now + latency with a one-round causal floor, matching
     # the pool path (`net/tpu.py _send`) and the reference's wall-clock
     # deadlines (`net.clj:201-204`). Offset ring-1 is safe: the cell it
     # targets was read (and cleared) the previous round.
+    L_out = out.valid.shape[2]
+    assert L_out <= LANE_STRIDE and cfg.lanes <= LANE_STRIDE
     lat = jnp.maximum(jnp.clip(latency_rounds, 0, cfg.ring - 1), 1)
-    arrival = (round_ + lat) % cfg.ring              # [N, D, LANES]
+    arrival = (round_ + lat) % cfg.ring              # [N, D, LANES_out]
     ok = out.valid & deliver_mask
     clipped = jnp.sum((ok & (latency_rounds > cfg.ring - 1)).astype(I32))
+    # packed send-side identity for journal pairing (stride, not the
+    # lane count: out and channel lane counts may differ under spill)
+    sent_val = (jnp.asarray(round_, I32) * LANE_STRIDE
+                + jnp.arange(L_out, dtype=I32))
+
+    if cfg.spill:
+        return _edge_write_spill(cfg, ch, out, ok, arrival, clipped,
+                                 sent_val)
+    assert L_out == cfg.lanes, \
+        "lane headroom requires spill mode (extra lanes are spill slots)"
 
     if cfg.ring <= 4:
         # tiny rings (constant latency): unrolled per-slot selects beat
@@ -156,7 +206,7 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
                 type=upd(ch.type, out.type), a=upd(ch.a, out.a),
                 b=upd(ch.b, out.b), c=upd(ch.c, out.c),
                 sent=(None if ch.sent is None
-                      else upd(ch.sent, jnp.asarray(round_, I32))))
+                      else upd(ch.sent, sent_val[None, None, :])))
         return ch.replace(overwrites=ch.overwrites + new_overwrites,
                           lat_clipped=ch.lat_clipped + clipped)
 
@@ -177,7 +227,45 @@ def edge_write(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
         overwrites=ch.overwrites + new_overwrites,
         lat_clipped=ch.lat_clipped + clipped,
         sent=(None if ch.sent is None
-              else jnp.where(m, jnp.asarray(round_, I32), ch.sent)))
+              else jnp.where(m, sent_val[None, None, None, :], ch.sent)))
+
+
+def _edge_write_spill(cfg: EdgeConfig, ch: EdgeChannels, out: EdgeMsgs,
+                      ok, arrival, clipped, sent_val) -> EdgeChannels:
+    """Collision-free write: each targeted cell is repacked with a stable
+    valid-first sort over (existing channel lanes ++ incoming messages),
+    so an incoming message takes any free lane of its arrival cell and
+    existing in-flight messages are never disturbed. A message is
+    destroyed only when a cell holds more live messages than it has
+    lanes — counted in `overwrites` and gated like any other silent
+    drop. O(ring * (lanes + lanes_out)) memory; used on randomized-
+    latency runs, where collisions actually occur (constant latency
+    cannot collide: all of a round's sends share one deadline)."""
+    L_out = out.valid.shape[2]
+    slots = jnp.arange(cfg.ring, dtype=I32)[None, None, :, None]
+    m = ok[:, :, None, :] & (arrival[:, :, None, :] == slots)  # [N,D,R,Lo]
+
+    def cat(chf, of):
+        inc = jnp.broadcast_to(of[:, :, None, :], m.shape)
+        return jnp.concatenate([chf, jnp.where(m, inc, 0)], axis=-1)
+
+    valid_c = jnp.concatenate([ch.valid, m], axis=-1)   # [N, D, R, Lc+Lo]
+    key = (~valid_c).astype(I32)                        # valid sorts first
+    ops = [key, valid_c, cat(ch.type, out.type), cat(ch.a, out.a),
+           cat(ch.b, out.b), cat(ch.c, out.c)]
+    if ch.sent is not None:
+        ops.append(cat(ch.sent, jnp.broadcast_to(
+            sent_val[None, None, :], ok.shape)))
+    packed = jax.lax.sort(tuple(ops), dimension=-1, is_stable=True,
+                          num_keys=1)
+    keep = [f[..., :cfg.lanes] for f in packed[1:]]
+    live = jnp.sum(valid_c.astype(I32), axis=-1)        # [N, D, R]
+    dropped = jnp.sum(jnp.maximum(live - cfg.lanes, 0))
+    return ch.replace(
+        valid=keep[0], type=keep[1], a=keep[2], b=keep[3], c=keep[4],
+        overwrites=ch.overwrites + dropped,
+        lat_clipped=ch.lat_clipped + clipped,
+        sent=None if ch.sent is None else keep[5])
 
 
 def edge_read(cfg: EdgeConfig, ch: EdgeChannels, neighbors, rev,

@@ -82,6 +82,17 @@ class NodeProgram:
     inbox_cap = 8
     outbox_cap = 8
     needs_state_reads = False   # runner pulls node state rows for reads
+    # edge programs: True when the inbox lanes are interchangeable (the
+    # step dispatches on message *type* across every lane, never on lane
+    # position). Prerequisite for building an `EdgeConfig(spill=True)` —
+    # the collision-free write reassigns lanes (net/static.py). Raft
+    # reads lanes positionally (0 = request, 1 = reply, 2 = proxy) and
+    # must leave this False.
+    edge_lanes_symmetric = False
+    # latency draws beyond the edge ring are clipped and counted; runs
+    # that clip are invalid unless the program (or test opts) accept the
+    # distortion explicitly
+    tolerates_latency_clipping = False
 
     def __init__(self, opts: dict, nodes: list[str]):
         self.opts = opts
@@ -157,6 +168,39 @@ def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
     ring = max(2, lat_rounds * slack * scale_headroom + 2)
     retry_rounds = max(2 * (lat_rounds + 1) + 4, 10)
     return ring, retry_rounds, lat_rounds
+
+
+def edge_capacity(opts: dict, program) -> tuple[bool, int]:
+    """Shared spill-mode decision + lane sizing for a program's
+    EdgeConfig: (spill, channel_lanes).
+
+    Spill (the collision-free write, net/static.py) is *mandatory* when
+    a destroyed message would change protocol semantics (randomized
+    latency + no retransmission) and an *optimization* for retrying
+    protocols, taken only where its sort working set is affordable (the
+    same <=4096-node cut as edge_timing's slow! headroom). Spill runs on
+    small clusters also get doubled lanes so colliding arrivals
+    essentially never exhaust a cell — capped at LANE_STRIDE, the send-
+    lane field width in the packed journal stamp."""
+    from ..net.static import LANE_STRIDE
+    n = program.n_nodes
+    lanes = program.lanes
+    assert lanes <= LANE_STRIDE, \
+        f"{program.name}: {lanes} edge lanes exceed LANE_STRIDE"
+    dist = (opts.get("latency") or {}).get("dist", "constant")
+    tolerates = getattr(program, "tolerates_channel_overwrites", False)
+    if dist != "constant" and not tolerates:
+        # lossless delivery is required but spill reassigns lanes: a
+        # positional-lane program cannot run this config correctly
+        assert program.edge_lanes_symmetric, (
+            f"{program.name}: randomized latency with no retransmission "
+            f"requires spill-mode channels, which need type-dispatched "
+            f"(symmetric) inbox lanes")
+    spill = (program.edge_lanes_symmetric and dist != "constant"
+             and (n <= 4096 or not tolerates))
+    if spill and n <= 4096:
+        lanes = min(2 * lanes, LANE_STRIDE)
+    return spill, lanes
 
 
 PROGRAMS: dict[str, Callable] = {}

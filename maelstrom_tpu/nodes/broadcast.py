@@ -41,7 +41,8 @@ import numpy as np
 from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
 from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from . import (EncodeCapacityError, NodeProgram, edge_timing,
+from . import (EncodeCapacityError, NodeProgram, edge_capacity,
+               edge_timing,
                register)
 
 T_BCAST = 10      # client -> node: a = value index
@@ -57,9 +58,9 @@ class BroadcastProgram(NodeProgram):
     name = "broadcast"
     needs_state_reads = True
     is_edge = True
-    # ring overwrites under randomized latency are tolerated: every value
-    # retransmits until a digest proves delivery
-    tolerates_channel_overwrites = True
+    # every inbox lane is decoded by message type (gossip/digest), never
+    # by position: safe for the spill write's lane reassignment
+    edge_lanes_symmetric = True
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
@@ -88,6 +89,13 @@ class BroadcastProgram(NodeProgram):
         # demonstrates — that's the teaching point.
         self.naive = bool(opts.get("naive_broadcast", False))
         self.skip_sender = bool(opts.get("skip_sender", True))
+        # digest mode retransmits every value until acknowledged, so a
+        # destroyed in-flight copy only delays convergence; the naive
+        # mode sends each value once — destroying one is permanent,
+        # undetectable loss, so it must invalidate the run
+        # (reference `net.clj:188-246` never destroys without loss/
+        # partition; VERDICT r2 "grid 25, 100 ms exponential")
+        self.tolerates_channel_overwrites = not self.naive
         self.lanes = self.per_nb + (0 if self.naive else 1)  # +digest lane
         self.ring, retry, _lat = edge_timing(opts, len(nodes))
         # a digest for any window returns within the round-trip plus one
@@ -95,8 +103,10 @@ class BroadcastProgram(NodeProgram):
         self.retry_rounds = retry + self.n_windows
         self.inbox_cap = int(opts.get("inbox_cap", 4))   # client RPCs only
         self.outbox_cap = self.inbox_cap
+        spill, chan_lanes = edge_capacity(opts, self)
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
-                                   lanes=self.lanes, ring=self.ring)
+                                   lanes=chan_lanes, ring=self.ring,
+                                   spill=spill)
 
     def init_state(self):
         N, D, V = self.n_nodes, self.D, self.V
@@ -129,7 +139,8 @@ class BroadcastProgram(NodeProgram):
     def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
         """(state, edge_in [N,D,L], client_in Msgs [N,K]) ->
         (state', edge_out [N,D,L], client_out Msgs [N,K])."""
-        N, D, V, L = self.n_nodes, self.D, self.V, self.lanes
+        N, D, V = self.n_nodes, self.D, self.V
+        L = int(edge_in.valid.shape[2])   # channel lanes (>= out lanes)
         seen, pending = state["seen"], state["pending"]
         inflight = state["inflight"]
         vee = jnp.arange(V, dtype=I32)
@@ -193,7 +204,10 @@ class BroadcastProgram(NodeProgram):
         # --- digests clear pending for values the neighbor has ---
         d_in = edge_in.valid & (edge_in.type == T_DIGEST)
         has_digest = d_in.any(axis=2)                       # [N, D]
-        # lane content reduced over lanes (digest occupies one lane)
+        # lane content reduced over lanes. Normally one digest per edge
+        # per round; the spill write can land two (sent in different
+        # rounds) in one cell — last lane wins, the ignored one is
+        # re-owed when its gossip retransmits (digests are idempotent)
         def lane_pick(field):
             out = jnp.zeros((N, D), I32)
             for l in range(L):

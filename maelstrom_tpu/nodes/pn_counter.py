@@ -36,7 +36,7 @@ from ..net.tpu import I32
 from ..net.static import reverse_index
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
 from .gset import gossip_topology_opts
-from . import NodeProgram, edge_timing, register
+from . import NodeProgram, edge_capacity, edge_timing, register
 
 T_ADD = 10        # client -> node: a = delta
 T_ADD_OK = 11
@@ -51,6 +51,8 @@ class PnCounterProgram(NodeProgram):
     needs_state_reads = True
     is_edge = True
     tolerates_channel_overwrites = True   # entries retransmit until synced
+    # lanes are decoded by message type across every slot: spill-safe
+    edge_lanes_symmetric = True
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
@@ -67,8 +69,10 @@ class PnCounterProgram(NodeProgram):
         self.ring, self.retry_rounds, _lat = edge_timing(opts, len(nodes))
         self.inbox_cap = int(opts.get("inbox_cap", 4))
         self.outbox_cap = self.inbox_cap
+        spill, chan_lanes = edge_capacity(opts, self)
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
-                                   lanes=self.lanes, ring=self.ring)
+                                   lanes=chan_lanes, ring=self.ring,
+                                   spill=spill)
 
     def init_state(self):
         N, D, M = self.n_nodes, self.D, self.M
@@ -78,7 +82,8 @@ class PnCounterProgram(NodeProgram):
                 "synced": jnp.zeros((N, D, M), bool)}
 
     def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
-        N, D, M, L = self.n_nodes, self.D, self.M, self.lanes
+        N, D, M = self.n_nodes, self.D, self.M
+        L = int(edge_in.valid.shape[2])   # channel lanes (>= out lanes)
         pos, neg = state["pos"], state["neg"]
         pending, synced = state["pending"], state["synced"]
         origins = jnp.arange(M, dtype=I32)

@@ -137,10 +137,12 @@ class TpuNetStats(Checker):
         out["dropped-overflow"] = c["dropped_overflow"]
         ch = self.runner.sim.channels
         overwrites = 0
+        lat_clipped = 0
         if ch is not None:
             overwrites = int(jax.device_get(ch.overwrites))
             out["channel-overwrites"] = overwrites
-            out["latency-clipped"] = int(jax.device_get(ch.lat_clipped))
+            lat_clipped = int(jax.device_get(ch.lat_clipped))
+            out["latency-clipped"] = lat_clipped
         journal = self.runner.journal
         store_dir = test.get("store_dir")
         if journal is not None and store_dir:
@@ -157,8 +159,15 @@ class TpuNetStats(Checker):
         tolerated = (test.get("allow_channel_overwrites")
                      or getattr(self.runner.program,
                                 "tolerates_channel_overwrites", False))
+        # clipped latency draws silently shorten delays — a distortion of
+        # the latency model the same class as an overwrite drop; gate it
+        # unless the test (or program) explicitly accepts it
+        clip_tolerated = (test.get("allow_latency_clipping")
+                          or getattr(self.runner.program,
+                                     "tolerates_latency_clipping", False))
         ok = (c["dropped_overflow"] == 0
-              and (overwrites == 0 or tolerated))
+              and (overwrites == 0 or tolerated)
+              and (lat_clipped == 0 or clip_tolerated))
         # program-state capacity failures (e.g. raft log-overflow) are the
         # same class of silent degradation as pool overflow
         for name, arr in self.runner.program.invalid_counters(
@@ -628,11 +637,12 @@ class TpuRunner:
 
     def _journal_edges(self, edge_out, edge_in, r: int):
         """Synthesizes journal rows for static edge-channel traffic. Ids
-        are deterministic functions of (send round, edge, lane): the send
-        side stamps its round, the channels carry it with the message
-        (`EdgeChannels.sent`, tracked on journaled runs), so every recv
-        row pairs exactly to its send — under any latency distribution or
-        live slow!/fast! scale (the reference's journal is exact too,
+        are deterministic functions of (send round, edge, send lane): the
+        send side stamps round * LANE_STRIDE + lane, the channels carry
+        it with the message (`EdgeChannels.sent`, tracked on journaled
+        runs), so every recv row pairs exactly to its send — under any
+        latency distribution, live slow!/fast! scale, or spill-mode lane
+        reassignment (the reference's journal is exact too,
         `net/journal.clj:225-239`). High id bit space keeps edge ids
         disjoint from pool message ids."""
         import numpy as np
@@ -655,14 +665,17 @@ class TpuRunner:
                 "send", ids, np.full(ids.shape, self._time_ns(r)),
                 n_i.astype(np.int32), nb[n_i, d_i].astype(np.int32),
                 node_names=self.node_names)
-        iv = np.asarray(edge_in.valid)               # [N, D, L] (receiver)
+        iv = np.asarray(edge_in.valid)               # [N, D, Lc] (receiver)
         if iv.any():
+            from ..net.static import LANE_STRIDE
             m_i, e_i, l_i = np.nonzero(iv)
             senders = nb[m_i, e_i]
             send_d = rev[m_i, e_i]
-            send_round = np.asarray(edge_in.sent)[m_i, e_i, l_i]
+            packed = np.asarray(edge_in.sent)[m_i, e_i, l_i]
+            send_round = packed // LANE_STRIDE
+            send_lane = packed % LANE_STRIDE         # pre-spill lane
             ids = base + (send_round.astype(np.int64) * (N * D * L)
-                          + (senders * D + send_d) * L + l_i
+                          + (senders * D + send_d) * L + send_lane
                           ).astype(np.int64)
             self.journal.log_batch(
                 "recv", ids, np.full(ids.shape, self._time_ns(r)),

@@ -114,6 +114,13 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     lost = jax.random.uniform(k4, shape) < net.p_loss
     deliver_mask = ~blocked[:, :, None] & ~lost
     lat = T.draw_latency_rounds(cfg, k5, net.latency_scale, shape)
+    # ecfg.spill (decided by the program, see EdgeConfig): randomized
+    # latency can land two sends in one (edge, round) cell; programs
+    # whose inbox lanes are interchangeable get the collision-free spill
+    # write so bounded rings never destroy a message the reference's
+    # unbounded queues would have delivered (net.clj:188-246).
+    # Positional-lane programs (raft) keep the overwrite semantics they
+    # explicitly tolerate.
     ch = static.edge_write(ecfg, ch, edge_out, net.round, lat, deliver_mask)
 
     n_sent = jnp.sum(edge_out.valid.astype(I32))

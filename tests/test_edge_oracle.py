@@ -123,3 +123,110 @@ def test_edge_channels_match_oracle(evs, ring):
     assert got[0] == want[0], (got[0], want[0])
     assert got[1] == want[1]        # overwrites
     assert got[2] == want[2]        # clipped draws
+
+
+# --- spill mode: collision-free writes -------------------------------------
+
+def drive_spill(cfg, schedule, rounds, lanes_out):
+    """Like `drive`, but with a spill-mode EdgeConfig and possibly fewer
+    out lanes than channel lanes. Returns (delivered multisets keyed by
+    (round, receiver, rev_edge), overwrites, clipped)."""
+    ch = S.make_channels(cfg)
+    nb = jnp.asarray(NEIGHBORS)
+    rev = jnp.asarray(REV)
+    delivered = {}
+    for r in range(rounds):
+        ch, inbox = S.edge_read(cfg, ch, nb, rev, jnp.int32(r))
+        ib = jax.device_get(inbox)
+        for m in range(N):
+            for e in range(D):
+                got = sorted(int(ib.a[m, e, l]) for l in range(cfg.lanes)
+                             if ib.valid[m, e, l])
+                if got:
+                    delivered[(r, m, e)] = got
+        out = S.EdgeMsgs.empty((N, D, lanes_out))
+        lat = np.zeros((N, D, lanes_out), np.int32)
+        mask = np.ones((N, D, lanes_out), bool)
+        valid = np.zeros((N, D, lanes_out), bool)
+        a = np.zeros((N, D, lanes_out), np.int32)
+        for (n, d, l, av, lv, dv) in schedule.get(r, []):
+            valid[n, d, l] = True
+            a[n, d, l] = av
+            lat[n, d, l] = lv
+            mask[n, d, l] = dv
+        out = out.replace(valid=jnp.asarray(valid), a=jnp.asarray(a),
+                          type=jnp.ones((N, D, lanes_out), I32))
+        ch = S.edge_write(cfg, ch, out, jnp.int32(r), jnp.asarray(lat),
+                          jnp.asarray(mask))
+    return (delivered, int(jax.device_get(ch.overwrites)),
+            int(jax.device_get(ch.lat_clipped)))
+
+
+def oracle_spill(cfg, schedule, rounds, lanes_out):
+    """Spill semantics: a cell holds up to cfg.lanes messages; incoming
+    messages append in lane order; only cell exhaustion drops (counted),
+    and drops take the newest arrivals (the stable sort keeps existing
+    messages first)."""
+    cells = {}          # (arrival_round, n, d) -> [a, ...]
+    overwrites = 0
+    clipped = 0
+    delivered = {}
+    for r in range(rounds):
+        for m in range(N):
+            for e in range(D):
+                if NEIGHBORS[m, e] < 0:
+                    continue
+                src, sd = NEIGHBORS[m, e], REV[m, e]
+                got = cells.pop((r, src, sd), None)
+                if got:
+                    delivered[(r, m, e)] = sorted(got)
+        for (n, d, l, av, lv, dv) in sorted(schedule.get(r, []),
+                                            key=lambda t: t[2]):
+            if not dv:
+                continue
+            if lv > cfg.ring - 1:
+                clipped += 1
+            eff = max(1, min(lv, cfg.ring - 1))
+            cell = cells.setdefault((r + eff, n, d), [])
+            if len(cell) >= cfg.lanes:
+                overwrites += 1
+            else:
+                cell.append(av)
+    return delivered, overwrites, clipped
+
+
+@settings(max_examples=40, deadline=None)
+@given(evs=events, ring=st.integers(2, 6), extra=st.integers(0, 2))
+def test_edge_channels_spill_match_oracle(evs, ring, extra):
+    """spill=True never destroys a message short of cell exhaustion, and
+    delivery rounds are unchanged; lane positions may differ (compared as
+    multisets). `extra` exercises channel lanes > out lanes (headroom)."""
+    cfg = S.EdgeConfig(n_nodes=N, degree=D, lanes=LANES + extra, ring=ring,
+                       spill=True)
+    slots = {}
+    for (r, n, d, l, av, lv, dv) in evs:
+        if NEIGHBORS[n, d] < 0:
+            continue
+        slots[(r, n, d, l)] = (av, lv, dv)
+    schedule = {}
+    for (r, n, d, l), (av, lv, dv) in slots.items():
+        schedule.setdefault(r, []).append((n, d, l, av, lv, dv))
+    rounds = 6 + ring + 10
+    got = drive_spill(cfg, schedule, rounds, LANES)
+    want = oracle_spill(cfg, schedule, rounds, LANES)
+    assert got[0] == want[0], (got[0], want[0])
+    assert got[1] == want[1]        # drops only on cell exhaustion
+    assert got[2] == want[2]        # clipped draws
+
+
+def test_spill_no_loss_when_capacity_suffices():
+    """Two same-cell arrivals with a free lane both deliver — the exact
+    collision that destroyed messages in overwrite mode (VERDICT r2:
+    naive broadcast, grid 25, 100 ms exponential, lost: 2)."""
+    cfg = S.EdgeConfig(n_nodes=N, degree=D, lanes=2, ring=4, spill=True)
+    # lane 0 at round 0 with latency 2 and lane 0 at round 1 with
+    # latency 1 both arrive at round 2 on edge (1, 1)
+    schedule = {0: [(1, 1, 0, 7, 2, True)], 1: [(1, 1, 0, 9, 1, True)]}
+    delivered, overwrites, _ = drive_spill(cfg, schedule, 6, 2)
+    assert overwrites == 0
+    assert delivered == {(2, 2, 0): [7, 9]}

@@ -77,3 +77,39 @@ def test_elle_rt_barriers_scale():
     r = ElleListAppendChecker().check({}, h)
     assert r["valid"] is True, r
     assert time.monotonic() - t0 < 10
+
+
+def test_latency_clipping_gates_netstats_validity():
+    """Clipped latency draws silently shorten delays — a distortion of the
+    latency model that must invalidate a run unless explicitly tolerated
+    (VERDICT r2: fuzz-100k shipped latency_clipped: 2666 with ok: true)."""
+    from types import SimpleNamespace
+
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.runner.tpu_runner import TpuNetStats
+    from maelstrom_tpu.sim import make_sim
+
+    nodes = [f"n{i}" for i in range(4)]
+    prog = get_program("broadcast", {"topology": "grid", "max_values": 8},
+                       nodes)
+    cfg = T.NetConfig(n_nodes=4, n_clients=1, pool_cap=16,
+                      inbox_cap=prog.inbox_cap)
+    sim = make_sim(prog, cfg)
+    runner = SimpleNamespace(sim=sim, program=prog, journal=None)
+    chk = TpuNetStats(runner)
+
+    assert chk.check({}, [])["valid"] is True
+    runner.sim = sim.replace(channels=sim.channels.replace(
+        lat_clipped=jnp.int32(5)))
+    out = chk.check({}, [])
+    assert out["valid"] is False and out["latency-clipped"] == 5
+    # explicit opt-in (the fuzz harness's randomized-dist configs)
+    assert chk.check({"allow_latency_clipping": True}, [])["valid"] is True
+    # overwrites still gate independently of clipping
+    runner.sim = sim.replace(channels=sim.channels.replace(
+        overwrites=jnp.int32(3)))
+    prog.tolerates_channel_overwrites = False
+    assert chk.check({}, [])["valid"] is False
