@@ -79,14 +79,32 @@ def bench_raft_clusters():
     one_leader = float(((roles == 2).sum(axis=1) == 1).mean())
     rounds_done = (R // chunk) * chunk
     rate = rounds_done * clusters / dt
-    print(json.dumps({
+    record = {
         "metric": "raft_cluster_rounds_per_sec_10k_clusters",
         "value": round(rate, 1), "unit": "cluster-rounds/sec",
         "vs_baseline": round(rate / 1e6, 4),
         "clusters": clusters, "nodes_per_cluster": n,
         "rounds": rounds_done, "wall_s": round(dt, 3),
         "clusters_with_one_leader": one_leader,
-    }))
+    }
+
+    # grading half: real contending client traffic into a sampled subset
+    # of the same-size vmapped fleet, every sampled history graded by
+    # the stock WGL linearizability checker
+    if os.environ.get("BENCH_RAFT_GRADED", "1") == "1":
+        from maelstrom_tpu.bench_raft_graded import run_raft_graded
+        g = run_raft_graded(
+            n_clusters=clusters, n=n,
+            sample=int(os.environ.get("BENCH_RAFT_SAMPLE", 64)),
+            seed=3)
+        record["graded"] = g
+        record["sampled_clusters"] = g["sampled_clusters"]
+        record["all_linearizable"] = g["all_linearizable"]
+    print(json.dumps(record))
+    if record.get("all_linearizable") is False:
+        sys.exit(1)
+    if one_leader < 1.0:
+        sys.exit(1)
 
 
 def main():
@@ -197,9 +215,10 @@ def main():
         "dropped_overflow": st["dropped_overflow"],
     }
 
-    # the efficient (send-once-plus-retry, interactive-default) protocol's
-    # rate, reported alongside the eager number so the headline doesn't
-    # overstate the steady-state figure a user would see
+    # the efficient (send-once-plus-retry) protocol is the interactive
+    # default — the number a user actually gets — so IT is the headline
+    # `value`; the eager-resend flood stays in the record as the stress
+    # figure (`eager_msgs_per_sec`). Both beat the 1M north star.
     if eager and os.environ.get("BENCH_EFFICIENT", "1") == "1":
         program_eff = get_program(
             "broadcast",
@@ -208,11 +227,18 @@ def main():
              "eager_resend": False}, nodes)
         st_e, conv_e, dt_e = timed_runs(
             program_eff, make_run_fn(program_eff, cfg), "[efficient]")
-        record["efficient_msgs_per_sec"] = round(st_e["recv_all"] / dt_e, 1)
-        record["efficient_messages_delivered"] = int(st_e["recv_all"])
-        record["efficient_wall_s"] = round(dt_e, 3)
-        record["efficient_converged"] = conv_e
-        record["efficient_dropped_overflow"] = st_e["dropped_overflow"]
+        record["value"] = round(st_e["recv_all"] / dt_e, 1)
+        record["vs_baseline"] = round(st_e["recv_all"] / dt_e / 1e6, 4)
+        record["eager_resend"] = False
+        record["eager_msgs_per_sec"] = round(rate, 1)
+        record["eager_messages_delivered"] = int(msgs)
+        record["eager_wall_s"] = round(dt, 3)
+        record["messages_delivered"] = int(st_e["recv_all"])
+        record["wall_s"] = round(dt_e, 3)
+        record["converged"] = conv_e
+        record["eager_converged"] = converged
+        record["dropped_overflow"] = st_e["dropped_overflow"]
+        record["eager_dropped_overflow"] = st["dropped_overflow"]
 
     # checker-graded run at the same scale: real history, stock
     # BroadcastChecker (the north star's "passing the stock checker")
@@ -233,10 +259,10 @@ def main():
     print(json.dumps(record))
     # a non-converged, lossy, or checker-failed run is not a valid
     # benchmark: fail loudly (after emitting the JSON record)
-    if not converged or st["dropped_overflow"]:
+    if not record["converged"] or record["dropped_overflow"]:
         sys.exit(1)
-    if (record.get("efficient_converged") is False
-            or record.get("efficient_dropped_overflow")):
+    if (record.get("eager_converged") is False
+            or record.get("eager_dropped_overflow")):
         sys.exit(1)
     if graded is not None and graded["checker_valid"] is not True:
         sys.exit(1)

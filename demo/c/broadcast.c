@@ -1,0 +1,279 @@
+/* A Maelstrom-protocol broadcast node in C: gossip with retry-until-ack,
+ * written against doc/protocol.md + doc/workloads.md alone — the
+ * second-language proof that the documented stdio boundary suffices for a
+ * non-trivial, partition-tolerant node (the counterpart of the
+ * reference's multi-language demo surface, demo/ruby/raft.rb etc).
+ *
+ * Protocol served (doc/workloads.md "broadcast"):
+ *   topology  -> topology_ok  (records this node's neighbor list)
+ *   broadcast -> broadcast_ok (new message: remember + gossip out)
+ *   read      -> read_ok {"messages": [...]}
+ * Inter-node:
+ *   gossip {"message": v} -> gossip_ok (reply ack)
+ *
+ * Every seen value is gossiped to every neighbor until that neighbor
+ * acks it; unacked values retransmit on a 250 ms tick, so partitions
+ * and message loss only delay convergence. Values are stored as raw
+ * JSON tokens and spliced verbatim into replies, so any scalar payload
+ * round-trips exactly.
+ *
+ * No JSON library: the same string-aware scanner as echo.c. Input is
+ * read with poll() + a hand-rolled line buffer (stdio's fgets would
+ * block the retry tick).
+ *
+ * Build: make -C demo/c    Run: ... test -w broadcast --bin demo/c/broadcast
+ */
+
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#define MAX_VALUES 8192
+#define MAX_NBRS 32
+#define VAL_LEN 64
+#define ID_LEN 64
+#define MAX_RPC (1 << 20)
+
+static size_t skip_string(const char *s, size_t i) {
+    i++;
+    while (s[i]) {
+        if (s[i] == '\\' && s[i + 1]) i += 2;
+        else if (s[i] == '"') return i + 1;
+        else i++;
+    }
+    return i;
+}
+
+static const char *find_value(const char *s, const char *key) {
+    size_t klen = strlen(key);
+    size_t i = 0;
+    while (s[i]) {
+        if (s[i] == '"') {
+            size_t start = i;
+            i = skip_string(s, i);
+            if (i - start - 2 == klen &&
+                strncmp(s + start + 1, key, klen) == 0) {
+                while (s[i] == ' ' || s[i] == '\t') i++;
+                if (s[i] == ':') {
+                    i++;
+                    while (s[i] == ' ' || s[i] == '\t') i++;
+                    return s + i;
+                }
+            }
+        } else {
+            i++;
+        }
+    }
+    return NULL;
+}
+
+static size_t value_len(const char *v) {
+    if (v[0] == '"') return skip_string(v, 0);
+    if (v[0] == '{' || v[0] == '[') {
+        char open = v[0], close = (open == '{') ? '}' : ']';
+        int depth = 0;
+        size_t i = 0;
+        while (v[i]) {
+            if (v[i] == '"') { i = skip_string(v, i); continue; }
+            if (v[i] == open) depth++;
+            else if (v[i] == close && --depth == 0) return i + 1;
+            i++;
+        }
+        return i;
+    }
+    size_t i = 0;
+    while (v[i] && !strchr(",}] \t\n", v[i])) i++;
+    return i;
+}
+
+/* Copies a JSON string value (sans quotes) into out. */
+static void copy_str(const char *v, char *out, size_t cap) {
+    out[0] = '\0';
+    if (v && v[0] == '"') {
+        size_t n = value_len(v);
+        if (n >= 2 && n - 2 < cap) {
+            memcpy(out, v + 1, n - 2);
+            out[n - 2] = '\0';
+        }
+    }
+}
+
+/* --- node state --- */
+
+static char node_id[ID_LEN] = "";
+static long next_id = 0;
+
+static char values[MAX_VALUES][VAL_LEN];   /* raw JSON tokens */
+static int n_values = 0;
+
+static char nbrs[MAX_NBRS][ID_LEN];
+static int n_nbrs = 0;
+
+/* acked[nb][val]: neighbor nb has acknowledged value val */
+static unsigned char acked[MAX_NBRS][MAX_VALUES];
+
+/* outstanding gossip RPCs: msg_id -> (nb, val), -1 = free */
+static int rpc_nb[MAX_RPC];
+static int rpc_val[MAX_RPC];
+
+static int find_or_add_value(const char *tok, size_t n) {
+    if (n >= VAL_LEN) n = VAL_LEN - 1;
+    for (int i = 0; i < n_values; i++)
+        if (strlen(values[i]) == n && strncmp(values[i], tok, n) == 0)
+            return i;
+    if (n_values >= MAX_VALUES) {
+        fprintf(stderr, "value table full\n");
+        return -1;
+    }
+    memcpy(values[n_values], tok, n);
+    values[n_values][n] = '\0';
+    return n_values++;
+}
+
+static int nbr_index(const char *id) {
+    for (int i = 0; i < n_nbrs; i++)
+        if (strcmp(nbrs[i], id) == 0) return i;
+    return -1;
+}
+
+static void send_gossip(int nb, int val) {
+    long mid = ++next_id;
+    rpc_nb[mid % MAX_RPC] = nb;
+    rpc_val[mid % MAX_RPC] = val;
+    printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+           "{\"type\": \"gossip\", \"msg_id\": %ld, \"message\": %s}}\n",
+           node_id, nbrs[nb], mid, values[val]);
+}
+
+/* Retransmit every unacked (neighbor, value) pair. Gossip is
+ * idempotent, so duplicates are harmless; acks stop the traffic. */
+static void tick(void) {
+    for (int nb = 0; nb < n_nbrs; nb++)
+        for (int v = 0; v < n_values; v++)
+            if (!acked[nb][v]) send_gossip(nb, v);
+    fflush(stdout);
+}
+
+static void handle_line(const char *line) {
+    const char *src_v = find_value(line, "src");
+    const char *mid_v = find_value(line, "msg_id");
+    const char *type_v = find_value(line, "type");
+    const char *irt_v = find_value(line, "in_reply_to");
+    char src[ID_LEN];
+    copy_str(src_v, src, sizeof src);
+    long in_reply_to = mid_v ? strtol(mid_v, NULL, 10) : -1;
+
+    if (irt_v) {                       /* a reply: gossip_ok ack */
+        long mid = strtol(irt_v, NULL, 10);
+        int slot = (int)(mid % MAX_RPC);
+        if (rpc_nb[slot] >= 0) {
+            acked[rpc_nb[slot]][rpc_val[slot]] = 1;
+            rpc_nb[slot] = -1;
+        }
+        return;
+    }
+    if (!type_v) return;
+
+    if (strncmp(type_v, "\"init\"", 6) == 0) {
+        copy_str(find_value(line, "node_id"), node_id, sizeof node_id);
+        fprintf(stderr, "node %s initialized\n", node_id);
+        printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+               "{\"type\": \"init_ok\", \"msg_id\": %ld, "
+               "\"in_reply_to\": %ld}}\n",
+               node_id, src, ++next_id, in_reply_to);
+    } else if (strncmp(type_v, "\"topology\"", 10) == 0) {
+        /* our row: "<node_id>": [ "n1", "n2", ... ] */
+        const char *topo = find_value(line, "topology");
+        const char *row = topo ? find_value(topo, node_id) : NULL;
+        n_nbrs = 0;
+        if (row && row[0] == '[') {
+            size_t i = 1;
+            while (row[i] && row[i] != ']' && n_nbrs < MAX_NBRS) {
+                if (row[i] == '"') {
+                    size_t end = skip_string(row, i);
+                    size_t n = end - i - 2;
+                    if (n < ID_LEN) {
+                        memcpy(nbrs[n_nbrs], row + i + 1, n);
+                        nbrs[n_nbrs][n] = '\0';
+                        n_nbrs++;
+                    }
+                    i = end;
+                } else {
+                    i++;
+                }
+            }
+        }
+        fprintf(stderr, "topology: %d neighbors\n", n_nbrs);
+        printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+               "{\"type\": \"topology_ok\", \"msg_id\": %ld, "
+               "\"in_reply_to\": %ld}}\n",
+               node_id, src, ++next_id, in_reply_to);
+    } else if (strncmp(type_v, "\"broadcast\"", 11) == 0 ||
+               strncmp(type_v, "\"gossip\"", 8) == 0) {
+        int is_gossip = type_v[1] == 'g';
+        const char *msg = find_value(line, "message");
+        int before = n_values;
+        int val = msg ? find_or_add_value(msg, value_len(msg)) : -1;
+        if (val >= 0 && val == before) {       /* genuinely new */
+            int from = is_gossip ? nbr_index(src) : -1;
+            for (int nb = 0; nb < n_nbrs; nb++) {
+                /* the gossiping sender has it by definition */
+                if (nb == from) acked[nb][val] = 1;
+                else send_gossip(nb, val);
+            }
+        } else if (val >= 0 && is_gossip) {
+            int from = nbr_index(src);
+            if (from >= 0) acked[from][val] = 1;  /* they have it too */
+        }
+        printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+               "{\"type\": \"%s\", \"msg_id\": %ld, "
+               "\"in_reply_to\": %ld}}\n",
+               node_id, src, is_gossip ? "gossip_ok" : "broadcast_ok",
+               ++next_id, in_reply_to);
+    } else if (strncmp(type_v, "\"read\"", 6) == 0) {
+        printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+               "{\"type\": \"read_ok\", \"msg_id\": %ld, "
+               "\"in_reply_to\": %ld, \"messages\": [",
+               node_id, src, ++next_id, in_reply_to);
+        for (int i = 0; i < n_values; i++)
+            printf("%s%s", i ? ", " : "", values[i]);
+        printf("]}}\n");
+    } else if (mid_v) {
+        printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
+               "{\"type\": \"error\", \"code\": 10, \"msg_id\": %ld, "
+               "\"in_reply_to\": %ld, "
+               "\"text\": \"unsupported message type\"}}\n",
+               node_id, src, ++next_id, in_reply_to);
+    }
+    fflush(stdout);
+}
+
+int main(void) {
+    static char buf[1 << 20];
+    size_t used = 0;
+    memset(rpc_nb, -1, sizeof rpc_nb);
+
+    for (;;) {
+        struct pollfd pfd = {STDIN_FILENO, POLLIN, 0};
+        int r = poll(&pfd, 1, 250);
+        if (r < 0) break;
+        if (r == 0) { tick(); continue; }
+        if (pfd.revents & (POLLERR | POLLNVAL)) break;
+        ssize_t n = read(STDIN_FILENO, buf + used, sizeof buf - used - 1);
+        if (n <= 0) break;            /* EOF: harness teardown */
+        used += (size_t)n;
+        buf[used] = '\0';
+        char *start = buf;
+        char *nl;
+        while ((nl = strchr(start, '\n'))) {
+            *nl = '\0';
+            if (*start) handle_line(start);
+            start = nl + 1;
+        }
+        used = (size_t)(buf + used - start);
+        memmove(buf, start, used);
+    }
+    return 0;
+}

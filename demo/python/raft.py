@@ -22,8 +22,10 @@ import threading
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from node import Node, RPCError  # noqa: E402
 
-ELECTION_S = 0.6
-HEARTBEAT_S = 0.08
+# overridable so slow/oversubscribed CI hosts can widen the stability
+# margin (heartbeat gaps from scheduler hiccups trigger elections)
+ELECTION_S = float(os.environ.get("RAFT_ELECTION_S", "0.6"))
+HEARTBEAT_S = float(os.environ.get("RAFT_HEARTBEAT_S", "0.08"))
 
 node = Node()
 lock = threading.RLock()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Raft tutorial, stage 1 (doc/tutorial/06-raft.md): a key-value store
+with no replication at all — one dict, three RPCs, correct error codes.
+
+Linearizable at --node-count 1 (one node IS a total order); demonstrably
+NOT at --node-count 5, where every node holds its own dict and the
+checker exhibits a read that observes a stale register. The rest of the
+chapter is the work of making five dicts behave like this one."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+kv = {}
+
+
+@node.on("read")
+def read(msg):
+    k = msg["body"]["key"]
+    if k not in kv:
+        raise RPCError.key_does_not_exist(f"no key {k}")
+    node.reply(msg, {"type": "read_ok", "value": kv[k]})
+
+
+@node.on("write")
+def write(msg):
+    kv[msg["body"]["key"]] = msg["body"]["value"]
+    node.reply(msg, {"type": "write_ok"})
+
+
+@node.on("cas")
+def cas(msg):
+    b = msg["body"]
+    k = b["key"]
+    if k not in kv:
+        raise RPCError.key_does_not_exist(f"no key {k}")
+    if kv[k] != b["from"]:
+        raise RPCError.precondition_failed(
+            f"expected {b['from']!r}, had {kv[k]!r}")
+    kv[k] = b["to"]
+    node.reply(msg, {"type": "cas_ok"})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Raft tutorial, stage 2 (doc/tutorial/06-raft.md): stage 1's KV plus
+leader election — roles, terms, randomized timeouts, vote counting, and
+heartbeats that suppress elections. No log yet: the leader answers
+clients from its *local* dict; everyone else returns error 11
+(temporarily-unavailable) so the workload retries elsewhere.
+
+With a stable leader this is accidentally linearizable (one dict serves
+everything). Kill the stability — `--nemesis partition` — and a new
+leader is elected with an *empty* dict: acknowledged writes vanish, and
+the checker shows the exact stale read. Election gives you a single
+writer; it does not give you durability. That's stage 3's job."""
+
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+# overridable so slow/oversubscribed CI hosts can widen the stability
+# margin (heartbeat gaps from scheduler hiccups trigger elections)
+ELECTION_S = float(os.environ.get("RAFT_ELECTION_S", "0.6"))
+HEARTBEAT_S = float(os.environ.get("RAFT_HEARTBEAT_S", "0.08"))
+
+node = Node()
+lock = threading.RLock()
+
+role = "follower"
+term = 0
+voted_for = None
+votes = set()
+leader = None
+deadline = 0.0
+kv = {}
+
+
+def reset_deadline():
+    # randomized: with a fixed timeout, candidates collide forever
+    global deadline
+    deadline = time.monotonic() + ELECTION_S * (1 + random.random())
+
+
+def other_nodes():
+    return [p for p in node.node_ids if p != node.node_id]
+
+
+def majority():
+    return len(node.node_ids) // 2 + 1
+
+
+def become_follower(new_term):
+    global role, term, voted_for, leader
+    role, term, voted_for, leader = "follower", new_term, None, None
+    reset_deadline()
+
+
+def become_candidate():
+    global role, term, voted_for, votes, leader
+    role = "candidate"
+    term += 1
+    voted_for = node.node_id
+    votes = {node.node_id}
+    leader = None
+    reset_deadline()
+    node.log(f"became candidate for term {term}")
+    for peer in other_nodes():
+        node.rpc(peer, {"type": "request_vote", "term": term,
+                        "candidate_id": node.node_id},
+                 callback=on_vote_reply(term))
+
+
+def become_leader():
+    global role, leader
+    role, leader = "leader", node.node_id
+    node.log(f"became leader for term {term}")
+
+
+def on_vote_reply(req_term):
+    def cb(msg):
+        with lock:
+            b = msg["body"]
+            if b.get("term", 0) > term:
+                become_follower(b["term"])
+            elif (role == "candidate" and term == req_term
+                  and b.get("vote_granted")):
+                votes.add(msg["src"])
+                if len(votes) >= majority():
+                    become_leader()
+    return cb
+
+
+@node.on("request_vote")
+def handle_request_vote(msg):
+    global voted_for
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        granted = (b["term"] == term
+                   and voted_for in (None, b["candidate_id"]))
+        if granted:
+            voted_for = b["candidate_id"]
+            reset_deadline()
+        node.reply(msg, {"type": "request_vote_res", "term": term,
+                         "vote_granted": granted})
+
+
+@node.on("append_entries")          # heartbeat only, no entries yet
+def handle_heartbeat(msg):
+    global role, leader
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        if b["term"] == term:
+            if role == "candidate":
+                role = "follower"
+            leader = b["leader_id"]
+            reset_deadline()
+        node.reply(msg, {"type": "append_entries_res", "term": term})
+
+
+def handle_client(msg):
+    with lock:
+        if role != "leader":
+            raise RPCError.temporarily_unavailable(
+                f"not the leader (ask {leader})")
+        b = msg["body"]
+        t, k = b["type"], b.get("key")
+        if t == "read":
+            if k not in kv:
+                raise RPCError.key_does_not_exist(f"no key {k}")
+            node.reply(msg, {"type": "read_ok", "value": kv[k]})
+        elif t == "write":
+            kv[k] = b["value"]
+            node.reply(msg, {"type": "write_ok"})
+        elif t == "cas":
+            if k not in kv:
+                raise RPCError.key_does_not_exist(f"no key {k}")
+            if kv[k] != b["from"]:
+                raise RPCError.precondition_failed(
+                    f"expected {b['from']!r}, had {kv[k]!r}")
+            kv[k] = b["to"]
+            node.reply(msg, {"type": "cas_ok"})
+
+
+for _type in ("read", "write", "cas"):
+    node.on(_type)(handle_client)
+
+
+@node.every(HEARTBEAT_S)
+def tick():
+    with lock:
+        if role == "leader":
+            for peer in other_nodes():
+                node.rpc(peer, {"type": "append_entries", "term": term,
+                                "leader_id": node.node_id})
+        elif time.monotonic() >= deadline:
+            become_candidate()
+
+
+reset_deadline()
+
+if __name__ == "__main__":
+    node.run()

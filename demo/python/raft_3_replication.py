@@ -1,0 +1,258 @@
+#!/usr/bin/env python3
+"""Raft tutorial, stage 3 (doc/tutorial/06-raft.md): stage 2 plus a
+replicated log — the leader appends client ops, ships them in
+append_entries with (prev_index, prev_term) consistency checks, walks
+next_idx back on mismatch, truncates conflicting suffixes, and grants
+votes only to candidates with an up-to-date log.
+
+Deliberately missing: the majority-commit barrier. The leader applies
+an entry and ACKS THE CLIENT the moment it appends locally. So state
+survives leader changes (the new leader's log carries the old writes —
+run it and watch), but an isolated old leader still acknowledges writes
+that the majority never saw; when it rejoins, its unreplicated suffix
+is truncated and those acknowledged writes vanish. The checker
+exhibits exactly that under `--nemesis partition`. Durable != agreed:
+that's stage 4's commit index."""
+
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+# overridable so slow/oversubscribed CI hosts can widen the stability
+# margin (heartbeat gaps from scheduler hiccups trigger elections)
+ELECTION_S = float(os.environ.get("RAFT_ELECTION_S", "0.6"))
+HEARTBEAT_S = float(os.environ.get("RAFT_HEARTBEAT_S", "0.08"))
+
+node = Node()
+lock = threading.RLock()
+
+role = "follower"
+term = 0
+voted_for = None
+votes = set()
+log = []                # entries: {"term": t, "op": body}
+applied_idx = -1
+next_idx = {}
+match_idx = {}
+leader = None
+deadline = 0.0
+kv = {}
+
+
+def reset_deadline():
+    global deadline
+    deadline = time.monotonic() + ELECTION_S * (1 + random.random())
+
+
+def other_nodes():
+    return [p for p in node.node_ids if p != node.node_id]
+
+
+def majority():
+    return len(node.node_ids) // 2 + 1
+
+
+def last_log():
+    return (len(log) - 1, log[-1]["term"]) if log else (-1, 0)
+
+
+def become_follower(new_term):
+    global role, term, voted_for, leader
+    role, term, voted_for, leader = "follower", new_term, None, None
+    reset_deadline()
+
+
+def become_candidate():
+    global role, term, voted_for, votes, leader
+    role = "candidate"
+    term += 1
+    voted_for = node.node_id
+    votes = {node.node_id}
+    leader = None
+    reset_deadline()
+    node.log(f"became candidate for term {term}")
+    li, lt = last_log()
+    for peer in other_nodes():
+        node.rpc(peer, {"type": "request_vote", "term": term,
+                        "candidate_id": node.node_id,
+                        "last_log_index": li, "last_log_term": lt},
+                 callback=on_vote_reply(term))
+
+
+def become_leader():
+    global role, leader, next_idx, match_idx
+    role, leader = "leader", node.node_id
+    next_idx = {p: len(log) for p in other_nodes()}
+    match_idx = {p: -1 for p in other_nodes()}
+    node.log(f"became leader for term {term} (log={len(log)})")
+    replicate()
+
+
+def on_vote_reply(req_term):
+    def cb(msg):
+        with lock:
+            b = msg["body"]
+            if b.get("term", 0) > term:
+                become_follower(b["term"])
+            elif (role == "candidate" and term == req_term
+                  and b.get("vote_granted")):
+                votes.add(msg["src"])
+                if len(votes) >= majority():
+                    become_leader()
+    return cb
+
+
+@node.on("request_vote")
+def handle_request_vote(msg):
+    global voted_for
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        granted = False
+        if b["term"] == term and voted_for in (None, b["candidate_id"]):
+            # the up-to-date restriction (last term, then last index):
+            # a stale log must not win an election and overwrite others
+            li, lt = last_log()
+            if (b["last_log_term"], b["last_log_index"]) >= (lt, li):
+                granted = True
+                voted_for = b["candidate_id"]
+                reset_deadline()
+        node.reply(msg, {"type": "request_vote_res", "term": term,
+                         "vote_granted": granted})
+
+
+@node.on("append_entries")
+def handle_append_entries(msg):
+    global role, leader
+    with lock:
+        b = msg["body"]
+        if b["term"] > term:
+            become_follower(b["term"])
+        if b["term"] < term:
+            node.reply(msg, {"type": "append_entries_res", "term": term,
+                             "success": False, "match_index": -1})
+            return
+        if role == "candidate":
+            role = "follower"
+        leader = b["leader_id"]
+        reset_deadline()
+        prev = b["prev_log_index"]
+        if prev >= 0 and (prev >= len(log)
+                          or log[prev]["term"] != b["prev_log_term"]):
+            node.reply(msg, {"type": "append_entries_res", "term": term,
+                             "success": False,
+                             "match_index": min(len(log) - 1, prev - 1)})
+            return
+        global applied_idx
+        i = prev + 1
+        for ent in b["entries"]:
+            if i < len(log) and log[i]["term"] != ent["term"]:
+                del log[i:]                     # conflict: truncate suffix
+                # the dict keeps the truncated entries' effects — stage 3
+                # cannot undo an apply; the checker will exhibit this
+                applied_idx = min(applied_idx, i - 1)
+            if i >= len(log):
+                log.append(ent)
+            i += 1
+        apply_all()                             # stage 3: apply = append
+        node.reply(msg, {"type": "append_entries_res", "term": term,
+                         "success": True,
+                         "match_index": prev + len(b["entries"])})
+
+
+def on_append_reply(peer, req_term):
+    def cb(msg):
+        with lock:
+            b = msg["body"]
+            if b.get("term", 0) > term:
+                become_follower(b["term"])
+                return
+            if role != "leader" or term != req_term:
+                return
+            if b.get("success"):
+                match_idx[peer] = max(match_idx[peer], b["match_index"])
+                next_idx[peer] = match_idx[peer] + 1
+            else:
+                next_idx[peer] = max(0, min(next_idx[peer] - 1,
+                                            b.get("match_index", -1) + 1))
+    return cb
+
+
+def replicate():
+    for peer in other_nodes():
+        nx = next_idx[peer]
+        prev = nx - 1
+        prev_term = log[prev]["term"] if prev >= 0 else 0
+        node.rpc(peer, {"type": "append_entries", "term": term,
+                        "leader_id": node.node_id,
+                        "prev_log_index": prev, "prev_log_term": prev_term,
+                        "entries": log[nx:nx + 16]},
+                 callback=on_append_reply(peer, term))
+
+
+def apply_op(body):
+    t, k = body["type"], body.get("key")
+    if t == "read":
+        if k not in kv:
+            return RPCError.key_does_not_exist(f"no key {k}").to_body()
+        return {"type": "read_ok", "value": kv[k]}
+    if t == "write":
+        kv[k] = body["value"]
+        return {"type": "write_ok"}
+    if t == "cas":
+        if k not in kv:
+            return RPCError.key_does_not_exist(f"no key {k}").to_body()
+        if kv[k] != body["from"]:
+            return RPCError.precondition_failed(
+                f"expected {body['from']!r}, had {kv[k]!r}").to_body()
+        kv[k] = body["to"]
+        return {"type": "cas_ok"}
+
+
+def apply_all():
+    """Stage 3's deliberate hole: every appended entry applies at once —
+    no commit index, no majority barrier."""
+    global applied_idx
+    while applied_idx < len(log) - 1:
+        applied_idx += 1
+        if log[applied_idx].get("op") is not None:
+            apply_op(log[applied_idx]["op"])
+
+
+def handle_client(msg):
+    global applied_idx
+    with lock:
+        if role != "leader":
+            raise RPCError.temporarily_unavailable(
+                f"not the leader (ask {leader})")
+        log.append({"term": term, "op": msg["body"]})
+        reply = apply_op(msg["body"])   # ack at append: NOT safe
+        applied_idx = len(log) - 1
+        node.log(f"acked index {applied_idx} before replication")
+        node.reply(msg, reply)
+        replicate()
+
+
+for _type in ("read", "write", "cas"):
+    node.on(_type)(handle_client)
+
+
+@node.every(HEARTBEAT_S)
+def tick():
+    with lock:
+        if role == "leader":
+            replicate()
+        elif time.monotonic() >= deadline:
+            become_candidate()
+
+
+reset_deadline()
+
+if __name__ == "__main__":
+    node.run()

@@ -12,20 +12,21 @@ How the history is honest:
   - every broadcast op's invoke is its injection round and its ok is the
     round its `broadcast_ok` reply actually came back through the
     client-message path (collected from the scanned rounds);
-  - read ops are injected *through the protocol* (T_READ -> T_READ_OK
-    acks) strictly after convergence has been verified on device, so
-    materializing their values from the (monotone, complete) `seen` rows
-    is exact — the same contract the interactive runner's
-    `completion()` uses (`maelstrom_tpu/nodes/__init__.py` docstring);
+  - read ops go *through the protocol* (T_READ -> T_READ_OK), and their
+    observed value sets come off the wire: with V <= 64 the reply
+    payload carries the serving node's seen bitmap in (b, c)
+    (`nodes/broadcast.py`), so a read's result is exact at its serve
+    round regardless of how many rounds one dispatch scans;
+  - *racing* reads are injected WHILE values propagate — every few
+    rounds, at rotating nodes — so the stock checker's stable-latency
+    machinery grades real propagation-visibility lag at full scale
+    (nonzero quantiles, sanity-bounded by the grid's hop depth);
+  - *final* reads after verified convergence pin stable/lost for every
+    value; their wire payloads are cross-checked bit-for-bit against
+    host-materialized `seen` rows (the contract the interactive
+    runner's `completion()` relies on);
   - the run fails loudly if convergence is not reached, any ack goes
     missing, or the network dropped anything (`dropped_overflow`).
-
-Because reads are scheduled strictly after convergence, no read ever
-observes a value missing, so the checker's stable-latency quantiles are
-all 0 by construction (jepsen semantics: latency = known -> last-absent
-lag). The grade exercises the attempt/ack/lost/stable machinery; the
-latency machinery is exercised by the interactive runs and the parity
-suite (`maelstrom_tpu/parity.py`), whose reads race propagation.
 
 Used by bench.py (BENCH_GRADED) and unit-tested at small scale on CPU.
 """
@@ -40,6 +41,7 @@ import time
 def run_graded(n_nodes: int, values: int, chunk: int = 100,
                pool_cap: int = 8192, reads: int = 16, seed: int = 2,
                max_rounds: int = 1600, per_neighbor: int = 4,
+               racing_read_every: int = 16,
                out_dir: str | None = None, verbose: bool = True) -> dict:
     """Runs a graded broadcast at `n_nodes` and returns a summary dict
     (checker results + net stats). Writes results.json + history.jsonl
@@ -73,21 +75,23 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
     t_ns = lambda r: int(r * ms_per_round * 1e6)  # noqa: E731
 
     def make_plan(rows):
-        """rows: [(round_in_chunk, dest, type, a)] -> Msgs [chunk, 1]."""
-        plan = T.Msgs.empty((chunk, 1))
+        """rows: [(round_in_chunk, slot, dest, type, a)] -> Msgs
+        [chunk, 2]. Slot 0 carries broadcasts, slot 1 reads, so a read
+        scheduled on an injection round never clobbers the injection."""
+        plan = T.Msgs.empty((chunk, 2))
         if not rows:
             return plan
-        rr, dd, tt, aa = (np.asarray(x) for x in zip(*rows))
-        valid = np.zeros((chunk, 1), bool)
-        dest = np.zeros((chunk, 1), np.int32)
-        typ = np.zeros((chunk, 1), np.int32)
-        a = np.zeros((chunk, 1), np.int32)
-        valid[rr, 0] = True
-        dest[rr, 0] = dd
-        typ[rr, 0] = tt
-        a[rr, 0] = aa
+        rr, ss, dd, tt, aa = (np.asarray(x) for x in zip(*rows))
+        valid = np.zeros((chunk, 2), bool)
+        dest = np.zeros((chunk, 2), np.int32)
+        typ = np.zeros((chunk, 2), np.int32)
+        a = np.zeros((chunk, 2), np.int32)
+        valid[rr, ss] = True
+        dest[rr, ss] = dd
+        typ[rr, ss] = tt
+        a[rr, ss] = aa
         return plan.replace(valid=jnp.asarray(valid),
-                            src=jnp.full((chunk, 1), N, T.I32),
+                            src=jnp.full((chunk, 2), N, T.I32),
                             dest=jnp.asarray(dest), type=jnp.asarray(typ),
                             a=jnp.asarray(a))
 
@@ -95,50 +99,77 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
     inj_round = {2 * v: v for v in range(V)}      # round -> value
     dest_of = lambda v: int((v * 2654435761) % N)  # noqa: E731
 
+    if V > 64:
+        raise ValueError("graded bench needs V <= 64 (read replies carry "
+                         "the seen bitmap on the wire)")
+
     sim = make_sim(program, cfg, seed=seed)
     t0 = time.perf_counter()
     ops = []              # assembled out of order; time-sorted at the end
-    outstanding = []      # FIFO of (f, value, invoke_round, process)
+    # FIFO per op kind: client RPCs have zero network latency and a fixed
+    # reply delay, so acks of one kind return in injection order
+    outstanding = {"broadcast": [], "read": []}
     n_procs = 0
     r = 0
     converged_at = None
+    wire_reads = {}       # process -> decoded value list (cross-check)
 
-    def drain_acks(cm_chunk, base_round, expect_type, read_values=None):
+    def decode_bits(b, c):
+        bits = (int(np.uint32(b)) | (int(np.uint32(c)) << 32))
+        return [v for v in range(V) if (bits >> v) & 1]
+
+    def drain_acks(cm_chunk, base_round):
         """Walks a chunk's collected client messages, appending ok ops
-        for each ack in arrival order (at most one op is ever in flight,
-        so FIFO pairing is exact). Each op gets its own process so
-        History.pairs() matches invoke to completion unambiguously.
-        Guards raise (not assert): the docstring's honesty contract must
-        survive python -O."""
+        for each ack in arrival order. Read values are decoded from the
+        reply payload (the serving node's seen bitmap) — exact at the
+        serve round. Guards raise (not assert): the honesty contract
+        must survive python -O."""
         valid = np.asarray(cm_chunk.valid)         # [chunk, CC]
         types = np.asarray(cm_chunk.type)
+        bs, cs = np.asarray(cm_chunk.b), np.asarray(cm_chunk.c)
         for i in range(valid.shape[0]):
             for j in np.nonzero(valid[i])[0]:
                 t = int(types[i, j])
-                if t != expect_type:
-                    raise RuntimeError(
-                        f"unexpected reply type {t} (want {expect_type})")
-                if not outstanding:
-                    raise RuntimeError("ack with nothing in flight")
-                kind, val, inv_r, proc = outstanding.pop(0)
-                value = (read_values[val] if read_values is not None
-                         else val)
+                kind = {T_BCAST_OK: "broadcast", T_READ_OK: "read"}.get(t)
+                if kind is None:
+                    raise RuntimeError(f"unexpected reply type {t}")
+                if not outstanding[kind]:
+                    raise RuntimeError(f"{kind} ack with nothing in flight")
+                val, inv_r, proc = outstanding[kind].pop(0)
+                value = (decode_bits(bs[i, j], cs[i, j])
+                         if kind == "read" else val)
+                if kind == "read":
+                    wire_reads[proc] = value
                 ops.append(Op(type="ok", f=kind, value=value,
                               process=proc, time=t_ns(base_round + i)))
 
+    # --- phase A: inject the V broadcasts; READS RACE PROPAGATION ---
+    # a racing read every `racing_read_every` rounds at a rotating
+    # pseudorandom node: reads that begin after a value is acked but
+    # before the flood reaches their node push the checker's
+    # last-absent marker — real, nonzero stable latencies at full scale
+    racing_procs = []
     while r < max_rounds:
         rows = []
         for rc in range(chunk):
             v = inj_round.get(r + rc)
             if v is not None:
-                rows.append((rc, dest_of(v), T_BCAST, v))
+                rows.append((rc, 0, dest_of(v), T_BCAST, v))
                 ops.append(Op(type="invoke", f="broadcast", value=v,
                               process=n_procs, time=t_ns(r + rc)))
-                outstanding.append(("broadcast", v, r + rc, n_procs))
+                outstanding["broadcast"].append((v, r + rc, n_procs))
+                n_procs += 1
+            if (r + rc) % racing_read_every == 0:
+                node = dest_of((r + rc) * 11 + 5)
+                rows.append((rc, 1, node, T_READ, 0))
+                ops.append(Op(type="invoke", f="read", value=None,
+                              process=n_procs, time=t_ns(r + rc)))
+                outstanding["read"].append((node, r + rc, n_procs))
+                racing_procs.append(n_procs)
                 n_procs += 1
         sim, cm = run_fn(sim, make_plan(rows))
         cm = jax.device_get(cm)
-        drain_acks(cm, r, T_BCAST_OK)
+        drain_acks(cm, r)
         r += chunk
         if r >= 2 * V and bool(jax.device_get(conv_fn(sim))):
             converged_at = r
@@ -146,43 +177,52 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
     if converged_at is None:
         raise SystemExit(f"graded run did not converge in {max_rounds} "
                          f"rounds")
-    if outstanding:
-        raise RuntimeError(f"{len(outstanding)} broadcasts never acked")
+    if outstanding["broadcast"] or outstanding["read"]:
+        raise RuntimeError(
+            f"{len(outstanding['broadcast'])} broadcasts / "
+            f"{len(outstanding['read'])} reads never acked")
     if verbose:
-        print(f"graded: converged at round {converged_at} "
+        print(f"graded: converged at round {converged_at}, "
+              f"{len(racing_procs)} racing reads "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
 
-    # --- phase B: reads through the protocol, after verified convergence
-    # (seen is monotone and complete, so the rows pulled here are exactly
-    # what each read observed) ---
+    # --- phase B: final reads after verified convergence; wire payloads
+    # cross-checked against host-materialized seen rows ---
     read_nodes = sorted({dest_of(k * 7 + 3) for k in range(reads)}
                         | {0, N - 1})
     seen_rows = np.asarray(jax.device_get(
         sim.nodes["seen"][jnp.asarray(read_nodes), :V]))
-    read_values = {n: [int(v) for v in np.nonzero(seen_rows[i])[0]]
-                   for i, n in enumerate(read_nodes)}
+    materialized = {n: [int(v) for v in np.nonzero(seen_rows[i])[0]]
+                    for i, n in enumerate(read_nodes)}
 
     read_sched = {r + 2 * k: node for k, node in enumerate(read_nodes)}
+    final_proc_node = {}
     last_read_round = max(read_sched)
-    while read_sched or outstanding:
+    while read_sched or outstanding["read"]:
         rows = []
         for rc in range(chunk):
             node = read_sched.pop(r + rc, None)
             if node is not None:
-                rows.append((rc, node, T_READ, 0))
+                rows.append((rc, 1, node, T_READ, 0))
                 ops.append(Op(type="invoke", f="read", value=None,
                               process=n_procs, time=t_ns(r + rc),
                               final=True))
-                outstanding.append(("read", node, r + rc, n_procs))
+                outstanding["read"].append((node, r + rc, n_procs))
+                final_proc_node[n_procs] = node
                 n_procs += 1
         sim, cm = run_fn(sim, make_plan(rows))
         cm = jax.device_get(cm)
-        drain_acks(cm, r, T_READ_OK, read_values=read_values)
+        drain_acks(cm, r)
         r += chunk
         if r > last_read_round + 4 * chunk:
             break
-    if outstanding:
-        raise RuntimeError(f"{len(outstanding)} reads never acked")
+    if outstanding["read"]:
+        raise RuntimeError(f"{len(outstanding['read'])} reads never acked")
+    for proc, node in final_proc_node.items():
+        if wire_reads[proc] != materialized[node]:
+            raise RuntimeError(
+                f"wire/materialized mismatch at node {node}: "
+                f"{wire_reads[proc]} != {materialized[node]}")
 
     # --- grade with the stock checker ---
     ops.sort(key=lambda o: (o.time, o.type != "invoke"))
@@ -190,12 +230,26 @@ def run_graded(n_nodes: int, values: int, chunk: int = 100,
     checker = BroadcastChecker()
     res = checker.check({}, history, {})
     st = T.stats_dict(sim.net)
+    # sanity bound on the graded latencies: a value's visibility lag is
+    # at most the grid's propagation depth (diameter hops at one hop per
+    # round at zero link latency) plus per-edge queueing of the V values
+    # through `per_neighbor`-wide lanes, with 50% slack
+    import math
+    hop_bound_ms = 1.5 * (2 * math.ceil(math.sqrt(N)) + V) * ms_per_round
+    stable_max = (res["stable-latencies"] or {}).get("1") or 0.0
+    if stable_max > hop_bound_ms:
+        raise RuntimeError(
+            f"graded stable-latency max {stable_max}ms exceeds the "
+            f"hop-depth bound {hop_bound_ms}ms — latency model broken")
     summary = {
         "nodes": N, "values": V, "reads": len(read_nodes),
+        "racing_reads": len(racing_procs),
         "rounds": r, "converged_at_round": converged_at,
         "checker": res, "checker_valid": res["valid"],
         "stable_count": res["stable-count"],
         "lost_count": res["lost-count"],
+        "stale_count": res.get("stale-count"),
+        "hop_bound_ms": hop_bound_ms,
         "messages_delivered": st["recv_all"],
         "dropped_overflow": st["dropped_overflow"],
         "history_ops": len(history),

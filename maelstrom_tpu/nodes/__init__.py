@@ -185,14 +185,18 @@ def edge_capacity(opts: dict, program) -> tuple[bool, int]:
     from ..net.static import LANE_STRIDE
     n = program.n_nodes
     lanes = program.lanes
-    assert lanes <= LANE_STRIDE, \
-        f"{program.name}: {lanes} edge lanes exceed LANE_STRIDE"
+    # validity-critical guards raise (not assert): they must survive
+    # python -O, or a forbidden config silently runs lossy channels
+    if lanes > LANE_STRIDE:
+        raise ValueError(
+            f"{program.name}: {lanes} edge lanes exceed LANE_STRIDE")
     dist = (opts.get("latency") or {}).get("dist", "constant")
     tolerates = getattr(program, "tolerates_channel_overwrites", False)
-    if dist != "constant" and not tolerates:
+    if dist != "constant" and not tolerates \
+            and not program.edge_lanes_symmetric:
         # lossless delivery is required but spill reassigns lanes: a
         # positional-lane program cannot run this config correctly
-        assert program.edge_lanes_symmetric, (
+        raise ValueError(
             f"{program.name}: randomized latency with no retransmission "
             f"requires spill-mode channels, which need type-dispatched "
             f"(symmetric) inbox lanes")

@@ -173,6 +173,21 @@ class BroadcastProgram(NodeProgram):
             valid=is_cb | is_read, dest=client_in.src,
             reply_to=client_in.mid, type=reply_type,
             a=jnp.zeros_like(client_in.a))
+        if self.V <= 64:
+            # the value set fits the wire: T_READ_OK carries the node's
+            # post-arrival seen bitmap in b|c, so a read's observed set
+            # is exact at its serve round — no host-side snapshot needed.
+            # bench_graded's racing reads (and its phase-B cross-check)
+            # grade real propagation lag from this payload.
+            wb = jnp.zeros((N,), I32)
+            wc = jnp.zeros((N,), I32)
+            for j in range(min(V, 32)):
+                wb = wb | (seen[:, j].astype(I32) << j)
+            for j in range(32, V):
+                wc = wc | (seen[:, j].astype(I32) << (j - 32))
+            client_out = client_out.replace(
+                b=jnp.where(is_read, wb[:, None], 0),
+                c=jnp.where(is_read, wc[:, None], 0))
 
         if self.naive:
             # forward each new value once per edge; skip-sender drops the

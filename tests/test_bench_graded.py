@@ -12,17 +12,23 @@ def test_graded_broadcast_small(tmp_path):
     from maelstrom_tpu.bench_graded import run_graded
 
     s = run_graded(n_nodes=256, values=16, chunk=50, pool_cap=1024,
-                   reads=8, out_dir=str(tmp_path), verbose=False)
+                   reads=8, racing_read_every=8, out_dir=str(tmp_path),
+                   verbose=False)
     c = s["checker"]
     assert c["valid"] is True
     # every broadcast is invoked, acked through the protocol, and stable
     assert c["attempt-count"] == 16
     assert c["acknowledged-count"] == 16
     assert c["stable-count"] == 16
-    assert c["lost-count"] == 0 and c["stale-count"] == 0
+    # racing reads may observe values mid-propagation (stale is legal,
+    # lost is not)
+    assert c["lost-count"] == 0
     assert s["dropped_overflow"] == 0
-    # stable latencies are measured (known -> last-absent lag)
+    assert s["racing_reads"] > 0
+    # stable latencies are measured (known -> last-absent lag) and
+    # bounded by the propagation model
     assert c["stable-latencies"]["0.5"] is not None
+    assert (c["stable-latencies"]["1"] or 0) <= s["hop_bound_ms"]
 
     # artifacts written and loadable
     res = json.load(open(os.path.join(tmp_path, "results.json")))
@@ -34,5 +40,25 @@ def test_graded_broadcast_small(tmp_path):
     pairs = h.pairs()
     assert all(c is not None and c.is_ok() for _, c in pairs)
     assert sum(1 for i, _ in pairs if i.f == "broadcast") == 16
-    reads = [(i, c) for i, c in pairs if i.f == "read"]
-    assert reads and all(len(c.value) == 16 for _, c in reads)
+    # final reads (post-convergence) observe the complete set; racing
+    # reads observe a monotone prefix of propagation
+    finals = [(i, c) for i, c in pairs if i.f == "read" and i.final]
+    racing = [(i, c) for i, c in pairs if i.f == "read" and not i.final]
+    assert finals and all(len(c.value) == 16 for _, c in finals)
+    assert racing
+
+
+def test_graded_racing_reads_produce_nonzero_latency(tmp_path):
+    """With reads racing propagation on a large-diameter topology, the
+    stock checker's stable-latency quantiles must be nonzero — the
+    VERDICT r2 gap: an all-zeros grading exercised only the
+    attempt/ack machinery."""
+    from maelstrom_tpu.bench_graded import run_graded
+
+    # 1024-node grid: diameter ~62 rounds, injections span 32 rounds,
+    # racing reads every 8 — plenty of reads land mid-flood
+    s = run_graded(n_nodes=1024, values=16, chunk=50, pool_cap=1024,
+                   reads=4, racing_read_every=8, verbose=False)
+    c = s["checker"]
+    assert c["valid"] is True and c["lost-count"] == 0
+    assert (c["stable-latencies"]["1"] or 0) > 0, c["stable-latencies"]

@@ -1,0 +1,16 @@
+"""The raft cluster-grading pipeline (maelstrom_tpu.bench_raft_graded) at
+CI scale: sampled vmapped clusters driven with real contending client
+traffic, per-cluster histories graded by the stock WGL linearizability
+checker — the grading half of the 10k-cluster benchmark config."""
+
+
+def test_raft_clusters_graded_small():
+    from maelstrom_tpu.bench_raft_graded import run_raft_graded
+
+    s = run_raft_graded(n_clusters=24, sample=6, ops_per_client=6,
+                        chunk=10, verbose=False)
+    assert s["sampled_clusters"] == 6
+    assert s["all_linearizable"] is True, s
+    # the traffic was real: two workers contended on a shared register
+    assert s["workers_per_cluster"] == 2
+    assert s["indeterminate_ops"] <= 2, s
