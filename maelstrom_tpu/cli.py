@@ -108,6 +108,9 @@ def build_parser() -> argparse.ArgumentParser:
                        "numbers (msgs-per-op, stable latencies)")
     pa.add_argument("--quick", action="store_true",
                     help="CI-sized subset of configs")
+    pa.add_argument("--render-only", action="store_true",
+                    help="regenerate doc/parity.md + gate verdict from "
+                         "the existing artifacts/parity.json")
     return p
 
 
@@ -267,7 +270,9 @@ def main(argv=None) -> int:
 
     if args.cmd == "parity":
         from .parity import main as parity_main
-        return parity_main(["--quick"] if args.quick else [])
+        pargs = (["--quick"] if args.quick else []) + \
+            (["--render-only"] if args.render_only else [])
+        return parity_main(pargs)
     return 1
 
 

@@ -93,6 +93,17 @@ class NodeProgram:
     # that clip are invalid unless the program (or test opts) accept the
     # distortion explicitly
     tolerates_latency_clipping = False
+    # reply-time state payload: when > 0, the compiled scan snapshots
+    # `reply_payload(state, node)` (an [M] -> [M, W] i32 device fn) for
+    # every client reply, ON DEVICE, AT THE REPLY ROUND, into the reply
+    # log; the host completes the op from that payload
+    # (`completion_payload`) instead of pulling device state. This is
+    # both more exact (the row is from the round that produced the
+    # reply, not end-of-dispatch) and much cheaper on remote backends
+    # (a read completion costs zero extra round trips) — and it makes
+    # the collect-replies fast path sound for programs whose
+    # completions read mutable state.
+    reply_payload_words = 0
 
     def __init__(self, opts: dict, nodes: list[str]):
         self.opts = opts
@@ -135,6 +146,18 @@ class NodeProgram:
         """Reply body -> completed op (type ok). Error bodies are mapped by
         the runner before this is called."""
         return {**op, "type": "ok"}
+
+    def reply_payload(self, state, node_idx):
+        """Device hook (see `reply_payload_words`): [M] node indices ->
+        [M, W] i32 payload rows snapshotting whatever this program's
+        completions need, evaluated inside the compiled round."""
+        raise NotImplementedError
+
+    def completion_payload(self, op: dict, body: dict, payload,
+                           intern: Intern) -> dict:
+        """Reply body + reply-round payload row -> completed op. Used
+        instead of `completion` when `reply_payload_words > 0`."""
+        raise NotImplementedError
 
     def host_op(self, op: dict, read_state: Callable[[], Any],
                 intern: Intern) -> dict:

@@ -103,6 +103,11 @@ class BroadcastProgram(NodeProgram):
         self.retry_rounds = retry + self.n_windows
         self.inbox_cap = int(opts.get("inbox_cap", 4))   # client RPCs only
         self.outbox_cap = self.inbox_cap
+        # read completions decode the node's seen bitmap from the reply
+        # log's payload (packed 32 values per i32 word): exact at the
+        # reply round, zero extra device round trips, and collect-mode
+        # safe (see NodeProgram.reply_payload_words)
+        self.reply_payload_words = self.n_windows * 2
         spill, chan_lanes = edge_capacity(opts, self)
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=chan_lanes, ring=self.ring,
@@ -175,19 +180,15 @@ class BroadcastProgram(NodeProgram):
             a=jnp.zeros_like(client_in.a))
         if self.V <= 64:
             # the value set fits the wire: T_READ_OK carries the node's
-            # post-arrival seen bitmap in b|c, so a read's observed set
-            # is exact at its serve round — no host-side snapshot needed.
+            # post-arrival seen bitmap in b|c (words 0|1 of the shared
+            # `_pack_seen_words` layout), so a read's observed set is
+            # exact at its serve round — no host-side snapshot needed.
             # bench_graded's racing reads (and its phase-B cross-check)
             # grade real propagation lag from this payload.
-            wb = jnp.zeros((N,), I32)
-            wc = jnp.zeros((N,), I32)
-            for j in range(min(V, 32)):
-                wb = wb | (seen[:, j].astype(I32) << j)
-            for j in range(32, V):
-                wc = wc | (seen[:, j].astype(I32) << (j - 32))
+            words = self._pack_seen_words(seen)            # [N, 2]
             client_out = client_out.replace(
-                b=jnp.where(is_read, wb[:, None], 0),
-                c=jnp.where(is_read, wc[:, None], 0))
+                b=jnp.where(is_read, words[:, 0][:, None], 0),
+                c=jnp.where(is_read, words[:, 1][:, None], 0))
 
         if self.naive:
             # forward each new value once per edge; skip-sender drops the
@@ -315,6 +316,37 @@ class BroadcastProgram(NodeProgram):
         channels are checked separately by the runner)."""
         return ~(state["pending"].any() | state["inflight"].any()
                  | state["inflight_old"].any())
+
+    def _pack_seen_words(self, rows):
+        """[..., V] bool seen rows -> [..., n_windows*2] i32, 32 values
+        per word (low bit = lowest value index). The ONE bitmap layout:
+        both the reply-log payload and the V<=64 read-reply wire words
+        (b = word 0, c = word 1) derive from it."""
+        lead = rows.shape[:-1]
+        pad = jnp.pad(rows, [(0, 0)] * (rows.ndim - 1)
+                      + [(0, self.Vp - self.V)])
+        bits = pad.reshape(*lead, self.n_windows * 2, 32).astype(I32)
+        return (bits << jnp.arange(32, dtype=I32)).sum(axis=-1)
+
+    def reply_payload(self, state, node_idx):
+        """[M] node indices -> [M, W] i32: the nodes' seen bitmaps."""
+        return self._pack_seen_words(state["seen"][node_idx])
+
+    def completion_payload(self, op, body, payload, intern):
+        if body["type"] == "read_ok":
+            words = np.asarray(payload, dtype=np.uint32)
+            vals = []
+            for w in range(len(words)):
+                bits = int(words[w])
+                base = w * 32
+                while bits:
+                    b = bits & -bits
+                    vals.append(base + b.bit_length() - 1)
+                    bits ^= b
+            return {**op, "type": "ok",
+                    "value": [intern.value(v) for v in vals
+                              if v < self.V]}
+        return {**op, "type": "ok"}
 
     # --- host boundary ---
 

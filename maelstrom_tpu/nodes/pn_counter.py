@@ -73,6 +73,9 @@ class PnCounterProgram(NodeProgram):
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=chan_lanes, ring=self.ring,
                                    spill=spill)
+        # read completions take the counter value from the reply-round
+        # payload (one word: sum(pos) - sum(neg) at the serving node)
+        self.reply_payload_words = 1
 
     def init_state(self):
         N, D, M = self.n_nodes, self.D, self.M
@@ -203,6 +206,16 @@ class PnCounterProgram(NodeProgram):
             value = int(np.asarray(row["pos"]).sum()
                         - np.asarray(row["neg"]).sum())
             return {**op, "type": "ok", "value": value}
+        return {**op, "type": "ok"}
+
+    def reply_payload(self, state, node_idx):
+        vals = (state["pos"][node_idx].sum(axis=1)
+                - state["neg"][node_idx].sum(axis=1))
+        return vals.astype(I32)[:, None]                  # [M, 1]
+
+    def completion_payload(self, op, body, payload, intern):
+        if body["type"] == "read_ok":
+            return {**op, "type": "ok", "value": int(payload[0])}
         return {**op, "type": "ok"}
 
 

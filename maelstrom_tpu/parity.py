@@ -124,32 +124,44 @@ def compare(measured, expect):
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     quick = "--quick" in argv
+    # --render-only: regenerate the markdown table + gate verdict from an
+    # existing artifacts/parity.json (prose/gate changes shouldn't cost a
+    # multi-hour re-measurement; the JSON is the measurement of record)
+    render_only = "--render-only" in argv
     time_limit = float(os.environ.get("PARITY_TIME_LIMIT", 20.0))
     out_json = os.environ.get("PARITY_OUT", "artifacts/parity.json")
     out_md = os.environ.get("PARITY_MD", "doc/parity.md")
 
-    results = []
-    for name, over, expect, src in CONFIGS:
-        if quick and name not in QUICK:
-            continue
-        t0 = time.perf_counter()
-        m = run_config(name, over, time_limit=time_limit)
-        rows = compare(m, expect)
-        results.append({"config": name, "source": src, "measured": m,
-                        "comparison": [
-                            {"metric": k, "reference": want, "measured": got,
-                             "deviation_pct": dev}
-                            for k, want, got, dev in rows],
-                        "wall_s": round(time.perf_counter() - t0, 1)})
-        worst = max((abs(d) for _, _, _, d in rows if d is not None),
-                    default=None)
-        print(f"parity: {name}: worst deviation "
-              f"{worst}% ({results[-1]['wall_s']}s)", file=sys.stderr)
+    if render_only:
+        with open(out_json) as f:
+            recorded = json.load(f)
+        results = recorded["results"]
+        # the doc header must describe the recorded measurement, not
+        # this process's env default
+        time_limit = float(recorded.get("time_limit", time_limit))
+    else:
+        results = []
+        for name, over, expect, src in CONFIGS:
+            if quick and name not in QUICK:
+                continue
+            t0 = time.perf_counter()
+            m = run_config(name, over, time_limit=time_limit)
+            rows = compare(m, expect)
+            results.append({"config": name, "source": src, "measured": m,
+                            "comparison": [
+                                {"metric": k, "reference": want,
+                                 "measured": got, "deviation_pct": dev}
+                                for k, want, got, dev in rows],
+                            "wall_s": round(time.perf_counter() - t0, 1)})
+            worst = max((abs(d) for _, _, _, d in rows if d is not None),
+                        default=None)
+            print(f"parity: {name}: worst deviation "
+                  f"{worst}% ({results[-1]['wall_s']}s)", file=sys.stderr)
 
-    os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
-    with open(out_json, "w") as f:
-        json.dump({"time_limit": time_limit, "rate": 100.0,
-                   "results": results}, f, indent=2, default=str)
+        os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+        with open(out_json, "w") as f:
+            json.dump({"time_limit": time_limit, "rate": 100.0,
+                       "results": results}, f, indent=2, default=str)
 
     lines = [
         "# Protocol-efficiency parity vs the reference",
@@ -196,19 +208,37 @@ def main(argv=None):
         "  protocol messages, independent of time discretization — and",
         "  land within ~2.5% across every topology.",
         "- Latency quantiles at **100 ms/hop** land within ~5% (tree4",
-        "  within 1.6%). At **10 ms/hop** the percentage deviations look",
-        "  large (p50 +55%) but the absolute gaps are 6–13 ms — under",
-        "  the combined resolution of 1 ms simulation rounds and the",
-        "  10 ms read-sampling cadence, where a half-round phase shift",
-        "  moves a catch by a whole hop. The reference's wall-clock JVM",
-        "  sits on the same knife edge with sub-ms thread jitter.",
+        "  within 1.6%). At **10 ms/hop** the quantiles sit 5–14 ms",
+        "  above the reference's. Two hypotheses were tested:",
+        "  - *Round quantization* — **disproven**: re-running both 10 ms",
+        "    configs at 0.25 ms rounds (4x resolution, the table's",
+        "    '0.25 ms rounds' rows) leaves the deviations unchanged.",
+        "  - *Measurement-clock offset* — supported: recomputing the",
+        "    quantiles from these runs' own histories with the",
+        "    element's `known` (ack) time shifted later by a single",
+        "    constant aligns **all 16 quantile comparisons** (grid +",
+        "    line, both resolutions) at ~7 ms, collapsing the total",
+        "    deviation to the ±6 ms noise floor of single-run order",
+        "    statistics (`python -m maelstrom_tpu.parity_analysis`,",
+        "    artifacts/parity_known_shift.json). A constant,",
+        "    hop-scale-independent offset is the signature of *when the",
+        "    ack is stamped*, not of propagation speed: the reference",
+        "    stamps an element known when a JVM client thread returns",
+        "    from a synchronous RPC (thread handoffs + queue polls after",
+        "    the server actually had the value — milliseconds at 25",
+        "    handlers × rate 100 on one machine), while this framework's",
+        "    virtual-clock ack is exact to one round. A later known",
+        "    shrinks (last_absent − known) at every quantile — and is",
+        "    invisible at 100 ms/hop, exactly as observed. Per-hop",
+        "    delivery here is exact by construction",
+        "    (tests/test_edge_oracle.py).",
         "- The **max of the exponential run** is a single order",
         "  statistic of an unbounded distribution (one latency draw);",
         "  the reference's own 630 ms is one sample of the same tail.",
         "",
         "Gate: msgs-per-op within 10%; latency quantiles within 15% or",
         "1.5 hops absolute; randomized-distribution maxima reported but",
-        "not gated.",
+        "not gated; any invalid or lossy row fails outright.",
     ]
     os.makedirs(os.path.dirname(out_md) or ".", exist_ok=True)
     with open(out_md, "w") as f:

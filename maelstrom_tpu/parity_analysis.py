@@ -1,0 +1,155 @@
+"""Root-cause analysis for the 10 ms-latency parity deviations.
+
+The 0.25 ms-round parity configs disprove the round-quantization
+explanation: at 4x time resolution the deviations do not shrink
+(grid p50 17 -> 18.75 vs reference 11). This module tests the competing
+hypothesis directly on the parity runs' own histories:
+
+    The reference's stable-latency quantiles are computed from
+    wall-clock operation timestamps. An element's `known` time is the
+    add's :ok completion, stamped by a JVM client thread after a
+    synchronous RPC through the simulated scheduler (two thread
+    handoffs and queue polls away from the moment the origin server
+    actually had the value). On a laptop running 25 server handlers
+    plus Jepsen's workers at rate 100, that stamp lags by milliseconds.
+    This framework's virtual-clock ack is exact (within one simulation
+    round). A LATER known shrinks (last_absent - known) by exactly the
+    lateness — at every quantile, on every topology, at any hop scale.
+
+Method: recompute the stock checker's stable-latency quantiles from the
+stored parity histories with `known` shifted by a constant delta, and
+find the delta that minimizes the total absolute deviation from the
+reference's published quantiles ACROSS ALL 10 ms configs at once (one
+shared constant — a per-config fit could chase noise).
+
+Result (see artifacts/parity_known_shift.json): a single delta of
+~6-8 ms aligns all 16 quantile comparisons (grid + line, 1 ms and
+0.25 ms rounds) from systematic +5..+14 ms deviations down to a
+residual of roughly +/-6 ms — the noise floor of single-run order
+statistics. The 100 ms-latency rows never showed the offset above noise
+(~7 ms against 450-800 ms quantiles), which is consistent: the offset
+is absolute, not hop-scaled, so it is a property of the *measurement
+clock*, not of message propagation (per-hop delivery here is exact by
+construction — see tests/test_edge_oracle.py).
+
+Run after a parity sweep:  python -m maelstrom_tpu.parity_analysis
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+# (store-dir name, reference quantiles) for the 10 ms configs
+TEN_MS_CONFIGS = {
+    "parity-grid-25-10-ms": {"p50": 11, "p95": 42, "p99": 56, "max": 72},
+    "parity-line-25-10-ms": {"p50": 86, "p95": 170, "p99": 193,
+                             "max": 224},
+    "parity-grid-25-10-ms-(0.25-ms-rounds)": {"p50": 11, "p95": 42,
+                                              "p99": 56, "max": 72},
+    "parity-line-25-10-ms-(0.25-ms-rounds)": {"p50": 86, "p95": 170,
+                                              "p99": 193, "max": 224},
+}
+
+
+def quantiles_with_shift(history, shift_ms: float) -> dict:
+    """The stock set-full stable-latency computation with the element's
+    `known` time shifted later by `shift_ms` (modelling ack-stamp
+    lateness in a wall-clock harness)."""
+    pairs = history.pairs()
+    attempts, acked = {}, {}
+    for inv, comp in pairs:
+        if inv.f != "broadcast":
+            continue
+        attempts[inv.value] = inv.time
+        if comp is not None and comp.is_ok():
+            acked[inv.value] = comp.time
+    reads = []
+    for inv, comp in pairs:
+        if inv.f != "read" or comp is None or not comp.is_ok():
+            continue
+        reads.append((inv.time, comp.time, frozenset(comp.value or [])))
+    reads.sort()
+    lat = []
+    for e in attempts:
+        present = [(ti, tc) for ti, tc, els in reads if e in els]
+        if e in acked:
+            known = acked[e] + shift_ms * 1e6
+        elif present:
+            known = min(tc for ti, tc in present) + shift_ms * 1e6
+        else:
+            continue
+        absent = [ti for ti, tc, els in reads
+                  if ti > known and e not in els]
+        la = max(absent, default=None)
+        if la is None and not any(ti > known for ti, tc in present):
+            continue                            # never-read: no verdict
+        if la is not None and not any(ti > la for ti, tc in present):
+            continue                            # lost (none here)
+        lat.append(max(0, ((la or known) - known)) / 1e6)
+    lat.sort()
+    n = len(lat)
+
+    def q(p):
+        return lat[min(n - 1, int(p * n))] if n else None
+    return {"p50": q(.5), "p95": q(.95), "p99": q(.99),
+            "max": lat[-1] if n else None}
+
+
+def main(argv=None):
+    from .history import History
+    store = os.environ.get("PARITY_STORE", "/tmp/maelstrom-parity-store")
+    out_path = os.environ.get("PARITY_SHIFT_OUT",
+                              "artifacts/parity_known_shift.json")
+    hists = {}
+    for name in TEN_MS_CONFIGS:
+        dirs = sorted(glob.glob(os.path.join(store, name, "2*")))
+        if not dirs:
+            print(f"missing store for {name}; run the parity sweep first",
+                  file=sys.stderr)
+            return 1
+        with open(os.path.join(dirs[-1], "history.jsonl")) as f:
+            hists[name] = History.from_jsonl(f.read())
+
+    shifts = [round(0.5 * i, 1) for i in range(0, 25)]   # 0..12 ms
+    key0 = str(shifts[0])
+    table = {}
+    totals = {}
+    for s in shifts:
+        total = 0.0
+        per = {}
+        for name, ref in TEN_MS_CONFIGS.items():
+            qs = quantiles_with_shift(hists[name], s)
+            devs = {k: round(qs[k] - ref[k], 2) for k in ref
+                    if qs[k] is not None}
+            per[name] = {"quantiles": qs, "abs_dev_ms": devs}
+            total += sum(abs(v) for v in devs.values())
+        table[str(s)] = per
+        totals[str(s)] = round(total, 1)
+    best = min(totals, key=lambda k: totals[k])
+    out = {
+        "hypothesis": "constant known-time (ack-stamp) offset between "
+                      "the reference's wall-clock harness and this "
+                      "framework's exact virtual-time acks",
+        "shifts_ms": shifts,
+        "total_abs_dev_ms_by_shift": totals,
+        "best_shift_ms": float(best),
+        "total_abs_dev_at_0": totals[key0],
+        "total_abs_dev_at_best": totals[best],
+        "detail_at_0": table[key0],
+        "detail_at_best": table[best],
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"best_shift_ms": out["best_shift_ms"],
+                      "total_abs_dev_at_0": out["total_abs_dev_at_0"],
+                      "total_abs_dev_at_best": out["total_abs_dev_at_best"],
+                      "wrote": out_path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Seed-variance study for a parity config: re-runs one config across
+PRNG seeds and reports per-quantile spread.
+
+The reference's tutorial numbers are single samples of a noisy
+statistic (each stable-latency quantile is an order statistic over
+~1000 values whose last-absent read is a race between a randomized read
+schedule and propagation). Before attributing a deviation to the
+simulation's semantics, measure how much of it is run-to-run variance.
+
+    python -m maelstrom_tpu.parity_seeds "grid 25, 10 ms" 3 4 5 6 7
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    from .parity import CONFIGS, run_config
+    name = argv[0]
+    seeds = [int(s) for s in argv[1:]] or [3, 4, 5]
+    cfg = next(c for c in CONFIGS if c[0] == name)
+    _, over, expect, src = cfg
+    rows = []
+    for seed in seeds:
+        m = run_config(name, over, seed=seed)
+        rows.append({"seed": seed,
+                     **{k: m.get(k) for k in
+                        ("valid", "server_mpo", "p50", "p95", "p99",
+                         "max", "lost")}})
+        print(json.dumps(rows[-1]), file=sys.stderr)
+    out = {"config": name, "source": src, "reference": expect,
+           "seeds": rows}
+    for q in ("p50", "p95", "p99", "max"):
+        vals = [r[q] for r in rows if r[q] is not None]
+        if vals:
+            out[q] = {"min": min(vals), "max": max(vals),
+                      "mean": round(sum(vals) / len(vals), 1),
+                      "reference": expect.get(q)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

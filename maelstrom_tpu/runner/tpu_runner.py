@@ -240,7 +240,11 @@ class TpuRunner:
         # state_reads_final.
         self.collect_replies = bool(test.get("collect_replies", True)) and (
             not self.program.needs_state_reads
-            or getattr(self.program, "state_reads_final", False))
+            or getattr(self.program, "state_reads_final", False)
+            # reply payloads are snapshotted at the reply's own round
+            # inside the scan, so crossing reply-bearing stretches can
+            # no longer skew completion values
+            or getattr(self.program, "reply_payload_words", 0) > 0)
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
@@ -525,12 +529,17 @@ class TpuRunner:
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
                 if self._pack_buf is None:
-                    self._pack_buf = self._make_packer((buf, rl))
+                    self._pack_buf = self._make_packer(
+                        (buf, rl, k, self.sim.net.next_mid))
                 pack, unpack = self._pack_buf
-                k, flat, self._next_mid = jax.device_get(
-                    (k, pack((buf, rl)), self.sim.net.next_mid))
+                # ONE fetched array per dispatch: k and next_mid ride the
+                # packed buffer (every separately fetched array is its own
+                # round trip on remote backends)
+                flat = jax.device_get(
+                    pack((buf, rl, k, self.sim.net.next_mid)))
+                buf, (rlog, rounds, plog, rn), k, self._next_mid = \
+                    unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
-                buf, (rlog, rounds, rn) = unpack(flat)
                 quiet_cm = jax.tree.map(
                     lambda a: np.zeros_like(a[:max(C, 1)]), rlog)
                 for i in range(k):
@@ -555,22 +564,27 @@ class TpuRunner:
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
                 if self._pack_replies is None:
-                    self._pack_replies = self._make_packer(rl)
+                    self._pack_replies = self._make_packer(
+                        (rl, k, self.sim.net.next_mid))
                 pack, unpack = self._pack_replies
-                k, flat, self._next_mid = jax.device_get(
-                    (k, pack(rl), self.sim.net.next_mid))
+                # ONE fetched array per dispatch (see journal branch)
+                flat = jax.device_get(
+                    pack((rl, k, self.sim.net.next_mid)))
+                (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
-                rlog, rounds, rn = unpack(flat)
                 rn = int(rn)
+            use_payload = getattr(self.program,
+                                  "reply_payload_words", 0) > 0
             replies = [(int(rounds[j]), int(rlog.type[j]),
                         int(rlog.a[j]), int(rlog.b[j]),
-                        int(rlog.c[j]), int(rlog.reply_to[j]))
+                        int(rlog.c[j]), int(rlog.reply_to[j]),
+                        plog[j] if use_payload else None)
                        for j in range(rn)]
             r += k
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
-            for stamp, t_, a_, b_, c_, rt in replies:
+            for stamp, t_, a_, b_, c_, rt, payload in replies:
                 entry = pending.pop(rt, None)
                 if entry is None:
                     continue        # stale reply (client.clj:167-168)
@@ -584,6 +598,11 @@ class TpuRunner:
                                  "error": [err.name if err
                                            else body.get("code"),
                                            body.get("text")]}
+                elif payload is not None:
+                    # state snapshotted at the reply round, on device —
+                    # no host<->device round trip per completion
+                    completed = program.completion_payload(
+                        op, body, payload, self.intern)
                 else:
                     completed = program.completion(
                         op, body, lambda i2=node_idx: self._read_state(i2),

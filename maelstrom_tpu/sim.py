@@ -188,8 +188,11 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
     rcap_req = None if reply_cap is None else max(1, int(reply_cap))
     rcap = None
     cw = None
+    # per-reply state payload (NodeProgram.reply_payload_words): rows
+    # snapshot completion state at the reply's own round, on device
+    W = int(getattr(program, "reply_payload_words", 0) or 0)
 
-    def append_replies(rlog, rounds, rn, cm, round_i):
+    def append_replies(rlog, rounds, plog, rn, cm, nodes, round_i):
         """Compacts this round's valid client msgs onto the reply log.
         Invalid rows scatter to an out-of-bounds index and are dropped,
         so duplicate-position writes cannot clobber real replies."""
@@ -201,10 +204,14 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
         rlog = jax.tree.map(upd, rlog, cm)
         rounds = rounds.at[pos].set(
             jnp.broadcast_to(round_i, pos.shape), mode="drop")
-        return rlog, rounds, rn + jnp.sum(cm.valid.astype(I32))
+        if W:
+            src_node = jnp.clip(cm.src, 0, cfg.n_nodes - 1)
+            rows = program.reply_payload(nodes, src_node)   # [CW, W]
+            plog = plog.at[pos].set(rows, mode="drop")
+        return rlog, rounds, plog, rn + jnp.sum(cm.valid.astype(I32))
 
     def cond(st):
-        _sim, cm, k, k_max, stop, _buf, _rlog, _rounds, rn = st
+        _sim, cm, k, k_max, stop, _buf, _rlog, _rounds, _plog, rn = st
         go = k < k_max
         go = go & ~(stop & cm.valid.any())
         if rcap_req is not None:
@@ -212,7 +219,7 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
         return go
 
     def body(st):
-        sim, _cm, k, k_max, stop, buf, rlog, rounds, rn = st
+        sim, _cm, k, k_max, stop, buf, rlog, rounds, plog, rn = st
         sim2, cm2, io = _round(program, cfg, sim, empty)
         if cap is not None:
             buf = jax.tree.map(lambda b, x: b.at[k].set(x), buf, io)
@@ -220,10 +227,10 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
             # stamp with the post-round counter: the host processes a
             # reply at the round after its producing dispatch, and the
             # replay must use identical times
-            rlog, rounds, rn = append_replies(rlog, rounds, rn, cm2,
-                                              sim2.net.round)
+            rlog, rounds, plog, rn = append_replies(
+                rlog, rounds, plog, rn, cm2, sim2.nodes, sim2.net.round)
         return (sim2, cm2, k + jnp.int32(1), k_max, stop, buf, rlog,
-                rounds, rn)
+                rounds, plog, rn)
 
     @jax.jit
     def scan_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply=True):
@@ -239,20 +246,24 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
             buf = jax.tree.map(lambda b, x: b.at[0].set(x), buf, io1)
             k_max = jnp.minimum(k_max, cap)
         if rcap_req is None:
-            rlog, rounds, rn = (), jnp.zeros(0, I32), jnp.int32(0)
+            rlog, rounds, plog, rn = ((), jnp.zeros(0, I32), (),
+                                      jnp.int32(0))
         else:
             cw = int(cm1.valid.shape[0])
             rcap = max(rcap_req, 2 * cw)
             rlog = Msgs.empty(rcap)
             rounds = jnp.zeros(rcap, I32)
-            rlog, rounds, rn = append_replies(rlog, rounds, jnp.int32(0),
-                                              cm1, sim1.net.round)
-        st = (sim1, cm1, jnp.int32(1), k_max, stop, buf, rlog, rounds, rn)
-        sim2, cm, k, _, _, buf, rlog, rounds, rn = jax.lax.while_loop(
-            cond, body, st)
+            plog = jnp.zeros((rcap, W), I32) if W else ()
+            rlog, rounds, plog, rn = append_replies(
+                rlog, rounds, plog, jnp.int32(0), cm1, sim1.nodes,
+                sim1.net.round)
+        st = (sim1, cm1, jnp.int32(1), k_max, stop, buf, rlog, rounds,
+              plog, rn)
+        sim2, cm, k, _, _, buf, rlog, rounds, plog, rn = \
+            jax.lax.while_loop(cond, body, st)
         out = (sim2, cm, k)
         if rcap is not None:
-            out = out + ((rlog, rounds, rn),)
+            out = out + ((rlog, rounds, plog, rn),)
         if cap is not None:
             out = out + (buf,)
         return out

@@ -293,3 +293,31 @@ def test_wgl_definite_fail_excluded_at_checker_level():
     # the checker must reject it because the cas definitely didn't run
     h[-1] = hop("ok", "read", [0, 2], 0, 5)
     assert LinearizableRegisterChecker().check({}, h)["valid"] is False
+
+
+def test_parity_known_shift_quantiles():
+    """parity_analysis.quantiles_with_shift: shifting `known` later by
+    d ms reduces each element's stable latency by exactly d (down to 0)
+    when the last-absent read stays fixed — the mechanism behind the
+    known-offset parity analysis."""
+    from maelstrom_tpu.history import History, Op
+    from maelstrom_tpu.parity_analysis import quantiles_with_shift
+
+    ms = 1e6
+    ops = []
+    # element 0: acked at t=0ms, reads miss it at 10ms and 20ms, then
+    # present from 30ms on -> latency 20ms
+    ops += [Op(type="invoke", f="broadcast", value=0, process=0, time=0),
+            Op(type="ok", f="broadcast", value=0, process=0, time=0)]
+    for i, (t, els) in enumerate([(10, []), (20, []), (30, [0]),
+                                  (40, [0])]):
+        ops += [Op(type="invoke", f="read", value=None, process=10 + i,
+                   time=int(t * ms)),
+                Op(type="ok", f="read", value=els, process=10 + i,
+                   time=int(t * ms))]
+    h = History(sorted(ops, key=lambda o: (o.time, o.type != "invoke")))
+    assert quantiles_with_shift(h, 0)["max"] == 20.0
+    assert quantiles_with_shift(h, 5)["max"] == 15.0
+    # shifting past the last absent read: the 20ms miss no longer counts
+    # (reads must begin strictly after known)
+    assert quantiles_with_shift(h, 25)["max"] == 0.0
