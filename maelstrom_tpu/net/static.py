@@ -278,11 +278,20 @@ def edge_read(cfg: EdgeConfig, ch: EdgeChannels, neighbors, rev,
     safe_nb = jnp.clip(neighbors, 0, cfg.n_nodes - 1)
     safe_rev = jnp.clip(rev, 0, cfg.degree - 1)
     edge_ok = (neighbors >= 0)
+    N, D, L = cfg.n_nodes, cfg.degree, cfg.lanes
+    # the routing is a fixed permutation of flat (node, edge) pairs; a
+    # row-take over that flat axis lowers to a vectorized gather, where
+    # the naive f[nb, rev, s, :] advanced-indexing form lowered to a
+    # near-scalar gather (measured 9.7 ms vs 2.7 ms per round for the
+    # 100k-node bench shapes)
+    flat = (safe_nb * D + safe_rev).reshape(N * D)
 
+    # slice the arrival cell first (one [N, D, L] dynamic slice), then
+    # route with one flat row-take
     def route(f):
-        # cell arriving this round, viewed from the receiving end:
-        # f[nb[m,e], rev[m,e], s, :]
-        return f[safe_nb, safe_rev, s, :]
+        sl = jax.lax.dynamic_index_in_dim(f, s, axis=2, keepdims=False)
+        return jnp.take(sl.reshape(N * D, L), flat,
+                        axis=0).reshape(N, D, L)
 
     inbox = EdgeMsgs(
         valid=route(ch.valid) & edge_ok[:, :, None],

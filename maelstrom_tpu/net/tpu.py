@@ -33,6 +33,7 @@ no data-dependent control flow.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -227,7 +228,25 @@ def _deliver(cfg: NetConfig, net: NetState):
     `[client_cap]` Msgs batch of messages addressed to clients. Node messages
     that lose the K-slot race stay pooled for the next round; partitioned
     messages are consumed and dropped, mirroring the reference's recv
-    (`net.clj:222-246`)."""
+    (`net.clj:222-246`).
+
+    Rounds with nothing due skip the whole delivery pipeline under a
+    `lax.cond`: edge programs route node traffic over the static
+    channels, so their pool is empty most rounds, and the ~5 ms
+    composite-key argsort at 100k nodes was pure overhead there. (Under
+    vmap — the cluster-parallel path — XLA lowers the cond to executing
+    both branches, which is simply the old behavior.)"""
+    N, K = cfg.n_nodes, cfg.inbox_cap
+    CC = min(cfg.client_cap, cfg.pool_cap)
+    any_due = (net.pool.valid & (net.pool.due <= net.round)).any()
+
+    def skip(net):
+        return net, Msgs.empty((N, K)), Msgs.empty(CC)
+
+    return jax.lax.cond(any_due, partial(_deliver_due, cfg), skip, net)
+
+
+def _deliver_due(cfg: NetConfig, net: NetState):
     pool, P, N, K = net.pool, cfg.pool_cap, cfg.n_nodes, cfg.inbox_cap
 
     due = pool.valid & (pool.due <= net.round)
