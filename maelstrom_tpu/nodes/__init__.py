@@ -193,9 +193,9 @@ def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
     return ring, retry_rounds, lat_rounds
 
 
-def edge_capacity(opts: dict, program) -> tuple[bool, int]:
+def edge_capacity(opts: dict, program) -> tuple[bool, int, bool]:
     """Shared spill-mode decision + lane sizing for a program's
-    EdgeConfig: (spill, channel_lanes).
+    EdgeConfig: (spill, channel_lanes, uniform_arrival).
 
     Spill (the collision-free write, net/static.py) is *mandatory* when
     a destroyed message would change protocol semantics (randomized
@@ -227,7 +227,9 @@ def edge_capacity(opts: dict, program) -> tuple[bool, int]:
              and (n <= 4096 or not tolerates))
     if spill and n <= 4096:
         lanes = min(2 * lanes, LANE_STRIDE)
-    return spill, lanes
+    # constant draws are identical within a round: edge_write can update
+    # the single shared arrival cell (EdgeConfig.uniform_arrival)
+    return spill, lanes, dist == "constant"
 
 
 PROGRAMS: dict[str, Callable] = {}

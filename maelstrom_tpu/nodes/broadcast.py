@@ -108,10 +108,10 @@ class BroadcastProgram(NodeProgram):
         # reply round, zero extra device round trips, and collect-mode
         # safe (see NodeProgram.reply_payload_words)
         self.reply_payload_words = self.n_windows * 2
-        spill, chan_lanes = edge_capacity(opts, self)
+        spill, chan_lanes, uniform = edge_capacity(opts, self)
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=chan_lanes, ring=self.ring,
-                                   spill=spill)
+                                   spill=spill, uniform_arrival=uniform)
 
     def init_state(self):
         N, D, V = self.n_nodes, self.D, self.V

@@ -69,10 +69,10 @@ class PnCounterProgram(NodeProgram):
         self.ring, self.retry_rounds, _lat = edge_timing(opts, len(nodes))
         self.inbox_cap = int(opts.get("inbox_cap", 4))
         self.outbox_cap = self.inbox_cap
-        spill, chan_lanes = edge_capacity(opts, self)
+        spill, chan_lanes, uniform = edge_capacity(opts, self)
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=chan_lanes, ring=self.ring,
-                                   spill=spill)
+                                   spill=spill, uniform_arrival=uniform)
         # read completions take the counter value from the reply-round
         # payload (one word: sum(pos) - sum(neg) at the serving node)
         self.reply_payload_words = 1

@@ -103,8 +103,16 @@ class RaftProgram(NodeProgram):
         self.heartbeat = max(self.election // 8, 2)
         self.inbox_cap = int(opts.get("inbox_cap", 4))
         self.outbox_cap = self.inbox_cap
+        # positional lanes forbid spill (edge_capacity returns False:
+        # AE/RV retransmit every round, so overwrites are tolerated),
+        # but the single-cell constant-latency write (uniform_arrival)
+        # is orthogonal: it never moves a message between lanes
+        from . import edge_capacity
+        spill, chan_lanes, uniform = edge_capacity(opts, self)
+        assert not spill and chan_lanes == self.lanes
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
-                                   lanes=self.lanes, ring=self.ring)
+                                   lanes=self.lanes, ring=self.ring,
+                                   uniform_arrival=uniform)
 
     def init_state(self):
         N, D, C = self.n_nodes, self.D, self.cap

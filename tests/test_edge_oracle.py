@@ -27,11 +27,13 @@ N, D = NEIGHBORS.shape
 LANES = 2
 
 
-def drive(cfg, schedule, rounds):
+def drive(cfg, schedule, rounds, lat_fill=None, track_sent=False):
     """schedule: {round: [(n, d, lane, a, lat, deliver)]}. Returns
-    (delivered {(round, receiver, rev_edge, lane): a}, overwrites,
-    clipped)."""
-    ch = S.make_channels(cfg)
+    (delivered {(round, receiver, rev_edge, lane): a-or-(a, sent)},
+    overwrites, clipped). `lat_fill`: {round: lv} fills the WHOLE
+    latency array (the uniform_arrival contract: constant draws cover
+    every entry, valid or not)."""
+    ch = S.make_channels(cfg, track_send_round=track_sent)
     nb = jnp.asarray(NEIGHBORS)
     rev = jnp.asarray(REV)
     delivered = {}
@@ -42,9 +44,13 @@ def drive(cfg, schedule, rounds):
             for e in range(D):
                 for l in range(LANES):
                     if ib.valid[m, e, l]:
-                        delivered[(r, m, e, l)] = int(ib.a[m, e, l])
+                        delivered[(r, m, e, l)] = (
+                            (int(ib.a[m, e, l]), int(ib.sent[m, e, l]))
+                            if track_sent else int(ib.a[m, e, l]))
         out = S.EdgeMsgs.empty((N, D, LANES))
-        lat = np.zeros((N, D, LANES), np.int32)
+        lat = np.full((N, D, LANES),
+                      0 if lat_fill is None else lat_fill.get(r, 0),
+                      np.int32)
         mask = np.ones((N, D, LANES), bool)
         valid = np.zeros((N, D, LANES), bool)
         a = np.zeros((N, D, LANES), np.int32)
@@ -230,3 +236,30 @@ def test_spill_no_loss_when_capacity_suffices():
     delivered, overwrites, _ = drive_spill(cfg, schedule, 6, 2)
     assert overwrites == 0
     assert delivered == {(2, 2, 0): [7, 9]}
+
+
+@settings(max_examples=25, deadline=None)
+@given(evs=events, ring=st.integers(2, 6),
+       lat_of_round=st.lists(st.integers(0, 5), min_size=16, max_size=16))
+def test_uniform_arrival_matches_general_write(evs, ring, lat_of_round):
+    """EdgeConfig(uniform_arrival=True) — the constant-latency single-
+    cell write — must be observationally identical to the general write
+    whenever every round's latency array is uniform (the constant-dist
+    contract, scale nemesis included)."""
+    base = S.EdgeConfig(n_nodes=N, degree=D, lanes=LANES, ring=ring)
+    uni = S.EdgeConfig(n_nodes=N, degree=D, lanes=LANES, ring=ring,
+                       uniform_arrival=True)
+    slots = {}
+    for (r, n, d, l, av, _lv, dv) in evs:
+        if NEIGHBORS[n, d] < 0:
+            continue
+        slots[(r, n, d, l)] = (av, lat_of_round[r % 16], dv)
+    schedule = {}
+    for (r, n, d, l), (av, lv, dv) in slots.items():
+        schedule.setdefault(r, []).append((n, d, l, av, lv, dv))
+    rounds = 6 + ring + 10
+    fill = {r: lat_of_round[r % 16] for r in range(rounds)}
+    # track_sent also pins the uniform path's journal-stamp plane
+    assert (drive(base, schedule, rounds, lat_fill=fill, track_sent=True)
+            == drive(uni, schedule, rounds, lat_fill=fill,
+                     track_sent=True))

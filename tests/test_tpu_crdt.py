@@ -51,3 +51,37 @@ def test_g_counter_tpu_e2e():
     res = run({"workload": "g-counter", "node": "tpu:g-counter",
                "node_count": 5})
     assert res["valid"] is True, res["workload"]
+
+
+def test_broadcast_reply_payload_roundtrip():
+    """The reply-log payload (packed seen bitmap) decodes to exactly the
+    node's seen set — the device/host contract behind zero-round-trip
+    read completions (NodeProgram.reply_payload_words)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from maelstrom_tpu.nodes import get_program
+
+    prog = get_program("broadcast", {"topology": "grid",
+                                     "max_values": 100},
+                       [f"n{i}" for i in range(4)])
+    state = prog.init_state()
+    rows = np.zeros((4, 100), bool)
+    rows[1, [0, 31, 32, 63, 64, 99]] = True
+    rows[3, 97] = True
+    state["seen"] = jnp.asarray(rows)
+    payload = np.asarray(prog.reply_payload(state, jnp.asarray([1, 3, 0])))
+    assert payload.shape == (3, prog.reply_payload_words)
+
+    class FakeIntern:
+        def value(self, i):
+            return i
+    done = prog.completion_payload({"f": "read"}, {"type": "read_ok"},
+                                   payload[0], FakeIntern())
+    assert done["value"] == [0, 31, 32, 63, 64, 99]
+    done = prog.completion_payload({"f": "read"}, {"type": "read_ok"},
+                                   payload[1], FakeIntern())
+    assert done["value"] == [97]
+    done = prog.completion_payload({"f": "read"}, {"type": "read_ok"},
+                                   payload[2], FakeIntern())
+    assert done["value"] == []
