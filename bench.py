@@ -29,6 +29,55 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 
+_ENV_ERROR_MARKS = (
+    "Unable to initialize backend", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "Socket closed", "failed to connect", "Connection reset",
+)
+
+
+def _is_env_error(exc: BaseException) -> bool:
+    """True when the failure is the tunneled TPU backend being down, not
+    a bug in the benchmark (r3 lesson: one transient backend-init failure
+    lost the whole round's artifact)."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _ENV_ERROR_MARKS)
+
+
+def run_with_env_retry(fn, attempts=3, backoff_s=60,
+                       metric="broadcast_sim_msgs_per_sec_100k_nodes",
+                       unit="msgs/sec"):
+    """Run `fn`; on an environmental (backend-unavailable) failure, clear
+    the half-initialized backend and retry up to `attempts` times with
+    `backoff_s` sleeps. On final environmental failure emit a JSON record
+    with "env_unavailable": true — machine-distinguishable from a
+    regression — and exit 3. Non-environmental errors propagate."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - filtered by _is_env_error
+            if not _is_env_error(e):
+                raise
+            last = e
+            print(f"bench: backend unavailable (attempt {i + 1}/"
+                  f"{attempts}): {e}", file=sys.stderr)
+            try:
+                import jax._src.xla_bridge as xb
+                xb._clear_backends()
+            except Exception:
+                pass
+            if i < attempts - 1:
+                time.sleep(backoff_s)
+    print(json.dumps({
+        "metric": metric,
+        "value": None, "unit": unit, "vs_baseline": None,
+        "env_unavailable": True,
+        "error": f"{type(last).__name__}: {last}",
+        "attempts": attempts,
+    }))
+    sys.exit(3)
+
+
 def bench_raft_clusters():
     """Secondary benchmark: 10k independent 5-node raft clusters advance
     under one vmap (BASELINE config 4). Metric: cluster-rounds/sec —
@@ -111,7 +160,14 @@ def main():
     from maelstrom_tpu.util import honor_jax_platforms
     honor_jax_platforms()   # JAX_PLATFORMS=cpu smoke runs; no-op unset
     if os.environ.get("BENCH_MODE") == "raft":
-        return bench_raft_clusters()
+        return run_with_env_retry(
+            bench_raft_clusters,
+            metric="raft_cluster_rounds_per_sec_10k_clusters",
+            unit="cluster-rounds/sec")
+    return run_with_env_retry(_main_broadcast)
+
+
+def _main_broadcast():
     import jax
     import jax.numpy as jnp
 

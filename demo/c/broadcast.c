@@ -114,9 +114,12 @@ static int n_nbrs = 0;
 /* acked[nb][val]: neighbor nb has acknowledged value val */
 static unsigned char acked[MAX_NBRS][MAX_VALUES];
 
-/* outstanding gossip RPCs: msg_id -> (nb, val), -1 = free */
+/* outstanding gossip RPCs: msg_id -> (nb, val), -1 = free. Slots are
+ * indexed msg_id % MAX_RPC; rpc_mid holds the full id so a late ack for
+ * a wrapped-around old id can't mark a reused slot's pair acked. */
 static int rpc_nb[MAX_RPC];
 static int rpc_val[MAX_RPC];
+static long rpc_mid[MAX_RPC];
 
 static int find_or_add_value(const char *tok, size_t n) {
     if (n >= VAL_LEN) n = VAL_LEN - 1;
@@ -142,6 +145,7 @@ static void send_gossip(int nb, int val) {
     long mid = ++next_id;
     rpc_nb[mid % MAX_RPC] = nb;
     rpc_val[mid % MAX_RPC] = val;
+    rpc_mid[mid % MAX_RPC] = mid;
     printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": "
            "{\"type\": \"gossip\", \"msg_id\": %ld, \"message\": %s}}\n",
            node_id, nbrs[nb], mid, values[val]);
@@ -168,7 +172,7 @@ static void handle_line(const char *line) {
     if (irt_v) {                       /* a reply: gossip_ok ack */
         long mid = strtol(irt_v, NULL, 10);
         int slot = (int)(mid % MAX_RPC);
-        if (rpc_nb[slot] >= 0) {
+        if (rpc_nb[slot] >= 0 && rpc_mid[slot] == mid) {
             acked[rpc_nb[slot]][rpc_val[slot]] = 1;
             rpc_nb[slot] = -1;
         }

@@ -229,8 +229,12 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     checker = LinearizableRegisterChecker()
     results = []
     for s in range(S):
+        # completions sort BEFORE invokes at equal round-quantized
+        # timestamps: an op completing at round t must happen-before an
+        # op invoked at round t, else the real-time order relaxes in the
+        # lenient (false-valid) direction
         ops = sorted(histories[s], key=lambda o: (o.time,
-                                                  o.type != "invoke"))
+                                                  o.type == "invoke"))
         res = checker.check({}, History(ops), {})
         results.append(res["valid"])
     ok_count = sum(1 for v in results if v is True)

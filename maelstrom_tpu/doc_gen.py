@@ -14,10 +14,12 @@ from . import schema as S
 PROTOCOL_INTRO = """\
 # Protocol
 
-Maelstrom nodes receive messages on STDIN, send messages on STDOUT, and log
-debugging output on STDERR. Nodes must not print anything that is not a
-message to STDOUT. Maelstrom processes are sequential programs which
-communicate by passing messages.
+A node is an ordinary OS process wired to the harness through its three
+standard streams: each line on STDIN is an incoming message, each line it
+writes to STDOUT is an outgoing message, and STDERR is free-form debug
+logging. Because STDOUT *is* the wire, a node must never print anything
+there except well-formed messages. Within a node, handling is sequential;
+all coordination between nodes happens by exchanging these messages.
 
 ## Messages
 

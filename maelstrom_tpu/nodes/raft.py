@@ -109,7 +109,11 @@ class RaftProgram(NodeProgram):
         # is orthogonal: it never moves a message between lanes
         from . import edge_capacity
         spill, chan_lanes, uniform = edge_capacity(opts, self)
-        assert not spill and chan_lanes == self.lanes
+        if spill or chan_lanes != self.lanes:
+            raise ValueError(
+                f"raft requires positional lanes (no spill, lanes="
+                f"{self.lanes}); edge_capacity returned spill={spill}, "
+                f"lanes={chan_lanes}")
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=self.lanes, ring=self.ring,
                                    uniform_arrival=uniform)

@@ -88,14 +88,14 @@ def quantiles_with_shift(history, shift_ms: float) -> dict:
             continue                            # never-read: no verdict
         if la is not None and not any(ti > la for ti, tc in present):
             continue                            # lost (none here)
-        lat.append(max(0, ((la or known) - known)) / 1e6)
+        lat.append(
+            max(0, ((known if la is None else la) - known)) / 1e6)
     lat.sort()
-    n = len(lat)
-
-    def q(p):
-        return lat[min(n - 1, int(p * n))] if n else None
-    return {"p50": q(.5), "p95": q(.95), "p99": q(.99),
-            "max": lat[-1] if n else None}
+    # the stock checker's quantile indexing, not a reimplementation
+    from .checkers.set_full import quantiles
+    qs = quantiles(lat, qs=(0.5, 0.95, 0.99))
+    return {"p50": qs[0.5], "p95": qs[0.95], "p99": qs[0.99],
+            "max": lat[-1] if lat else None}
 
 
 def main(argv=None):

@@ -121,9 +121,13 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     # unbounded queues would have delivered (net.clj:188-246).
     # Positional-lane programs (raft) keep the overwrite semantics they
     # explicitly tolerate.
-    assert not ecfg.uniform_arrival or cfg.latency_dist == "constant", \
-        "uniform_arrival requires constant latency draws (program opts " \
-        "and NetConfig disagree about the latency distribution)"
+    if ecfg.uniform_arrival and cfg.latency_dist != "constant":
+        # validity-critical: a broken invariant here would silently route
+        # every message to entry-0's arrival cell, so raise (not assert —
+        # asserts vanish under python -O)
+        raise ValueError(
+            "uniform_arrival requires constant latency draws (program "
+            "opts and NetConfig disagree about the latency distribution)")
     ch = static.edge_write(ecfg, ch, edge_out, net.round, lat, deliver_mask)
 
     n_sent = jnp.sum(edge_out.valid.astype(I32))
