@@ -139,12 +139,19 @@ def bench_raft_clusters():
 
     # grading half: real contending client traffic into a sampled subset
     # of the same-size vmapped fleet, every sampled history graded by
-    # the stock WGL linearizability checker
+    # the stock WGL linearizability checker — with a partition nemesis
+    # ACTIVE during the graded window (every cluster gets an independent
+    # majority/minority split, healed before each worker's final read)
     if os.environ.get("BENCH_RAFT_GRADED", "1") == "1":
         from maelstrom_tpu.bench_raft_graded import run_raft_graded
         g = run_raft_graded(
             n_clusters=clusters, n=n,
-            sample=int(os.environ.get("BENCH_RAFT_SAMPLE", 64)),
+            sample=int(os.environ.get("BENCH_RAFT_SAMPLE", 512)),
+            ops_per_client=int(os.environ.get("BENCH_RAFT_OPS", 50)),
+            partition_at=int(os.environ.get("BENCH_RAFT_PART_AT", 20)),
+            partition_chunks=int(
+                os.environ.get("BENCH_RAFT_PART_CHUNKS", 30)),
+            max_chunks=800,
             seed=3)
         record["graded"] = g
         record["sampled_clusters"] = g["sampled_clusters"]

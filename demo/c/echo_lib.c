@@ -1,0 +1,19 @@
+/* Echo on the C node library — the doc/tutorial "hello world" showing
+ * how small a node gets once maelstrom_node.h owns the stdio boundary
+ * (compare echo.c, which hand-rolls the same loop in ~150 lines).
+ *
+ * Build: make -C demo/c    Run: ... test -w echo --bin demo/c/echo_lib
+ */
+
+#include "maelstrom_node.h"
+
+static void on_echo(const mn_msg *m) {
+    const char *e = mn_find(m->body, "echo");
+    mn_reply(m, "{\"type\": \"echo_ok\", \"echo\": %.*s}",
+             e ? (int)mn_value_len(e) : 4, e ? e : "null");
+}
+
+int main(void) {
+    mn_handle("echo", on_echo);
+    return mn_run();
+}

@@ -1,0 +1,132 @@
+/* A grow-only set (g-set workload) on the C node library — the port
+ * that proves maelstrom_node.h's full surface: handler registry for the
+ * client RPCs, an `mn_every` periodic task for anti-entropy, and
+ * `mn_rpc` callbacks for acked replication with retry-on-timeout.
+ *
+ * Protocol served (doc/workloads.md "g-set"):
+ *   add  {"element": e} -> add_ok
+ *   read                -> read_ok {"value": [...]}
+ * Inter-node:
+ *   replicate {"value": [...]} -> replicate_ok
+ *
+ * Replication: every 200 ms each peer that has not acknowledged this
+ * node's current set gets the full set over an RPC; the reply callback
+ * records how much that peer has confirmed, a timeout simply leaves the
+ * peer dirty for the next tick. Unions are idempotent, so loss,
+ * duplication, and partitions only delay convergence — add availability
+ * is total (every node accepts adds), exactly the CRDT story of the
+ * reference's g-set demos.
+ *
+ * Build: make -C demo/c    Run: ... test -w g-set --bin demo/c/gset
+ */
+
+#include <stdio.h>
+#include <string.h>
+
+#include "maelstrom_node.h"
+
+#define MAX_ELEMS 8192
+#define ELEM_LEN 64
+
+static char elems[MAX_ELEMS][ELEM_LEN];    /* raw JSON tokens */
+static int n_elems = 0;
+
+/* acked_upto[i]: how many of our elements peer i has confirmed (our
+ * set only grows and replicate carries a full prefix-closed snapshot,
+ * so a count is a complete acknowledgement state) */
+static int acked_upto[MN_MAX_NODES];
+
+static int find_or_add(const char *tok, size_t n) {
+    if (n == 0 || n >= ELEM_LEN) return -1;
+    for (int i = 0; i < n_elems; i++)
+        if (strlen(elems[i]) == n && strncmp(elems[i], tok, n) == 0)
+            return i;
+    if (n_elems >= MAX_ELEMS) {
+        fprintf(stderr, "gset: element table full\n");
+        return -1;
+    }
+    memcpy(elems[n_elems], tok, n);
+    elems[n_elems][n] = '\0';
+    return n_elems++;
+}
+
+static size_t render_set(char *out, size_t cap, int upto) {
+    size_t w = 0;
+    out[w++] = '[';
+    for (int i = 0; i < upto && w + ELEM_LEN + 4 < cap; i++) {
+        if (i) out[w++] = ',';
+        w += (size_t)snprintf(out + w, cap - w, "%s", elems[i]);
+    }
+    out[w++] = ']';
+    out[w] = '\0';
+    return w;
+}
+
+static void absorb_array(const char *arr) {
+    if (!arr || arr[0] != '[') return;
+    size_t i = 1;
+    while (arr[i] && arr[i] != ']') {
+        if (arr[i] == ' ' || arr[i] == ',' || arr[i] == '\t') {
+            i++;
+            continue;
+        }
+        size_t n = mn_value_len(arr + i);
+        find_or_add(arr + i, n);
+        i += n;
+    }
+}
+
+static void on_add(const mn_msg *m) {
+    const char *e = mn_find(m->body, "element");
+    if (e) find_or_add(e, mn_value_len(e));
+    mn_reply(m, "{\"type\": \"add_ok\"}");
+}
+
+static void on_read(const mn_msg *m) {
+    static char set[MAX_ELEMS * (ELEM_LEN + 1) + 8];
+    render_set(set, sizeof set, n_elems);
+    mn_reply(m, "{\"type\": \"read_ok\", \"value\": %s}", set);
+}
+
+static void on_replicate(const mn_msg *m) {
+    absorb_array(mn_find(m->body, "value"));
+    mn_reply(m, "{\"type\": \"replicate_ok\"}");
+}
+
+/* reply callback: peer `ctx` confirmed the snapshot we sent it. One
+ * RPC in flight per peer (inflight guard): a second overlapping
+ * snapshot could otherwise get acked by the FIRST snapshot's reply,
+ * over-acknowledging elements the peer may never have received. */
+static long sent_upto[MN_MAX_NODES];
+static int inflight[MN_MAX_NODES];
+
+static void on_replicate_ack(const mn_msg *reply, void *ctx) {
+    int peer = (int)(long)ctx;
+    inflight[peer] = 0;
+    if (reply != NULL && sent_upto[peer] > acked_upto[peer])
+        acked_upto[peer] = (int)sent_upto[peer];
+    /* timeout (reply == NULL): leave the peer dirty; the next tick
+     * retransmits the then-current snapshot */
+}
+
+static void anti_entropy(void) {
+    static char set[MAX_ELEMS * (ELEM_LEN + 1) + 8];
+    for (int i = 0; i < mn_n_nodes(); i++) {
+        const char *peer = mn_node_name(i);
+        if (strcmp(peer, mn_node_id()) == 0) continue;
+        if (inflight[i] || acked_upto[i] >= n_elems) continue;
+        render_set(set, sizeof set, n_elems);
+        sent_upto[i] = n_elems;
+        inflight[i] = 1;
+        mn_rpc(peer, on_replicate_ack, (void *)(long)i, 1000,
+               "{\"type\": \"replicate\", \"value\": %s}", set);
+    }
+}
+
+int main(void) {
+    mn_handle("add", on_add);
+    mn_handle("read", on_read);
+    mn_handle("replicate", on_replicate);
+    mn_every(200, anti_entropy);
+    return mn_run();
+}

@@ -1,0 +1,327 @@
+/* maelstrom_node.c — implementation of the reusable C node library.
+ * See maelstrom_node.h for the API story. Single-threaded: one poll(2)
+ * loop interleaves stdin lines with timer firings, so handlers and
+ * periodic tasks never race (the same sequential-node model as the
+ * reference's demo libraries). */
+
+#define _POSIX_C_SOURCE 200809L   /* clock_gettime under -std=c99 */
+
+#include "maelstrom_node.h"
+
+#include <poll.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+/* --- JSON scanning (string-aware, allocation-free) --- */
+
+static size_t skip_string(const char *s, size_t i) {
+    i++;
+    while (s[i]) {
+        if (s[i] == '\\' && s[i + 1]) i += 2;
+        else if (s[i] == '"') return i + 1;
+        else i++;
+    }
+    return i;
+}
+
+const char *mn_find(const char *s, const char *key) {
+    size_t klen = strlen(key);
+    size_t i = 0;
+    while (s[i]) {
+        if (s[i] == '"') {
+            size_t start = i;
+            i = skip_string(s, i);
+            if (i - start - 2 == klen &&
+                strncmp(s + start + 1, key, klen) == 0) {
+                while (s[i] == ' ' || s[i] == '\t') i++;
+                if (s[i] == ':') {
+                    i++;
+                    while (s[i] == ' ' || s[i] == '\t') i++;
+                    return s + i;
+                }
+            }
+        } else {
+            i++;
+        }
+    }
+    return NULL;
+}
+
+size_t mn_value_len(const char *v) {
+    if (v[0] == '"') return skip_string(v, 0);
+    if (v[0] == '{' || v[0] == '[') {
+        char open = v[0], close = (open == '{') ? '}' : ']';
+        int depth = 0;
+        size_t i = 0;
+        while (v[i]) {
+            if (v[i] == '"') { i = skip_string(v, i); continue; }
+            if (v[i] == open) depth++;
+            else if (v[i] == close && --depth == 0) return i + 1;
+            i++;
+        }
+        return i;
+    }
+    size_t i = 0;
+    while (v[i] && !strchr(",}] \t\n", v[i])) i++;
+    return i;
+}
+
+void mn_copy_str(const char *v, char *out, size_t cap) {
+    out[0] = '\0';
+    if (v && v[0] == '"') {
+        size_t n = mn_value_len(v);
+        if (n >= 2 && n - 2 < cap) {
+            memcpy(out, v + 1, n - 2);
+            out[n - 2] = '\0';
+        }
+    }
+}
+
+/* --- identity --- */
+
+static char g_node_id[MN_ID_LEN] = "";
+static char g_nodes[MN_MAX_NODES][MN_ID_LEN];
+static int g_n_nodes = 0;
+static void (*g_init_hook)(void) = NULL;
+
+const char *mn_node_id(void) { return g_node_id; }
+int mn_n_nodes(void) { return g_n_nodes; }
+const char *mn_node_name(int i) { return g_nodes[i]; }
+void mn_on_init(void (*fn)(void)) { g_init_hook = fn; }
+
+/* --- handler registry --- */
+
+#define MN_MAX_HANDLERS 32
+static struct { char type[48]; void (*fn)(const mn_msg *); }
+    g_handlers[MN_MAX_HANDLERS];
+static int g_n_handlers = 0;
+
+void mn_handle(const char *type, void (*h)(const mn_msg *m)) {
+    if (g_n_handlers >= MN_MAX_HANDLERS) {
+        fprintf(stderr, "mn: handler table full\n");
+        exit(1);
+    }
+    snprintf(g_handlers[g_n_handlers].type,
+             sizeof g_handlers[g_n_handlers].type, "%s", type);
+    g_handlers[g_n_handlers].fn = h;
+    g_n_handlers++;
+}
+
+/* --- sending --- */
+
+static long g_next_id = 0;
+
+static long send_body(const char *dest, long in_reply_to,
+                      const char *fmt, va_list ap) {
+    char body[65536];
+    vsnprintf(body, sizeof body, fmt, ap);
+    size_t blen = strlen(body);
+    if (blen < 2 || body[0] != '{' || body[blen - 1] != '}') {
+        fprintf(stderr, "mn: body must be a JSON object: %s\n", body);
+        exit(1);
+    }
+    long mid = ++g_next_id;
+    body[blen - 1] = '\0';            /* strip '}' to splice ids */
+    printf("{\"src\": \"%s\", \"dest\": \"%s\", \"body\": %s%s"
+           "\"msg_id\": %ld",
+           g_node_id, dest, body, blen > 2 ? ", " : "", mid);
+    if (in_reply_to >= 0) printf(", \"in_reply_to\": %ld", in_reply_to);
+    printf("}}\n");
+    fflush(stdout);
+    return mid;
+}
+
+long mn_send(const char *dest, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    long mid = send_body(dest, -1, fmt, ap);
+    va_end(ap);
+    return mid;
+}
+
+long mn_reply(const mn_msg *m, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    long mid = send_body(m->src, m->msg_id, fmt, ap);
+    va_end(ap);
+    return mid;
+}
+
+/* --- RPC table --- */
+
+#define MN_MAX_RPC 4096
+static struct {
+    long mid;                  /* full id; 0 = free slot */
+    long deadline_ms;          /* monotonic ms, or 0 = no timeout */
+    void (*cb)(const mn_msg *, void *);
+    void *ctx;
+} g_rpc[MN_MAX_RPC];
+
+static long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000L + ts.tv_nsec / 1000000L;
+}
+
+long mn_rpc(const char *dest, void (*cb)(const mn_msg *reply, void *ctx),
+            void *ctx, long timeout_ms, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    long mid = send_body(dest, -1, fmt, ap);
+    va_end(ap);
+    int slot = (int)(mid % MN_MAX_RPC);
+    if (g_rpc[slot].mid != 0) {
+        /* recycled before completion: fire its timeout now so no
+         * callback is ever silently dropped */
+        void (*old)(const mn_msg *, void *) = g_rpc[slot].cb;
+        void *octx = g_rpc[slot].ctx;
+        g_rpc[slot].mid = 0;
+        if (old) old(NULL, octx);
+    }
+    g_rpc[slot].mid = mid;
+    g_rpc[slot].deadline_ms = timeout_ms > 0 ? now_ms() + timeout_ms : 0;
+    g_rpc[slot].cb = cb;
+    g_rpc[slot].ctx = ctx;
+    return mid;
+}
+
+static void rpc_tick(long t) {
+    for (int i = 0; i < MN_MAX_RPC; i++) {
+        if (g_rpc[i].mid != 0 && g_rpc[i].deadline_ms != 0 &&
+            t >= g_rpc[i].deadline_ms) {
+            void (*cb)(const mn_msg *, void *) = g_rpc[i].cb;
+            void *ctx = g_rpc[i].ctx;
+            g_rpc[i].mid = 0;
+            if (cb) cb(NULL, ctx);
+        }
+    }
+}
+
+/* --- timers --- */
+
+#define MN_MAX_TIMERS 16
+static struct { long interval_ms; long due_ms; void (*fn)(void); }
+    g_timers[MN_MAX_TIMERS];
+static int g_n_timers = 0;
+
+void mn_every(long interval_ms, void (*fn)(void)) {
+    if (g_n_timers >= MN_MAX_TIMERS) {
+        fprintf(stderr, "mn: timer table full\n");
+        exit(1);
+    }
+    g_timers[g_n_timers].interval_ms = interval_ms;
+    g_timers[g_n_timers].due_ms = now_ms() + interval_ms;
+    g_timers[g_n_timers].fn = fn;
+    g_n_timers++;
+}
+
+/* --- dispatch --- */
+
+static void handle_init(const mn_msg *m) {
+    mn_copy_str(mn_find(m->line, "node_id"), g_node_id,
+                sizeof g_node_id);
+    const char *ids = mn_find(m->line, "node_ids");
+    g_n_nodes = 0;
+    if (ids && ids[0] == '[') {
+        size_t i = 1;
+        while (ids[i] && ids[i] != ']') {
+            if (ids[i] == '"') {
+                size_t n = mn_value_len(ids + i);
+                if (g_n_nodes < MN_MAX_NODES)
+                    mn_copy_str(ids + i, g_nodes[g_n_nodes++],
+                                MN_ID_LEN);
+                i += n;
+            } else {
+                i++;
+            }
+        }
+    }
+    mn_reply(m, "{\"type\": \"init_ok\"}");
+    if (g_init_hook) g_init_hook();
+}
+
+static void dispatch(const char *line) {
+    mn_msg m;
+    m.line = line;
+    m.body = mn_find(line, "body");
+    if (!m.body) return;
+    mn_copy_str(mn_find(line, "src"), m.src, sizeof m.src);
+    const char *t = mn_find(m.body, "type");
+    mn_copy_str(t, m.type, sizeof m.type);
+    const char *mid_v = mn_find(m.body, "msg_id");
+    const char *irt_v = mn_find(m.body, "in_reply_to");
+    m.msg_id = mid_v ? strtol(mid_v, NULL, 10) : -1;
+    m.in_reply_to = irt_v ? strtol(irt_v, NULL, 10) : -1;
+
+    if (m.in_reply_to >= 0) {
+        int slot = (int)(m.in_reply_to % MN_MAX_RPC);
+        if (g_rpc[slot].mid == m.in_reply_to) {   /* full-id check */
+            void (*cb)(const mn_msg *, void *) = g_rpc[slot].cb;
+            void *ctx = g_rpc[slot].ctx;
+            g_rpc[slot].mid = 0;
+            if (cb) cb(&m, ctx);
+        }
+        return;                                   /* late reply: drop */
+    }
+    if (strcmp(m.type, "init") == 0) {
+        handle_init(&m);
+        return;
+    }
+    for (int i = 0; i < g_n_handlers; i++) {
+        if (strcmp(g_handlers[i].type, m.type) == 0) {
+            g_handlers[i].fn(&m);
+            return;
+        }
+    }
+    mn_reply(&m, "{\"type\": \"error\", \"code\": 10, "
+                 "\"text\": \"unsupported: %s\"}", m.type);
+}
+
+/* --- event loop --- */
+
+int mn_run(void) {
+    static char buf[1 << 20];
+    size_t len = 0;
+    struct pollfd pfd = { .fd = STDIN_FILENO, .events = POLLIN };
+    for (;;) {
+        long t = now_ms();
+        rpc_tick(t);
+        long wait = 1000;
+        for (int i = 0; i < g_n_timers; i++) {
+            if (g_timers[i].due_ms <= t) {
+                g_timers[i].due_ms = t + g_timers[i].interval_ms;
+                g_timers[i].fn();
+            }
+            long d = g_timers[i].due_ms - t;
+            if (d < wait) wait = d;
+        }
+        for (int i = 0; i < MN_MAX_RPC; i++) {
+            if (g_rpc[i].mid != 0 && g_rpc[i].deadline_ms != 0) {
+                long d = g_rpc[i].deadline_ms - t;
+                if (d < wait) wait = d;
+            }
+        }
+        if (wait < 0) wait = 0;
+        int r = poll(&pfd, 1, (int)wait);
+        if (r <= 0) continue;
+        if (pfd.revents & (POLLHUP | POLLERR) && !(pfd.revents & POLLIN))
+            return 0;
+        ssize_t n = read(STDIN_FILENO, buf + len, sizeof buf - len - 1);
+        if (n <= 0) return 0;                     /* EOF: clean exit */
+        len += (size_t)n;
+        buf[len] = '\0';
+        char *start = buf;
+        char *nl;
+        while ((nl = strchr(start, '\n')) != NULL) {
+            *nl = '\0';
+            if (nl > start) dispatch(start);
+            start = nl + 1;
+        }
+        len = (size_t)(buf + len - start);
+        memmove(buf, start, len);
+    }
+}

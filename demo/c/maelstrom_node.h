@@ -1,0 +1,95 @@
+/* maelstrom_node.h — a reusable Maelstrom node library for C.
+ *
+ * The second *library* (not just node) language surface: the feature set
+ * of the reference's demo/ruby/node.rb — a handler registry, periodic
+ * tasks, and asynchronous RPC with per-request callbacks and timeouts —
+ * rebuilt C-idiomatically on a poll(2) event loop, written against
+ * doc/protocol.md alone. Demos link one .c file and register handlers:
+ *
+ *     #include "maelstrom_node.h"
+ *     static void on_echo(const mn_msg *m) {
+ *         const char *e = mn_find(m->body, "echo");
+ *         mn_reply(m, "{\"type\": \"echo_ok\", \"echo\": %.*s}",
+ *                  (int)mn_value_len(e), e);
+ *     }
+ *     int main(void) {
+ *         mn_handle("echo", on_echo);
+ *         return mn_run();
+ *     }
+ *
+ * The library owns the stdio boundary: it parses each incoming line's
+ * envelope (src, type, msg_id, in_reply_to), answers `init` itself
+ * (recording node_id and the peer list), routes replies to their RPC
+ * callbacks, stamps outgoing msg_ids, and drives `mn_every` timers from
+ * the poll timeout. Handlers receive the raw line plus a pointer to the
+ * body object and use mn_find/mn_value_len/mn_copy_str to pull fields —
+ * values can be spliced verbatim into replies, so arbitrary scalar JSON
+ * round-trips without a JSON library.
+ */
+
+#ifndef MAELSTROM_NODE_H
+#define MAELSTROM_NODE_H
+
+#include <stddef.h>
+
+#define MN_ID_LEN 64
+#define MN_MAX_NODES 128
+
+typedef struct mn_msg {
+    const char *line;    /* whole raw message line */
+    const char *body;    /* pointer to the body object within line */
+    char src[MN_ID_LEN];
+    char type[48];
+    long msg_id;         /* body msg_id, or -1 */
+    long in_reply_to;    /* body in_reply_to, or -1 */
+} mn_msg;
+
+/* --- JSON field access (string-aware scanner, no allocation) --- */
+
+/* Pointer to the value of `key` anywhere in `s`, or NULL. */
+const char *mn_find(const char *s, const char *key);
+/* Token length of the value at `v` (string/object/array/scalar). */
+size_t mn_value_len(const char *v);
+/* Copy a JSON string value (sans quotes) into out; "" when absent. */
+void mn_copy_str(const char *v, char *out, size_t cap);
+
+/* --- identity (valid after init; mn_run handles init itself) --- */
+
+const char *mn_node_id(void);
+int mn_n_nodes(void);
+const char *mn_node_name(int i);          /* all nodes, including self */
+
+/* Optional hook invoked once after init_ok is sent. */
+void mn_on_init(void (*fn)(void));
+
+/* --- handlers --- */
+
+/* Register `h` for body type `type` (non-reply messages). */
+void mn_handle(const char *type, void (*h)(const mn_msg *m));
+
+/* --- sending --- */
+
+/* Send a body (printf-style; the body must be a JSON object literal —
+ * the library splices a fresh msg_id into it). Returns the msg_id. */
+long mn_send(const char *dest, const char *fmt, ...);
+/* Reply to `m`: splices msg_id AND in_reply_to. */
+long mn_reply(const mn_msg *m, const char *fmt, ...);
+
+/* Async RPC: send a body and register a callback for its reply. The
+ * callback fires once — with the reply, or with reply == NULL when
+ * timeout_ms elapses first (retry by issuing a fresh mn_rpc). A late
+ * reply after the timeout is dropped (the slot remembers its full
+ * msg_id, so a recycled slot can never mis-ack). */
+long mn_rpc(const char *dest, void (*cb)(const mn_msg *reply, void *ctx),
+            void *ctx, long timeout_ms, const char *fmt, ...);
+
+/* --- periodic tasks --- */
+
+/* Run `fn` every interval_ms (first firing after one interval). */
+void mn_every(long interval_ms, void (*fn)(void));
+
+/* --- event loop: poll stdin + timers; returns on EOF --- */
+
+int mn_run(void);
+
+#endif /* MAELSTROM_NODE_H */

@@ -5,6 +5,7 @@ so values survive partitions (counterpart of demo/ruby/broadcast.rb)."""
 import os
 import sys
 import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from node import Node
@@ -14,6 +15,10 @@ lock = threading.Lock()
 messages = set()
 neighbors = []
 unacked = {}        # neighbor -> set of values not yet acknowledged
+
+# BCAST_STAMP=1: log the monotonic instant this node first held each
+# value (ack-stamp lag measurement, maelstrom_tpu.parity_ackstamp)
+STAMP = bool(os.environ.get("BCAST_STAMP"))
 
 
 @node.on("topology")
@@ -35,6 +40,8 @@ def accept(value, sender=None):
         for n in neighbors:
             if n != sender:
                 unacked[n].add(value)
+    if STAMP:
+        node.log(f"HADVAL {value} {time.monotonic_ns()}")
 
 
 @node.on("broadcast")

@@ -5,7 +5,7 @@ clusters"; the throughput bench (`bench.py --raft`) measures
 cluster-rounds/s and leader uniqueness, but lin-kv is the one workload
 where *grading* is the whole point (reference
 `workload/lin_kv.clj:95-102`). This module drives a sampled subset of
-the vmapped clusters with real client traffic — two concurrent client
+the vmapped clusters with real client traffic — concurrent client
 workers per sampled cluster issuing read/write/cas on a shared key
 through the protocol (leader proxying included) — synthesizes one
 operation history per cluster from the actual reply stream, and grades
@@ -16,9 +16,23 @@ All `n_clusters` clusters advance in the same vmapped dispatches (the
 benchmark's scaling claim); only the sampled ones receive traffic. The
 reply path is exact: client messages are collected per round inside the
 scan, sliced to the sampled clusters on device, and paired to their
-requests by (cluster, client-src) — each worker keeps at most one op in
-flight, and a worker whose reply never arrives records an indeterminate
-(`info`) op, which the checker treats as may-or-may-not-have-happened.
+requests **by message id** — the scan also emits each sampled cluster's
+`next_mid` after every round, so the device-assigned id of every
+injected request is reconstructed exactly (mid = next_mid before its
+round + its rank among that round's injections; `net/tpu.py _send`).
+A reply whose id matches no in-flight op must match a timed-out one
+(the op was already graded indeterminate — `info` means exactly "may
+have committed"; the late ack is dropped); anything else is an error.
+
+A partition nemesis can run *during* the graded window
+(`partition_at`/`partition_chunks`): every cluster gets an independent
+majority/minority split (component labels, `net/tpu.py
+partition_components` semantics — clients exempt), healed before the
+end of the run; each worker holds back its final read until after the
+heal, so the tail of every history exercises recovery. Ops that die in
+the minority side surface as indeterminates, which WGL treats as
+may-or-may-not-have-happened — the reference's flagship lin-kv +
+partitions test (`workload/lin_kv.clj` + jepsen nemesis).
 
 Used by bench.py (BENCH_MODE=raft) and unit-tested at small scale on
 CPU (tests/test_bench_raft_graded.py).
@@ -32,7 +46,9 @@ import time
 def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                     ops_per_client: int = 12, clients: int = 2,
                     chunk: int = 10, seed: int = 0, warmup_chunks: int = 8,
-                    max_chunks: int = 400, verbose: bool = True) -> dict:
+                    max_chunks: int = 400, partition_at: int | None = None,
+                    partition_chunks: int = 0,
+                    verbose: bool = True) -> dict:
     import sys
 
     import jax
@@ -61,17 +77,31 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     def scan_chunk(sims, small_plan):
         """chunk rounds in one dispatch; injections only into the
         sampled clusters (scattered on device — the host ships
-        [chunk, S, M], not [chunk, n_clusters, M]); client replies
-        sliced to the sampled clusters before leaving the device."""
+        [chunk, S, M], not [chunk, n_clusters, M]); client replies and
+        the post-round next_mid of the sampled clusters leave the
+        device per round (next_mid drives exact reply pairing)."""
         def body(s, small_round):
             full = T.Msgs.empty((n_clusters, M))
             full = jax.tree.map(
                 lambda f, sm: f.at[sampled_d].set(sm), full, small_round)
             s, cm, _io = round_fn(s, full)
-            return s, jax.tree.map(lambda f: f[sampled_d], cm)
+            return s, (jax.tree.map(lambda f: f[sampled_d], cm),
+                       s.net.next_mid[sampled_d])
         return jax.lax.scan(body, sims, small_plan)
 
     scan_chunk = jax.jit(scan_chunk)
+
+    minority = n // 2
+
+    def set_partition(sims, comp):
+        """Install per-cluster component labels [n_clusters, n] (clients
+        exempt: their labels stay 0, and the pool path never blocks
+        client messages)."""
+        net = sims.net
+        return sims.replace(net=net.replace(
+            component=net.component.at[:, :n].set(comp)))
+
+    set_partition = jax.jit(set_partition)
 
     sims = make_cluster_sims(program, cfg, n_clusters, seed=seed)
     empty_plan = T.Msgs.empty((chunk, S, M))
@@ -81,23 +111,26 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     leader_fn = jax.jit(
         lambda s: ((s.nodes["role"][sampled_d] == 2).sum(axis=1)))
     for _ in range(warmup_chunks):
-        sims, _cm = scan_chunk(sims, empty_plan)
+        sims, _out = scan_chunk(sims, empty_plan)
     leaders = np.asarray(jax.device_get(leader_fn(sims)))
     if not (leaders == 1).all():
         raise RuntimeError(
             f"{int((leaders != 1).sum())}/{S} sampled clusters lack a "
             f"unique leader after warmup")
+    nm_prev = np.asarray(jax.device_get(
+        jax.jit(lambda s: s.net.next_mid[sampled_d])(sims)))   # [S]
 
     # --- client traffic: per (sampled cluster, worker) op scripts on a
     # shared register (key = cluster index % 8) — writes, reads, and
-    # cas chains that genuinely contend across the two workers ---
+    # cas chains that genuinely contend across the workers; the LAST op
+    # of every script is a read, held back until any partition heals ---
     rng = np.random.default_rng(seed + 7)
     key_of = {s: int(s % 8) for s in range(S)}
 
     def script(s, w):
         k = key_of[s]
         ops = [("write", k, int(rng.integers(0, 100)), 0)]
-        for _ in range(ops_per_client - 1):
+        for _ in range(ops_per_client - 2):
             r = rng.random()
             if r < 0.4:
                 ops.append(("read", k, 0, 0))
@@ -106,12 +139,13 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
             else:
                 ops.append(("cas", k, int(rng.integers(0, 100)),
                             int(rng.integers(0, 100))))
+        ops.append(("read", k, 0, 0))            # final read, post-heal
         return ops
 
     scripts = {(s, w): script(s, w) for s in range(S)
                for w in range(clients)}
     cursor = {sw: 0 for sw in scripts}           # next op index
-    in_flight = {}                               # (s, w) -> (op, proc, rnd)
+    in_flight = {}            # (s, w) -> (op, proc, rnd, mid-or-None)
     histories = {s: [] for s in range(S)}        # per-cluster Op lists
     n_procs = 0
     round_base = warmup_chunks * chunk
@@ -121,7 +155,7 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     OK_OF = {T_READ_OK: "read", T_WRITE_OK: "write", T_CAS_OK: "cas"}
 
     def complete(s, w, typ, a, at_round):
-        op, proc, _rnd = in_flight.pop((s, w))
+        op, proc, _rnd, _mid = in_flight.pop((s, w))
         f, k, v1, v2 = op
         if typ == 1:                              # definite error (20/22)
             histories[s].append(Op(type="fail", f=f, process=proc,
@@ -142,9 +176,38 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
             return [k, v1]
         return [k, [v1, v2]]
 
-    timed_out = {}                # (s, w) -> True after an SLA expiry
+    p0 = partition_at if partition_chunks else None
+    p1 = (p0 + partition_chunks) if p0 is not None else None
+    if p0 is not None and p1 >= max_chunks - 4:
+        raise ValueError("partition window must heal well before "
+                         "max_chunks so final reads can complete")
+    partition_active = False
+
+    # (cluster, mid) of ops graded indeterminate: next_mid is a
+    # PER-CLUSTER counter, so bare mids collide across sampled clusters
+    timed_out_mids = set()
     chunks_run = 0
     while chunks_run < max_chunks:
+        # --- nemesis schedule (host-side state surgery, like the
+        # reference's nemesis thread; component semantics net.clj:104+) ---
+        if p0 is not None and chunks_run == p0:
+            prng = np.random.default_rng(seed + 31)
+            order = prng.random((n_clusters, n)).argsort(axis=1)
+            splits = (order < minority).astype(np.int32)
+            sims = set_partition(sims, jnp.asarray(splits))
+            partition_active = True
+            if verbose:
+                print(f"raft-graded: partition installed at round "
+                      f"{round_base} (minority {minority}/{n}, every "
+                      f"cluster)", file=sys.stderr)
+        if p1 is not None and chunks_run == p1:
+            sims = set_partition(
+                sims, jnp.zeros((n_clusters, n), jnp.int32))
+            partition_active = False
+            if verbose:
+                print(f"raft-graded: partition healed at round "
+                      f"{round_base}", file=sys.stderr)
+
         plan_valid = np.zeros((chunk, S, M), bool)
         plan_dest = np.zeros((chunk, S, M), np.int32)
         plan_type = np.zeros((chunk, S, M), np.int32)
@@ -152,9 +215,14 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
         plan_b = np.zeros((chunk, S, M), np.int32)
         plan_c = np.zeros((chunk, S, M), np.int32)
         plan_src = np.full((chunk, S, M), n, np.int32)
+        injected = {}               # (s, rr) -> [(w, proc), ...] in order
         for (s, w), idx in list(cursor.items()):
             if (s, w) in in_flight or idx >= len(scripts[(s, w)]):
                 continue
+            if (idx == len(scripts[(s, w)]) - 1
+                    and (partition_active
+                         or (p1 is not None and chunks_run < p1))):
+                continue          # final read waits for the heal
             f, k, v1, v2 = scripts[(s, w)][idx]
             # stagger workers across rounds and nodes: a non-leader
             # proxies at most ONE client request per round, so two
@@ -175,38 +243,55 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                 type="invoke", f=f, process=proc,
                 value=_val(f, k, v1, v2, None),
                 time=int((round_base + rr) * 1e6)))
-            in_flight[(s, w)] = ((f, k, v1, v2), proc, round_base + rr)
+            in_flight[(s, w)] = ((f, k, v1, v2), proc,
+                                 round_base + rr, None)
+            injected.setdefault((s, rr), []).append(w)
             cursor[(s, w)] = idx + 1
         plan = T.Msgs.empty((chunk, S, M)).replace(
             valid=jnp.asarray(plan_valid), src=jnp.asarray(plan_src),
             dest=jnp.asarray(plan_dest), type=jnp.asarray(plan_type),
             a=jnp.asarray(plan_a), b=jnp.asarray(plan_b),
             c=jnp.asarray(plan_c))
-        sims, cm = scan_chunk(sims, plan)
-        cm = jax.device_get(cm)
+        sims, (cm, nms) = scan_chunk(sims, plan)
+        cm, nms = jax.device_get((cm, nms))
         valid = np.asarray(cm.valid)              # [chunk, S, CC]
         types = np.asarray(cm.type)
         dests = np.asarray(cm.dest)
         avals = np.asarray(cm.a)
+        rtos = np.asarray(cm.reply_to)
+        nms = np.asarray(nms)                     # [chunk, S]
         for i in range(chunk):
+            # device mids of this round's injections: next_mid before
+            # the round + rank in worker order (= plan row order)
+            nm_before = nm_prev if i == 0 else nms[i - 1]
+            for (s, rr), ws in injected.items():
+                if rr != i:
+                    continue
+                for rank, w in enumerate(ws):
+                    op, proc, rnd, _ = in_flight[(s, w)]
+                    in_flight[(s, w)] = (op, proc, rnd,
+                                         int(nm_before[s]) + rank)
             for s, j in zip(*np.nonzero(valid[i])):
                 w = int(dests[i, s, j]) - n
-                if (s, w) not in in_flight:
-                    # a reply landing after its op's SLA window: the op
-                    # was already graded indeterminate (it may indeed
-                    # have committed — exactly what `info` means), so
-                    # the late ack is dropped, once, not fatal
-                    if timed_out.pop((int(s), w), None):
-                        continue
+                rto = int(rtos[i, s, j])
+                cur = in_flight.get((int(s), w))
+                if cur is not None and cur[3] == rto:
+                    complete(int(s), w, int(types[i, s, j]),
+                             int(avals[i, s, j]), round_base + i)
+                elif (int(s), rto) in timed_out_mids:
+                    # late ack for an op already graded indeterminate:
+                    # `info` means exactly "may have committed" — drop
+                    timed_out_mids.discard((int(s), rto))
+                else:
                     raise RuntimeError(
-                        f"reply for idle worker c{s}/w{w}")
-                complete(int(s), w, int(types[i, s, j]),
-                         int(avals[i, s, j]), round_base + i)
+                        f"unmatched reply mid {rto} for c{s}/w{w}")
+        nm_prev = nms[-1]
         round_base += chunk
         chunks_run += 1
         # reply SLA: an op outstanding past the window becomes info
-        # (indeterminate: it may still commit later; WGL handles it)
-        for sw, (op, proc, rnd) in list(in_flight.items()):
+        # (indeterminate: it may still commit later; WGL handles it) and
+        # the worker moves on — its ops keep flowing through partitions
+        for sw, (op, proc, rnd, mid) in list(in_flight.items()):
             if round_base - rnd > pending_rounds:
                 s, w = sw
                 f, k, v1, v2 = op
@@ -214,11 +299,16 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                                        value=_val(f, k, v1, v2, None),
                                        time=int(round_base * 1e6)))
                 del in_flight[sw]
-                timed_out[sw] = True
-                cursor[sw] = len(scripts[sw])     # stop this worker
+                if mid is not None:
+                    timed_out_mids.add((s, mid))
         if not in_flight and all(cursor[sw] >= len(scripts[sw])
                                  for sw in scripts):
             break
+
+    if in_flight or any(cursor[sw] < len(scripts[sw]) for sw in scripts):
+        raise RuntimeError(
+            f"graded run hit max_chunks={max_chunks} with "
+            f"{len(in_flight)} ops in flight and unfinished scripts")
 
     if verbose:
         print(f"raft-graded: {S} clusters x {clients} workers x "
@@ -240,7 +330,7 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     ok_count = sum(1 for v in results if v is True)
     info_ops = sum(1 for s in range(S) for o in histories[s]
                    if o.type == "info")
-    return {
+    out = {
         "sampled_clusters": S,
         "clusters_total": n_clusters,
         "workers_per_cluster": clients,
@@ -251,3 +341,11 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
         "rounds": round_base,
         "wall_s": round(time.perf_counter() - t0, 3),
     }
+    if p0 is not None:
+        out["partition"] = {
+            "from_round": warmup_chunks * chunk + p0 * chunk,
+            "rounds": partition_chunks * chunk,
+            "minority_size": minority,
+            "clusters_partitioned": n_clusters,
+        }
+    return out

@@ -45,60 +45,79 @@ def _apply(f, value, ok: bool, state):
     raise ValueError(f"unknown register op {f!r}")
 
 
-def check_register_history(ops, max_states: int = 2_000_000):
+def check_register_history(ops, max_states: int = 5_000_000):
     """ops: [{f, value, inv, ret, ok}] with ret=INF for indeterminate ops.
-    Returns {"valid": bool|"unknown", ...}."""
+    Returns {"valid": bool|"unknown", ...}.
+
+    Just-in-time linearization (Lowe's WGL refinement, the Knossos-scale
+    optimization): a configuration is (i, extra, state) where `i` is the
+    invocation-order frontier (every op before it linearized) and
+    `extra` the small set of ops linearized ahead of the frontier. The
+    naive bitmask form keys its memo on an n-bit mask and scans all n
+    ops per expansion — at n=600 it was already at its practical limit;
+    this form's memo key and candidate scan are O(concurrent window)
+    (bounded by worker count + open indeterminate ops), so histories of
+    many thousands of ops check definitively in seconds."""
     n = len(ops)
     if n == 0:
         return {"valid": True}
-    if n > 600:
-        return {"valid": "unknown",
-                "error": f"history too long for WGL search ({n} ops)"}
-    full = (1 << n) - 1
-    seen = set()
-    order = sorted(range(n), key=lambda j: ops[j]["inv"])
+    order = sorted(range(n), key=lambda j: (ops[j]["inv"], ops[j]["ret"]))
+    ops = [ops[j] for j in order]
+    inv = [o["inv"] for o in ops]
+    ret = [o["ret"] for o in ops]
 
-    # Iterative DFS: stack of (mask, state, iterator position)
-    def candidates(mask):
-        min_ret = INF
-        for k in range(n):
-            if not mask & (1 << k):
-                r = ops[k]["ret"]
-                if r < min_ret:
-                    min_ret = r
+    def norm(i, extra):
+        while i < n and i in extra:
+            extra = extra - frozenset((i,))
+            i += 1
+        return i, extra
+
+    def candidates(i, extra):
+        """Ops that may linearize next: scan forward from the frontier;
+        op j is eligible unless some still-unlinearized op completed
+        before j's invocation. Ops are invocation-sorted, so the running
+        min-return gate is exact and the scan stops at the first op
+        invoked after it (every later op is invoked later still)."""
         out = []
-        for j in order:
-            if mask & (1 << j):
+        m = INF
+        j = i
+        while j < n:
+            if j in extra:
+                j += 1
                 continue
-            if ops[j]["inv"] > min_ret:
+            if inv[j] > m:
                 break
             out.append(j)
+            if ret[j] < m:
+                m = ret[j]
+            j += 1
         return out
 
-    stack = [(0, None, None)]
+    seen = set()
+    stack = [((0, frozenset(), None), None)]
     while stack:
-        mask, state, it = stack.pop()
+        (i, extra, state), it = stack.pop()
         if it is None:
-            if mask == full:
+            if i == n:
                 return {"valid": True}
-            key = (mask, state)
+            key = (i, extra, state)
             if key in seen:
                 continue
             seen.add(key)
             if len(seen) > max_states:
                 return {"valid": "unknown",
-                        "error": "WGL state cap exceeded"}
-            it = iter([(j, s2) for j in candidates(mask)
+                        "error": "WGL configuration cap exceeded"}
+            it = iter([(j, s2) for j in candidates(i, extra)
                        for s2 in _apply(ops[j]["f"], ops[j]["value"],
                                         ops[j]["ok"], state)])
         nxt = next(it, None)
         if nxt is None:
             continue
         j, s2 = nxt
-        stack.append((mask, state, it))
-        stack.append((mask | (1 << j), s2, None))
+        stack.append(((i, extra, state), it))
+        stack.append((norm(i, extra | frozenset((j,))) + (s2,), None))
     return {"valid": False,
-            "explored-states": len(seen),
+            "explored-configurations": len(seen),
             "op-count": n}
 
 

@@ -168,8 +168,11 @@ def opts_from_args(args) -> dict:
 DEMOS = [
     {"workload": "echo", "bin": "demo/python/echo.py"},
     {"workload": "echo", "bin": "demo/python/echo_full.py"},
-    # compiled C node (make -C demo/c); skipped when not built
+    # compiled C nodes (make -C demo/c); skipped when not built
     {"workload": "echo", "bin": "demo/c/echo"},
+    # nodes on the reusable C library (demo/c/maelstrom_node.h)
+    {"workload": "echo", "bin": "demo/c/echo_lib"},
+    {"workload": "g-set", "bin": "demo/c/gset"},
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
     {"workload": "g-counter", "bin": "demo/python/g_counter.py"},

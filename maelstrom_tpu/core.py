@@ -163,7 +163,11 @@ def _run(test: dict, net: HostNet, test_dir: str) -> dict:
         results["valid"] = False
     store.write_history(test_dir, history)
     store.write_results(test_dir, results)
-    store.write_test(test_dir, {k: test[k] for k in DEFAULTS if k in test})
+    # t0 lets offline analyses (parity_ackstamp) align node-process
+    # monotonic stamps with the history's relative-ns timeline
+    store.write_test(test_dir,
+                     {**{k: test[k] for k in DEFAULTS if k in test},
+                      "t0_monotonic_ns": net.t0})
     store.mark_complete(test_dir)
     log.info("Results valid? %s (store: %s)", results["valid"], test_dir)
     return results

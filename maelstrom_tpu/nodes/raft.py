@@ -68,6 +68,9 @@ class RaftProgram(NodeProgram):
     needs_state_reads = False
     is_edge = True
     tolerates_channel_overwrites = True   # AE windows resend every round
+    # trace-time phase ablation for in-context profiling ONLY
+    # (maelstrom_tpu.profile_raft); production paths never set it
+    ablate: frozenset = frozenset()
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
@@ -160,11 +163,21 @@ class RaftProgram(NodeProgram):
         return b >> 16, (b >> 8) & 0xFF, b & 0xFF       # client, v1, v2
 
     def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        # The round is kernel-count-bound, not bandwidth-bound (the whole
+        # 10k x 5 x 256 log is ~50 MB): every phase below is a single
+        # batched gather/scatter over a stacked [N, C, 3] log instead of
+        # an unrolled Python loop of one-hot [N, C] masked writes — the
+        # unrolled form traced to ~2,800 jaxpr eqns with 121 fusion-
+        # breaking gather/scatters and ran 60x slower per node than the
+        # broadcast round (doc/performance.md methodology).
         N, D, C, E = self.n_nodes, self.D, self.cap, self.E
         nb, rnd = self.neighbors, ctx["round"]
         edge_ok = nb >= 0
         s = dict(state)
-        cap_i = jnp.arange(C, dtype=I32)
+        me = jnp.arange(N, dtype=I32)
+        # one stacked log: fields (a, b, c) ride the trailing axis so
+        # each append/read phase costs ONE scatter/gather, not three
+        log = jnp.stack([s["log_a"], s["log_b"], s["log_c"]], axis=-1)
 
         # ------------------------------------------------ inbound decode
         req = jax.tree.map(lambda f: f[:, :, 0], edge_in)   # lane 0
@@ -211,29 +224,35 @@ class RaftProgram(NodeProgram):
         last_term = jnp.where(last_idx >= 0, last_term_arr, 0)
 
         # ------------------------------------------------ votes (5.2)
-        # grant at most one vote per round: sequential unroll over edges
+        # grant at most one vote per round: neighbors are distinct, so
+        # the sequential "first eligible edge wins" unroll is exactly a
+        # first-True pick over the joint eligibility mask
         grant = jnp.zeros((N, D), bool)
-        for d in range(D):
-            rv_ok = is_rv[:, d] & (req.a[:, d] == s["term"])
-            cand = nb[:, d]
-            log_ok = ((req.c[:, d] > last_term)
-                      | ((req.c[:, d] == last_term)
-                         & (req.b[:, d] >= last_idx)))
-            can_vote = (s["voted_for"] < 0) | (s["voted_for"] == cand)
-            g = rv_ok & can_vote & log_ok
-            s["voted_for"] = jnp.where(g, cand, s["voted_for"])
-            s["deadline"] = jnp.where(g, rnd + self.election + jitter,
+        if "votes" not in self.ablate:
+            rv_ok = is_rv & (req.a == s["term"][:, None])       # [N, D]
+            log_ok = ((req.c > last_term[:, None])
+                      | ((req.c == last_term[:, None])
+                         & (req.b >= last_idx[:, None])))
+            can_vote = ((s["voted_for"][:, None] < 0)
+                        | (s["voted_for"][:, None] == nb))
+            elig = rv_ok & can_vote & log_ok
+            any_g = elig.any(axis=1)
+            first = jnp.argmax(elig, axis=1)
+            grant = elig & (jnp.arange(D, dtype=I32)[None, :]
+                            == first[:, None])
+            cand = jnp.take_along_axis(nb, first[:, None], axis=1)[:, 0]
+            s["voted_for"] = jnp.where(any_g, cand, s["voted_for"])
+            s["deadline"] = jnp.where(any_g, rnd + self.election + jitter,
                                       s["deadline"])
-            grant = grant.at[:, d].set(g)
 
         # count granted replies; self-vote is implicit
         rv_granted = (is_rvr & (rep.a == s["term"][:, None])
                       & (rep.b > 0))
         votes_add = jnp.zeros((N, N), bool)
-        me = jnp.arange(N, dtype=I32)
-        for d in range(D):
-            votes_add |= (rv_granted[:, d, None]
-                          & (nb[:, d, None] == me[None, :]))
+        if "votes" not in self.ablate:
+            votes_add = (rv_granted[:, :, None]
+                         & (nb[:, :, None] == me[None, None, :])).any(
+                             axis=1)
         s["votes"] = (s["votes"] | votes_add) & \
             (s["role"] == CANDIDATE)[:, None]
         won = (s["role"] == CANDIDATE) & \
@@ -285,36 +304,40 @@ class RaftProgram(NodeProgram):
 
         conflict = jnp.zeros((N,), bool)
         new_len = s["log_len"]
-        contig = jnp.ones((N,), bool)
         contig_cnt = jnp.zeros((N,), I32)
-        for e in range(E):
-            lane = jax.tree.map(lambda f: f[:, :, 3 + e], edge_in)
-            on_acc = (lane.valid & (lane.type == T_ENTRY)
-                      & (jnp.arange(D, dtype=I32)[None, :]
-                         == acc_d[:, None]))
-            present = acc_any & on_acc.any(axis=1)
-            expected = acc_any & (e < acc_cnt)
-            eff = present & contig & expected
-            contig = contig & (present | ~expected)
-            ea = jnp.take_along_axis(lane.a, acc_d[:, None], axis=1)[:, 0]
-            eb = jnp.take_along_axis(lane.b, acc_d[:, None], axis=1)[:, 0]
-            ec = jnp.take_along_axis(lane.c, acc_d[:, None], axis=1)[:, 0]
-            pos = acc_prev + 1 + e
+        if "entries" not in self.ablate:
+            # all E entry lanes of the accepted edge in one gather each:
+            # [N, E] per field (acc_d indexes the D axis)
+            def at_acc(f):
+                return jnp.take_along_axis(
+                    f[:, :, 3:3 + E], acc_d[:, None, None], axis=1)[:, 0]
+            lv, lt = at_acc(edge_in.valid), at_acc(edge_in.type)
+            ea = at_acc(edge_in.a)
+            eb = at_acc(edge_in.b)
+            ec = at_acc(edge_in.c)
+            e_i = jnp.arange(E, dtype=I32)[None, :]
+            present = acc_any[:, None] & lv & (lt == T_ENTRY)   # [N, E]
+            expected = acc_any[:, None] & (e_i < acc_cnt[:, None])
+            # only a contiguous prefix of arrived entries may append:
+            # contig_before[e] = all earlier lanes present-or-unexpected
+            bad = (~(present | ~expected)).astype(I32)
+            contig_before = jnp.cumsum(
+                jnp.pad(bad[:, :-1], ((0, 0), (1, 0))), axis=1) == 0
+            eff = present & contig_before & expected
+            pos = acc_prev[:, None] + 1 + e_i                   # [N, E]
             in_cap = eff & (pos < C)
-            contig_cnt = contig_cnt + in_cap.astype(I32)
-            at = in_cap[:, None] & (cap_i == pos[:, None])
-            had = pos < s["log_len"]
-            old_term = self._unpack_a(
-                jnp.take_along_axis(s["log_a"],
-                                    jnp.clip(pos, 0, C - 1)[:, None],
-                                    axis=1))[0][:, 0]
-            conflict = conflict | (in_cap & had
-                                   & (old_term != (ea >> 16)))
-            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
-            s["log_b"] = jnp.where(at, eb[:, None], s["log_b"])
-            s["log_c"] = jnp.where(at, ec[:, None], s["log_c"])
-            new_len = jnp.where(in_cap, jnp.maximum(new_len, pos + 1),
-                                new_len)
+            contig_cnt = in_cap.astype(I32).sum(axis=1)
+            had = pos < s["log_len"][:, None]
+            old_a = jnp.take_along_axis(
+                log[:, :, 0], jnp.clip(pos, 0, C - 1), axis=1)  # [N, E]
+            conflict = (in_cap & had
+                        & ((old_a >> 16) != (ea >> 16))).any(axis=1)
+            vals = jnp.stack([ea, eb, ec], axis=-1)             # [N, E, 3]
+            log = log.at[me[:, None], jnp.where(in_cap, pos, C)].set(
+                vals, mode="drop")
+            new_len = jnp.maximum(
+                s["log_len"],
+                jnp.where(in_cap, pos + 1, 0).max(axis=1))
 
         window_end = acc_prev + 1 + contig_cnt
         # conflict => adopt exactly the sent prefix (truncate suffix)
@@ -375,121 +398,132 @@ class RaftProgram(NodeProgram):
             jnp.where(client_in.type == T_CAS, OP_CAS,
                       jnp.where(client_in.type == T_TXN, OP_TXN,
                                 OP_READ)))
-        # sequential append of direct requests (leader) — K is tiny
-        proxy_slot = jnp.full((N,), -1, I32)    # first unserved request
+        # batched append of direct requests (leader); a non-leader
+        # remembers its FIRST unserved request to proxy toward the leader
+        proxy_slot = jnp.full((N,), -1, I32)
         proxy_a = jnp.zeros((N,), I32)
         proxy_b = jnp.zeros((N,), I32)
         proxy_c = jnp.zeros((N,), I32)
-        for k in range(K):
-            rk = creq[:, k]
-            is_txn_k = client_in.type[:, k] == T_TXN
-            keyk = jnp.where(is_txn_k, 0,
-                             jnp.clip(client_in.a[:, k], 0,
-                                      self.keys - 1))
+        if "client" not in self.ablate and K > 0:
+            is_txn = client_in.type == T_TXN                    # [N, K]
+            keyk = jnp.where(is_txn, 0,
+                             jnp.clip(client_in.a, 0, self.keys - 1))
             # OP_TXN carries a 16-bit opaque command id split across v1/v2
             v1 = jnp.where(
-                is_txn_k, (client_in.a[:, k] >> 8) & 0xFF,
-                jnp.where(client_in.type[:, k] == T_WRITE,
-                          client_in.b[:, k] + 1,
-                          jnp.where(client_in.type[:, k] == T_CAS,
-                                    client_in.b[:, k] + 1, 0)))
-            v2 = jnp.where(
-                is_txn_k, client_in.a[:, k] & 0xFF,
-                jnp.where(client_in.type[:, k] == T_CAS,
-                          client_in.c[:, k] + 1, 0))
-            client_idx = client_in.src[:, k] - N
-            ea, eb = self._pack_entry(s["term"], keyk, op_of[:, k],
-                                      jnp.clip(client_idx, 0, 0xFFFF),
-                                      jnp.clip(v1, 0, 0xFF),
-                                      jnp.clip(v2, 0, 0xFF))
-            full = s["log_len"] >= C
-            do = rk & is_leader & ~full
-            at = do[:, None] & (cap_i == s["log_len"][:, None])
-            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
-            s["log_b"] = jnp.where(at, eb[:, None], s["log_b"])
-            s["log_c"] = jnp.where(at, client_in.mid[:, k, None],
-                                   s["log_c"])
-            s["log_len"] = jnp.where(do, s["log_len"] + 1, s["log_len"])
+                is_txn, (client_in.a >> 8) & 0xFF,
+                jnp.where((client_in.type == T_WRITE)
+                          | (client_in.type == T_CAS),
+                          client_in.b + 1, 0))
+            v2 = jnp.where(is_txn, client_in.a & 0xFF,
+                           jnp.where(client_in.type == T_CAS,
+                                     client_in.c + 1, 0))
+            client_idx = jnp.clip(client_in.src - N, 0, 0xFFFF)
+            v1c, v2c = jnp.clip(v1, 0, 0xFF), jnp.clip(v2, 0, 0xFF)
+            ea = (s["term"][:, None] << 16) | (keyk << 4) | op_of
+            eb = (client_idx << 16) | (v1c << 8) | v2c
+            # append positions: log_len + how many earlier slots append;
+            # once a position passes C every later one does too, so
+            # counting wishes (not successes) is exact
+            wish = creq & is_leader[:, None]
+            nbefore = jnp.cumsum(
+                jnp.pad(wish[:, :-1], ((0, 0), (1, 0))).astype(I32),
+                axis=1)
+            pos = s["log_len"][:, None] + nbefore
+            do = wish & (pos < C)
+            vals = jnp.stack([ea, eb, client_in.mid], axis=-1)  # [N, K, 3]
+            log = log.at[me[:, None], jnp.where(do, pos, C)].set(
+                vals, mode="drop")
+            s["log_len"] = s["log_len"] + do.astype(I32).sum(axis=1)
             s["log_overflow"] = s["log_overflow"] + (
-                rk & is_leader & full).astype(I32)
-            # non-leader: remember ONE request to proxy toward the leader
-            want_proxy = rk & ~is_leader & (proxy_slot < 0)
-            proxy_slot = jnp.where(want_proxy, k, proxy_slot)
-            pa = (keyk << 4) | op_of[:, k]
-            pb = (jnp.clip(client_idx, 0, 0xFFFF) << 16) | \
-                (jnp.clip(v1, 0, 0xFF) << 8) | jnp.clip(v2, 0, 0xFF)
-            proxy_a = jnp.where(want_proxy, pa, proxy_a)
-            proxy_b = jnp.where(want_proxy, pb, proxy_b)
-            proxy_c = jnp.where(want_proxy, client_in.mid[:, k], proxy_c)
+                wish & (pos >= C)).astype(I32).sum(axis=1)
+            want = creq & ~is_leader[:, None]
+            any_w = want.any(axis=1)
+            k0 = jnp.argmax(want, axis=1)
+            pick = lambda f: jnp.where(  # noqa: E731
+                any_w, jnp.take_along_axis(f, k0[:, None], axis=1)[:, 0], 0)
+            proxy_slot = jnp.where(any_w, k0, proxy_slot)
+            proxy_a = pick((keyk << 4) | op_of)
+            proxy_b = pick(eb)
+            proxy_c = pick(client_in.mid)
 
         # proxied requests arriving at the leader: append (one per edge)
-        for d in range(D):
-            full = s["log_len"] >= C
-            pk = is_prx[:, d] & is_leader & ~full
-            key_d = (prx.a[:, d] >> 4) & 0xFFF
-            op_d = prx.a[:, d] & 0xF
-            ea = (s["term"] << 16) | (key_d << 4) | op_d
-            at = pk[:, None] & (cap_i == s["log_len"][:, None])
-            s["log_a"] = jnp.where(at, ea[:, None], s["log_a"])
-            s["log_b"] = jnp.where(at, prx.b[:, d, None], s["log_b"])
-            s["log_c"] = jnp.where(at, prx.c[:, d, None], s["log_c"])
-            s["log_len"] = jnp.where(pk, s["log_len"] + 1, s["log_len"])
+        if "proxy" not in self.ablate:
+            wish = is_prx & is_leader[:, None]                  # [N, D]
+            nbefore = jnp.cumsum(
+                jnp.pad(wish[:, :-1], ((0, 0), (1, 0))).astype(I32),
+                axis=1)
+            pos = s["log_len"][:, None] + nbefore
+            do = wish & (pos < C)
+            key_d = (prx.a >> 4) & 0xFFF
+            ea = (s["term"][:, None] << 16) | (key_d << 4) | (prx.a & 0xF)
+            vals = jnp.stack([ea, prx.b, prx.c], axis=-1)       # [N, D, 3]
+            log = log.at[me[:, None], jnp.where(do, pos, C)].set(
+                vals, mode="drop")
+            s["log_len"] = s["log_len"] + do.astype(I32).sum(axis=1)
             s["log_overflow"] = s["log_overflow"] + (
-                is_prx[:, d] & is_leader & full).astype(I32)
+                wish & (pos >= C)).astype(I32).sum(axis=1)
 
         # ------------------------------------------------ apply + replies
+        # entries apply strictly in log order: applied+1+j while active.
+        # ONE gather fetches all A candidate entries; the per-step loop
+        # keeps only the tiny [N] algebra (a CAS may read a key the
+        # previous step wrote, so the kv chain is inherently sequential)
         A = K                                    # replies share client slots
-        out_valid = jnp.zeros((N, A), bool)
-        out_dest = jnp.zeros((N, A), I32)
-        out_type = jnp.zeros((N, A), I32)
-        out_a = jnp.zeros((N, A), I32)
-        out_reply = jnp.full((N, A), -1, I32)
-        key_i = jnp.arange(self.keys, dtype=I32)
-        for j in range(A):
-            idx = s["applied"] + 1
-            active = idx <= s["commit"]
-            ea = jnp.take_along_axis(s["log_a"],
-                                     jnp.clip(idx, 0, C - 1)[:, None],
-                                     axis=1)[:, 0]
-            eb = jnp.take_along_axis(s["log_b"],
-                                     jnp.clip(idx, 0, C - 1)[:, None],
-                                     axis=1)[:, 0]
-            ec = jnp.take_along_axis(s["log_c"],
-                                     jnp.clip(idx, 0, C - 1)[:, None],
-                                     axis=1)[:, 0]
-            _t, key, op = self._unpack_a(ea)
-            client, v1, v2 = self._unpack_b(eb)
-            at_key = active[:, None] & (key_i == key[:, None])
-            cur_v = jnp.take_along_axis(s["kv"],
-                                        jnp.clip(key, 0,
-                                                 self.keys - 1)[:, None],
-                                        axis=1)[:, 0]
-            cas_ok = (op == OP_CAS) & (cur_v == v1) & (cur_v > 0)
-            do_write = (op == OP_WRITE) | cas_ok
-            new_v = jnp.where(op == OP_WRITE, v1, v2)
-            s["kv"] = jnp.where(at_key & do_write[:, None],
-                                new_v[:, None], s["kv"])
-            s["applied"] = jnp.where(active, idx, s["applied"])
-            # leader replies to the originating client
-            say = active & is_leader & (op != OP_NOOP)
-            rtype = jnp.where(
-                op == OP_TXN, T_TXN_OK,
-                jnp.where(
-                    op == OP_READ,
-                    jnp.where(cur_v > 0, T_READ_OK, 1),  # 1 = T_ERROR
-                    jnp.where(op == OP_WRITE, T_WRITE_OK,
-                              jnp.where(cas_ok, T_CAS_OK, 1))))
-            ra = jnp.where(
-                op == OP_TXN, idx,                       # commit position
-                jnp.where(op == OP_READ,
-                          jnp.where(cur_v > 0, cur_v, 20),
-                          jnp.where((op == OP_CAS) & ~cas_ok,
-                                    jnp.where(cur_v > 0, 22, 20), 0)))
-            out_valid = out_valid.at[:, j].set(say)
-            out_dest = out_dest.at[:, j].set(N + client)
-            out_type = out_type.at[:, j].set(rtype)
-            out_a = out_a.at[:, j].set(ra)
-            out_reply = out_reply.at[:, j].set(ec)
+        outs = []
+        if "apply" not in self.ablate and A > 0:
+            start = s["applied"] + 1
+            idxs = start[:, None] + jnp.arange(A, dtype=I32)[None, :]
+            entries = log[me[:, None], jnp.clip(idxs, 0, C - 1)]  # [N,A,3]
+            for j in range(A):
+                idx = start + j
+                active = idx <= s["commit"]
+                ea, eb, ec = (entries[:, j, 0], entries[:, j, 1],
+                              entries[:, j, 2])
+                _t, key, op = self._unpack_a(ea)
+                client, v1, v2 = self._unpack_b(eb)
+                safe_key = jnp.clip(key, 0, self.keys - 1)
+                cur_v = jnp.take_along_axis(s["kv"], safe_key[:, None],
+                                            axis=1)[:, 0]
+                cas_ok = (op == OP_CAS) & (cur_v == v1) & (cur_v > 0)
+                do_write = active & ((op == OP_WRITE) | cas_ok)
+                new_v = jnp.where(op == OP_WRITE, v1, v2)
+                s["kv"] = s["kv"].at[
+                    me, jnp.where(do_write, safe_key, self.keys)].set(
+                        new_v, mode="drop")
+                s["applied"] = jnp.where(active, idx, s["applied"])
+                # leader replies to the originating client
+                say = active & is_leader & (op != OP_NOOP)
+                rtype = jnp.where(
+                    op == OP_TXN, T_TXN_OK,
+                    jnp.where(
+                        op == OP_READ,
+                        jnp.where(cur_v > 0, T_READ_OK, 1),  # 1 = T_ERROR
+                        jnp.where(op == OP_WRITE, T_WRITE_OK,
+                                  jnp.where(cas_ok, T_CAS_OK, 1))))
+                ra = jnp.where(
+                    op == OP_TXN, idx,                   # commit position
+                    jnp.where(op == OP_READ,
+                              jnp.where(cur_v > 0, cur_v, 20),
+                              jnp.where((op == OP_CAS) & ~cas_ok,
+                                        jnp.where(cur_v > 0, 22, 20), 0)))
+                outs.append((say, N + client, rtype, ra, ec))
+        if outs:
+            out_valid = jnp.stack([o[0] for o in outs], axis=1)
+            out_dest = jnp.stack([o[1] for o in outs], axis=1)
+            out_type = jnp.stack([o[2] for o in outs], axis=1)
+            out_a = jnp.stack([o[3] for o in outs], axis=1)
+            out_reply = jnp.stack([o[4] for o in outs], axis=1)
+        else:
+            out_valid = jnp.zeros((N, A), bool)
+            out_dest = jnp.zeros((N, A), I32)
+            out_type = jnp.zeros((N, A), I32)
+            out_a = jnp.zeros((N, A), I32)
+            out_reply = jnp.full((N, A), -1, I32)
+
+        # log writes are complete: unstack back to the state planes
+        s["log_a"] = log[:, :, 0]
+        s["log_b"] = log[:, :, 1]
+        s["log_c"] = log[:, :, 2]
 
         # ------------------------------------------------ outbound lanes
         # lane 0 requests: candidates ask for votes; leaders send AE
@@ -501,8 +535,8 @@ class RaftProgram(NodeProgram):
         prev_idx = nxt - 1
         prev_term = jnp.where(
             prev_idx >= 0,
-            self._unpack_a(jnp.take_along_axis(
-                s["log_a"], jnp.clip(prev_idx, 0, C - 1), axis=1))[0],
+            jnp.take_along_axis(
+                log[:, :, 0], jnp.clip(prev_idx, 0, C - 1), axis=1) >> 16,
             0)
         l0_valid = send_rv | send_ae
         l0_type = jnp.where(send_rv, T_RV, T_AE)
@@ -537,26 +571,28 @@ class RaftProgram(NodeProgram):
         l2_b = jnp.broadcast_to(proxy_b[:, None], (N, D))
         l2_c = jnp.broadcast_to(proxy_c[:, None], (N, D))
 
-        # entry lanes
-        lanes = [
-            (l0_valid, l0_type, l0_a, l0_b, l0_c),
-            (l1_valid, l1_type, l1_a, l1_b, l1_c),
-            (l2_valid, l2_type, l2_a, l2_b, l2_c),
-        ]
-        for e in range(E):
-            pos = jnp.clip(nxt + e, 0, C - 1)
-            ev = send_ae & (e < cnt)
-            ea = jnp.take_along_axis(s["log_a"], pos, axis=1)
-            eb = jnp.take_along_axis(s["log_b"], pos, axis=1)
-            ec = jnp.take_along_axis(s["log_c"], pos, axis=1)
-            lanes.append((ev, jnp.full((N, D), T_ENTRY, I32), ea, eb, ec))
+        # entry lanes: the leader's per-neighbor send window, fetched as
+        # ONE [N, D, E, 3] gather from the stacked log
+        if "outlanes" in self.ablate:
+            ev = jnp.zeros((N, D, E), bool)
+            window = jnp.zeros((N, D, E, 3), I32)
+        else:
+            e_i = jnp.arange(E, dtype=I32)[None, None, :]
+            pos = jnp.clip(nxt[:, :, None] + e_i, 0, C - 1)     # [N, D, E]
+            ev = send_ae[:, :, None] & (e_i < cnt[:, :, None])
+            window = log[me[:, None, None], pos]                # [N,D,E,3]
+
+        def pack3(x0, x1, x2, xe):
+            return jnp.concatenate(
+                [jnp.stack([x0, x1, x2], axis=2), xe], axis=2)
 
         edge_out = EdgeMsgs(
-            valid=jnp.stack([x[0] for x in lanes], axis=2),
-            type=jnp.stack([x[1] for x in lanes], axis=2),
-            a=jnp.stack([x[2] for x in lanes], axis=2),
-            b=jnp.stack([x[3] for x in lanes], axis=2),
-            c=jnp.stack([x[4] for x in lanes], axis=2))
+            valid=pack3(l0_valid, l1_valid, l2_valid, ev),
+            type=pack3(l0_type, l1_type, l2_type,
+                       jnp.full((N, D, E), T_ENTRY, I32)),
+            a=pack3(l0_a, l1_a, l2_a, window[:, :, :, 0]),
+            b=pack3(l0_b, l1_b, l2_b, window[:, :, :, 1]),
+            c=pack3(l0_c, l1_c, l2_c, window[:, :, :, 2]))
 
         client_out = client_in.replace(
             valid=out_valid, dest=out_dest, type=out_type, a=out_a,

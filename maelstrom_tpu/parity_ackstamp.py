@@ -1,0 +1,150 @@
+"""Measure the ack-stamp lag instead of fitting it (VERDICT r3 item 7).
+
+The one surviving deviation in `doc/parity.md` is grid-25 @ 10 ms,
+where this framework's stable-latency p50 undershoots the reference's
+published number by ~7.5 ms. `parity_analysis.py` showed a single
+shared 7.5-8.5 ms shift aligns all 16 quantile comparisons and
+attributed it to *ack-stamp lag*: the checker's "known time" for a
+value is the broadcast_ok **completion stamp recorded by the client
+harness**, which trails the instant the server actually held the value
+(request transit + handler scheduling + reply transit + history
+stamping under 25 concurrent handler threads at rate 100).
+
+That story was a fit. This experiment measures it: run the real host
+path — 25 node processes, 25 concurrent client workers, rate 100,
+10 ms hop latency, the reference's grid-25 parity config — with the
+broadcast node stamping the monotonic instant it first holds each value
+(`demo/python/broadcast.py` BCAST_STAMP). Both clocks are
+CLOCK_MONOTONIC on one box; the store's `t0_monotonic_ns` aligns the
+node stamps with the history's relative timeline. For every
+client-acked broadcast:
+
+    lag = t(broadcast_ok in history) - t(acking node first held value)
+
+The distribution's center is the measured ack-stamp offset; doc/parity.md
+cites it against the fitted 7.5-8.5 ms band.
+
+Usage:
+    python -m maelstrom_tpu.parity_ackstamp [--rate 100] [--nodes 25]
+        [--time-limit 8] [--out artifacts/ackstamp_lag.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+
+def run_instrumented(nodes: int, rate: float, time_limit: float,
+                     latency_ms: float, repo_root: str) -> str:
+    """Runs the host-path broadcast test with HADVAL stamping on; returns
+    the store directory of the completed run."""
+    env = dict(os.environ, BCAST_STAMP="1", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "maelstrom_tpu", "test",
+           "--workload", "broadcast",
+           "--bin", "demo/python/broadcast.py",
+           "--node-count", str(nodes),
+           "--concurrency", str(nodes),
+           "--rate", str(rate),
+           "--time-limit", str(time_limit),
+           "--latency", str(latency_ms),
+           "--topology", "grid"]
+    r = subprocess.run(cmd, cwd=repo_root, env=env,
+                       capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        raise RuntimeError(f"instrumented run failed:\n{r.stderr[-2000:]}")
+    m = re.search(r"store: ([^\s)]+)", r.stderr + r.stdout)
+    if not m:
+        raise RuntimeError("no store dir in run output")
+    return os.path.join(repo_root, m.group(1))
+
+
+def analyze(store_dir: str) -> dict:
+    with open(os.path.join(store_dir, "test.json")) as f:
+        test = json.load(f)
+    t0 = int(test["t0_monotonic_ns"])
+    node_names = test["nodes"]
+    n_nodes = len(node_names)
+
+    # node stamps: value -> {node: monotonic_ns of first holding}
+    hadval: dict = {}
+    logdir = os.path.join(store_dir, "node-logs")
+    for fn in os.listdir(logdir):
+        node = fn.rsplit(".", 1)[0]
+        with open(os.path.join(logdir, fn)) as f:
+            for line in f:
+                m = re.search(r"HADVAL (\S+) (\d+)", line)
+                if m:
+                    hadval.setdefault(m.group(1), {})[node] = \
+                        int(m.group(2)) - t0
+
+    # history: completed broadcasts -> (value, ack time, acking node)
+    lags = []
+    with open(os.path.join(store_dir, "history.jsonl")) as f:
+        ops = [json.loads(line) for line in f if line.strip()]
+    invokes = {}
+    for o in ops:
+        if o["f"] != "broadcast":
+            continue
+        if o["type"] == "invoke":
+            invokes[o["process"]] = o
+        elif o["type"] == "ok":
+            inv = invokes.get(o["process"])
+            if inv is None:
+                continue
+            # worker i drives nodes[i % n] (host_runner worker mapping)
+            node = node_names[o["process"] % n_nodes]
+            stamp = hadval.get(str(inv["value"]), {}).get(node)
+            if stamp is not None:
+                lags.append((o["time"] - stamp) / 1e6)   # ms
+
+    lags.sort()
+    n = len(lags)
+    if n < 30:
+        raise RuntimeError(f"only {n} matched acks — run longer")
+
+    def q(p):
+        return round(lags[min(n - 1, int(p * n))], 3)
+    return {
+        "matched_acks": n,
+        "lag_ms": {"p10": q(.10), "p25": q(.25), "p50": q(.50),
+                   "p75": q(.75), "p90": q(.90), "p99": q(.99),
+                   "mean": round(sum(lags) / n, 3),
+                   "min": round(lags[0], 3), "max": round(lags[-1], 3)},
+        "fitted_shift_band_ms": [7.5, 8.5],
+        "store": store_dir,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=25)
+    ap.add_argument("--rate", type=float, default=100.0)
+    ap.add_argument("--time-limit", type=float, default=8.0)
+    ap.add_argument("--latency", type=float, default=10.0)
+    ap.add_argument("--out", default="artifacts/ackstamp_lag.json")
+    ap.add_argument("--store", default=None,
+                    help="analyze an existing store dir instead of running")
+    args = ap.parse_args(argv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    store_dir = args.store or run_instrumented(
+        args.nodes, args.rate, args.time_limit, args.latency, repo_root)
+    report = analyze(store_dir)
+    report["config"] = {"nodes": args.nodes, "rate": args.rate,
+                        "time_limit": args.time_limit,
+                        "latency_ms": args.latency}
+    out = os.path.join(repo_root, args.out)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report["lag_ms"]))
+    print(f"wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""In-context phase profiling for the batched raft round.
+
+Applies the doc/performance.md methodology (measure inside the real
+`lax.scan`, never as isolated microbenchmarks) to the 10k x 5-node
+cluster configuration: times the full compiled round, then re-times it
+with individual edge_step phases stubbed out (the ablation deltas are
+the phase costs — XLA dead-code-eliminates a stubbed phase's work as
+long as downstream consumers get same-shaped zeros).
+
+Usage:
+    JAX_PLATFORMS=cpu python -m maelstrom_tpu.profile_raft --clusters 1000
+    python -m maelstrom_tpu.profile_raft            # real TPU, 10k
+
+Ablations are selected by RaftProgram.ablate (a frozenset checked at
+trace time; production runs never set it, so the flag costs nothing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+from .net import tpu as T
+from .nodes import get_program
+from .parallel import make_cluster_round_fn, make_cluster_sims
+
+PHASES = ("votes", "entries", "client", "proxy", "apply", "outlanes")
+
+
+def time_round(program, cfg, clusters: int, rounds: int, chunk: int,
+               seed: int = 0) -> float:
+    """Wall seconds per simulated round, measured over a chunked scan
+    (compile + first run excluded)."""
+    round_fn = make_cluster_round_fn(program, cfg)
+    scan = jax.jit(lambda sims: jax.lax.scan(
+        lambda s, _: (round_fn(s, T.Msgs.empty((clusters, 1)))[0], None),
+        sims, None, length=chunk)[0])
+
+    def run(sims):
+        for _ in range(rounds // chunk):
+            sims = scan(sims)
+        assert int(jax.device_get(sims.net.round[0])) == \
+            (rounds // chunk) * chunk
+        return sims
+
+    run(make_cluster_sims(program, cfg, clusters, seed=seed))   # compile
+    sims = make_cluster_sims(program, cfg, clusters, seed=seed + 1)
+    t0 = time.perf_counter()
+    run(sims)
+    return (time.perf_counter() - t0) / ((rounds // chunk) * chunk)
+
+
+def main(argv=None):
+    from .util import honor_jax_platforms
+    honor_jax_platforms()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=10_000)
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--rounds", type=int, default=300)
+    ap.add_argument("--chunk", type=int, default=100)
+    ap.add_argument("--phases", default="all",
+                    help="comma list of phases to ablate, or 'all'/'none'")
+    args = ap.parse_args(argv)
+
+    nodes = [f"n{i}" for i in range(args.nodes)]
+
+    def build(ablate=frozenset()):
+        program = get_program("lin-kv", {"latency": {"mean": 0}}, nodes)
+        program.ablate = frozenset(ablate)
+        cfg = T.NetConfig(n_nodes=args.nodes, n_clients=1, pool_cap=64,
+                          inbox_cap=program.inbox_cap, client_cap=4)
+        return program, cfg
+
+    program, cfg = build()
+    dev = jax.devices()[0]
+    print(f"profile_raft: {args.clusters} clusters x {args.nodes} nodes, "
+          f"{args.rounds} rounds ({args.chunk}/dispatch), "
+          f"device {dev.device_kind}", file=sys.stderr)
+
+    base = time_round(program, cfg, args.clusters, args.rounds, args.chunk)
+    report = {"device": dev.device_kind, "clusters": args.clusters,
+              "nodes": args.nodes,
+              "ms_per_round": round(base * 1e3, 3),
+              "cluster_rounds_per_sec": round(args.clusters / base, 1),
+              "phases": {}}
+    print(f"  full round: {base * 1e3:.2f} ms "
+          f"({args.clusters / base:,.0f} cluster-rounds/s)",
+          file=sys.stderr)
+
+    wanted = (PHASES if args.phases == "all"
+              else () if args.phases == "none"
+              else tuple(args.phases.split(",")))
+    for ph in wanted:
+        p2, c2 = build({ph})
+        t = time_round(p2, c2, args.clusters, args.rounds, args.chunk)
+        delta = base - t
+        report["phases"][ph] = {"ms_per_round": round(t * 1e3, 3),
+                                "delta_ms": round(delta * 1e3, 3)}
+        print(f"  -{ph:<9} {t * 1e3:7.2f} ms  (phase cost "
+              f"{delta * 1e3:+.2f} ms)", file=sys.stderr)
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

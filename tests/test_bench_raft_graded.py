@@ -14,3 +14,18 @@ def test_raft_clusters_graded_small():
     # the traffic was real: two workers contended on a shared register
     assert s["workers_per_cluster"] == 2
     assert s["indeterminate_ops"] <= 2, s
+
+
+def test_raft_clusters_graded_under_partition():
+    """The reference's flagship test shape: lin-kv + partition nemesis.
+    Every cluster gets a majority/minority split mid-run; histories must
+    stay linearizable (ops may go indeterminate, never inconsistent),
+    and the final reads land after the heal."""
+    from maelstrom_tpu.bench_raft_graded import run_raft_graded
+
+    s = run_raft_graded(n_clusters=24, sample=6, ops_per_client=8,
+                        chunk=10, partition_at=2, partition_chunks=6,
+                        verbose=False)
+    assert s["all_linearizable"] is True, s
+    assert s["partition"]["rounds"] == 60
+    assert s["partition"]["clusters_partitioned"] == 24

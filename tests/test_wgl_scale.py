@@ -1,0 +1,100 @@
+"""Knossos-scale WGL: long per-key histories must check definitively.
+
+The round-3 checker returned "unknown" above 600 ops per key
+(VERDICT r3 missing item 3); the just-in-time configuration form must
+handle thousands-of-ops histories from the graded configs — bounded
+worker concurrency, mixed read/write/cas, indeterminate ops from
+timeouts — in seconds, with no "unknown" escape hatch.
+"""
+
+import random
+import time
+
+from maelstrom_tpu.checkers.linearizable import (INF,
+                                                 check_register_history)
+
+
+def _simulate(n_ops: int, workers: int, seed: int, info_rate: float = 0.02):
+    """A real linearizable schedule: a hidden register serializes ops at
+    a random point inside each op's [inv, ret] window; concurrent ops
+    overlap via per-worker clocks. Some completions are dropped to
+    indeterminate (ret=INF), mimicking RPC timeouts."""
+    rng = random.Random(seed)
+    reg = None
+    clock = 0.0
+    ops = []
+    open_until = [0.0] * workers
+    for _ in range(n_ops):
+        w = rng.randrange(workers)
+        inv = max(open_until[w], clock) + rng.random()
+        lin = inv + rng.random()            # serialization point
+        ret = lin + rng.random()
+        open_until[w] = ret
+        clock = inv                          # invocations march forward
+        kind = rng.random()
+        if kind < 0.45:
+            f, val = "read", None
+        elif kind < 0.8:
+            f, val = "write", rng.randrange(6)
+        else:
+            f, val = "cas", (rng.randrange(6), rng.randrange(6))
+        # apply at lin
+        if f == "read":
+            val = reg
+            ok = val is not None             # read of empty: model as ok
+            if reg is None:
+                continue                      # skip empty-register reads
+        elif f == "write":
+            reg = val
+            ok = True
+        else:
+            frm, to = val
+            ok = reg == frm
+            if ok:
+                reg = to
+            else:
+                continue                      # failed cas: excluded anyway
+        if rng.random() < info_rate:
+            ops.append({"f": f, "value": val, "inv": inv, "ret": INF,
+                        "ok": False})
+        else:
+            ops.append({"f": f, "value": val, "inv": inv, "ret": ret,
+                        "ok": True})
+    return ops
+
+
+def test_long_valid_history_checks_definitively():
+    ops = _simulate(5_000, workers=4, seed=1)
+    assert len(ops) > 3_000
+    t0 = time.perf_counter()
+    r = check_register_history(ops)
+    dt = time.perf_counter() - t0
+    assert r["valid"] is True
+    assert dt < 60, f"5k-op check took {dt:.1f}s"
+
+
+def test_long_invalid_history_detected():
+    ops = _simulate(3_000, workers=4, seed=2, info_rate=0.0)
+    # corrupt one late read: claim a value the register never held there
+    for o in reversed(ops):
+        if o["f"] == "read":
+            o["value"] = 99
+            break
+    r = check_register_history(ops)
+    assert r["valid"] is False
+
+
+def test_concurrent_window_history():
+    # heavier concurrency: 16 workers, overlapping windows
+    ops = _simulate(2_000, workers=16, seed=3)
+    r = check_register_history(ops)
+    assert r["valid"] is True
+
+
+def test_no_unknown_below_cap():
+    # the old implementation returned "unknown" above 600 ops; any
+    # verdict other than True/False here is a regression
+    ops = _simulate(1_200, workers=2, seed=4)
+    r = check_register_history(ops)
+    assert r["valid"] in (True, False)
+    assert r["valid"] is True
