@@ -78,7 +78,13 @@ static void absorb_array(const char *arr) {
 
 static void on_add(const mn_msg *m) {
     const char *e = mn_find(m->body, "element");
-    if (e) find_or_add(e, mn_value_len(e));
+    if (!e || find_or_add(e, mn_value_len(e)) < 0) {
+        /* never ack a dropped element — an acked-then-missing element
+         * is exactly what the set-full checker calls "lost" */
+        mn_reply(m, "{\"type\": \"error\", \"code\": 13, "
+                    "\"text\": \"element rejected (size or capacity)\"}");
+        return;
+    }
     mn_reply(m, "{\"type\": \"add_ok\"}");
 }
 

@@ -117,8 +117,15 @@ static long g_next_id = 0;
 
 static long send_body(const char *dest, long in_reply_to,
                       const char *fmt, va_list ap) {
-    char body[65536];
-    vsnprintf(body, sizeof body, fmt, ap);
+    /* static: bodies can be large (a g-set snapshot is ~0.5 MB) and
+     * the node is single-threaded, so one buffer serves every send */
+    static char body[1 << 20];
+    int w = vsnprintf(body, sizeof body, fmt, ap);
+    if (w < 0 || (size_t)w >= sizeof body) {
+        fprintf(stderr, "mn: body exceeds %zu bytes, dropped\n",
+                sizeof body);
+        return -1;
+    }
     size_t blen = strlen(body);
     if (blen < 2 || body[0] != '{' || body[blen - 1] != '}') {
         fprintf(stderr, "mn: body must be a JSON object: %s\n", body);
@@ -310,6 +317,11 @@ int mn_run(void) {
         if (r <= 0) continue;
         if (pfd.revents & (POLLHUP | POLLERR) && !(pfd.revents & POLLIN))
             return 0;
+        if (len >= sizeof buf - 1) {
+            fprintf(stderr, "mn: input line exceeds %zu bytes\n",
+                    sizeof buf);
+            return 1;
+        }
         ssize_t n = read(STDIN_FILENO, buf + len, sizeof buf - len - 1);
         if (n <= 0) return 0;                     /* EOF: clean exit */
         len += (size_t)n;

@@ -1,0 +1,35 @@
+#!/usr/bin/env python3
+"""Broadcast tutorial, stage 1 (doc/tutorial/03-broadcast.md): accept
+and acknowledge values, serve reads — and tell nobody. Passes trivially
+at --node-count 1; at 5 nodes the stock checker fails the run, naming
+each value that reached one node and was never seen by a read at
+another. The chapter is the work of emptying that list."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+messages = set()
+
+
+@node.on("topology")
+def topology(msg):
+    node.reply(msg, {"type": "topology_ok"})
+
+
+@node.on("broadcast")
+def broadcast(msg):
+    messages.add(msg["body"]["message"])
+    node.reply(msg, {"type": "broadcast_ok"})
+
+
+@node.on("read")
+def read(msg):
+    node.reply(msg, {"type": "read_ok", "messages": sorted(messages)})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Broadcast tutorial, stage 2 (doc/tutorial/03-broadcast.md): on first
+receipt, forward the value once to every neighbor except whoever sent
+it (deg-1 fan-out — the skip-sender rule the reference's naive node
+uses). Converges on a healthy network and passes the checker there; a
+single lost or partition-blocked hop loses the value FOREVER, and the
+checker exhibits it under `--nemesis partition`. Fire-once is fast and
+wrong; stage 3 adds the retry loop that makes it merely fast."""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+lock = threading.Lock()
+messages = set()
+neighbors = []
+
+
+@node.on("topology")
+def topology(msg):
+    global neighbors
+    with lock:
+        neighbors = msg["body"]["topology"].get(node.node_id, [])
+    node.reply(msg, {"type": "topology_ok"})
+
+
+@node.on("broadcast")
+def broadcast(msg):
+    v = msg["body"]["message"]
+    new = False
+    with lock:
+        if v not in messages:
+            messages.add(v)
+            new = True
+        nbs = list(neighbors)
+    if new:
+        for n in nbs:
+            if n != msg["src"]:
+                node.send_msg(n, {"type": "broadcast", "message": v})
+    if msg["body"].get("msg_id") is not None:
+        node.reply(msg, {"type": "broadcast_ok"})
+
+
+@node.on("read")
+def read(msg):
+    with lock:
+        vals = sorted(messages)
+    node.reply(msg, {"type": "read_ok", "messages": vals})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -186,6 +186,8 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     # (cluster, mid) of ops graded indeterminate: next_mid is a
     # PER-CLUSTER counter, so bare mids collide across sampled clusters
     timed_out_mids = set()
+    completed_mids = set()    # (cluster, mid) already ok/fail-completed
+    duplicate_replies = 0
     chunks_run = 0
     while chunks_run < max_chunks:
         # --- nemesis schedule (host-side state surgery, like the
@@ -278,10 +280,19 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                 if cur is not None and cur[3] == rto:
                     complete(int(s), w, int(types[i, s, j]),
                              int(avals[i, s, j]), round_base + i)
+                    completed_mids.add((int(s), rto))
                 elif (int(s), rto) in timed_out_mids:
                     # late ack for an op already graded indeterminate:
                     # `info` means exactly "may have committed" — drop
-                    timed_out_mids.discard((int(s), rto))
+                    # (kept in the set: a re-applying post-heal leader
+                    # can ack the same committed entry more than once)
+                    pass
+                elif (int(s), rto) in completed_mids:
+                    # duplicate reply: a post-heal leader re-applying a
+                    # committed entry (its applied index trailed the old
+                    # leader's) answers the client a second time —
+                    # idempotent at the client, counted for the record
+                    duplicate_replies += 1
                 else:
                     raise RuntimeError(
                         f"unmatched reply mid {rto} for c{s}/w{w}")
@@ -338,6 +349,7 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
         "linearizable_clusters": ok_count,
         "all_linearizable": ok_count == S,
         "indeterminate_ops": info_ops,
+        "duplicate_replies": duplicate_replies,
         "rounds": round_base,
         "wall_s": round(time.perf_counter() - t0, 3),
     }

@@ -94,6 +94,8 @@ def check_register_history(ops, max_states: int = 5_000_000):
         return out
 
     seen = set()
+    best = (0, frozenset(), None)    # deepest configuration reached
+    best_n = -1
     stack = [((0, frozenset(), None), None)]
     while stack:
         (i, extra, state), it = stack.pop()
@@ -104,6 +106,8 @@ def check_register_history(ops, max_states: int = 5_000_000):
             if key in seen:
                 continue
             seen.add(key)
+            if i + len(extra) > best_n:
+                best_n, best = i + len(extra), key
             if len(seen) > max_states:
                 return {"valid": "unknown",
                         "error": "WGL configuration cap exceeded"}
@@ -116,9 +120,19 @@ def check_register_history(ops, max_states: int = 5_000_000):
         j, s2 = nxt
         stack.append(((i, extra, state), it))
         stack.append((norm(i, extra | frozenset((j,))) + (s2,), None))
+    # witness: the deepest frontier any linearization reached, and the
+    # op stuck there (the Knossos-style "this op cannot linearize" line)
+    bi, bextra, bstate = best
+    stuck = next((ops[j] for j in range(bi, n) if j not in bextra), None)
     return {"valid": False,
             "explored-configurations": len(seen),
-            "op-count": n}
+            "op-count": n,
+            "linearized-prefix": best_n,
+            "final-state": bstate,
+            "stuck-op": None if stuck is None else
+            {"f": stuck["f"], "value": stuck["value"],
+             "ok": stuck["ok"], "inv": stuck["inv"],
+             "ret": None if stuck["ret"] == INF else stuck["ret"]}}
 
 
 class LinearizableRegisterChecker(Checker):
@@ -160,6 +174,16 @@ class LinearizableRegisterChecker(Checker):
         valid = (False if failures else
                  ("unknown" if any(r["valid"] == "unknown"
                                    for r in results.values()) else True))
-        return {"valid": valid,
-                "key-count": len(by_key),
-                "failures": failures or None}
+        out = {"valid": valid,
+               "key-count": len(by_key),
+               "failures": failures or None}
+        if failures:
+            # surface each failed key's witness (deepest linearizable
+            # prefix + the op that cannot linearize) in the results file
+            out["witnesses"] = {
+                str(k): {kk: results[str(k)][kk]
+                         for kk in ("linearized-prefix", "op-count",
+                                    "final-state", "stuck-op")
+                         if kk in results[str(k)]}
+                for k in failures}
+        return out

@@ -35,6 +35,7 @@ def time_round(program, cfg, clusters: int, rounds: int, chunk: int,
                seed: int = 0) -> float:
     """Wall seconds per simulated round, measured over a chunked scan
     (compile + first run excluded)."""
+    chunk = max(1, min(chunk, rounds))
     round_fn = make_cluster_round_fn(program, cfg)
     scan = jax.jit(lambda sims: jax.lax.scan(
         lambda s, _: (round_fn(s, T.Msgs.empty((clusters, 1)))[0], None),
