@@ -80,8 +80,11 @@ static void on_add(const mn_msg *m) {
     const char *e = mn_find(m->body, "element");
     if (!e || find_or_add(e, mn_value_len(e)) < 0) {
         /* never ack a dropped element — an acked-then-missing element
-         * is exactly what the set-full checker calls "lost" */
-        mn_reply(m, "{\"type\": \"error\", \"code\": 13, "
+         * is exactly what the set-full checker calls "lost". Code 11
+         * is DEFINITE (temporarily-unavailable): the add certainly did
+         * not happen, so the checker grades a clean fail, not an
+         * indeterminate the set must carry forever. */
+        mn_reply(m, "{\"type\": \"error\", \"code\": 11, "
                     "\"text\": \"element rejected (size or capacity)\"}");
         return;
     }

@@ -180,6 +180,10 @@ long mn_rpc(const char *dest, void (*cb)(const mn_msg *reply, void *ctx),
     va_start(ap, fmt);
     long mid = send_body(dest, -1, fmt, ap);
     va_end(ap);
+    if (mid < 0) {            /* body too large: fail like a timeout */
+        if (cb) cb(NULL, ctx);
+        return -1;
+    }
     int slot = (int)(mid % MN_MAX_RPC);
     if (g_rpc[slot].mid != 0) {
         /* recycled before completion: fire its timeout now so no

@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Unique-id node (doc/tutorial/09-workloads.md): ids are
+"<node_id>-<counter>" — node ids are unique by construction and the
+counter is node-local, so no coordination (and no network traffic at
+all) is needed for global uniqueness. Total availability under any
+fault the nemesis can throw."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+counter = 0
+
+
+@node.on("generate")
+def generate(msg):
+    global counter
+    counter += 1
+    node.reply(msg, {"type": "generate_ok",
+                     "id": f"{node.node_id}-{counter}"})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Unique-id tutorial's deliberately broken stage
+(doc/tutorial/09-workloads.md): ids are wall-clock milliseconds — the
+classic "timestamps are probably unique" mistake. Two requests inside
+one millisecond (or any two nodes asked in the same one) collide, and
+the unique-ids checker names every collision."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node  # noqa: E402
+
+node = Node()
+
+
+@node.on("generate")
+def generate(msg):
+    node.reply(msg, {"type": "generate_ok",
+                     "id": int(time.time() * 1000)})
+
+
+if __name__ == "__main__":
+    node.run()

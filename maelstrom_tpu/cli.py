@@ -26,7 +26,8 @@ def build_parser() -> argparse.ArgumentParser:
                                   "tpu:broadcast (instead of --bin)")
     t.add_argument("-w", "--workload", default="lin-kv",
                    choices=["broadcast", "echo", "g-set", "g-counter",
-                            "pn-counter", "lin-kv", "txn-list-append"],
+                            "pn-counter", "lin-kv", "txn-list-append",
+                            "unique-ids"],
                    help="What workload to run")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
@@ -184,12 +185,14 @@ DEMOS = [
      "concurrency": 10, "time_limit_min": 8.0},
     {"workload": "txn-list-append",
      "bin": "demo/python/datomic_list_append.py"},
+    {"workload": "unique-ids", "bin": "demo/python/unique_ids.py"},
     # native batched node programs (the TPU path's userland)
     {"workload": "broadcast", "node": "tpu:broadcast", "topology": "tree4"},
     {"workload": "g-set", "node": "tpu:g-set"},
     {"workload": "pn-counter", "node": "tpu:pn-counter"},
     {"workload": "lin-kv", "node": "tpu:lin-kv"},
     {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
+    {"workload": "unique-ids", "node": "tpu:unique-ids"},
 ]
 
 

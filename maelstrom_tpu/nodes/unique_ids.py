@@ -1,0 +1,63 @@
+"""Batched unique-id node: ids are (node index, per-node counter) pairs
+minted with zero coordination (the TPU-native analogue of
+`demo/python/unique_ids.py`, serving `workloads/unique_ids.py` —
+doc/tutorial/09-workloads.md's worked example).
+
+Vectorization note: several `generate` requests can land in one node's
+inbox in the same round, and each must get a distinct counter value —
+the per-row prefix sum over request slots assigns ranks, the counter
+advances by the row's request count, and the whole thing stays one
+fused elementwise+cumsum kernel for all N nodes."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..net.tpu import I32
+from . import NodeProgram, register
+
+T_GEN = 10
+T_GEN_OK = 11
+
+
+@register
+class UniqueIdsProgram(NodeProgram):
+    name = "unique-ids"
+
+    def init_state(self):
+        return {"counter": jnp.zeros((self.n_nodes,), I32)}
+
+    def step(self, state, inbox, ctx):
+        is_gen = inbox.valid & (inbox.type == T_GEN)        # [N, K]
+        # rank each request within its row so same-round requests at
+        # one node mint distinct counters
+        rank = jnp.cumsum(is_gen.astype(I32), axis=1) - 1
+        n_idx = jnp.arange(self.n_nodes, dtype=I32)[:, None]
+        minted = state["counter"][:, None] + 1 + rank
+        out = inbox.replace(
+            valid=is_gen,
+            dest=inbox.src,
+            reply_to=inbox.mid,
+            type=jnp.full_like(inbox.type, T_GEN_OK),
+            a=jnp.broadcast_to(n_idx, inbox.a.shape),
+            b=minted)
+        state = {"counter": state["counter"]
+                 + is_gen.astype(I32).sum(axis=1)}
+        return state, out
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        return {"type": "generate"}
+
+    def encode_body(self, body, intern):
+        assert body["type"] == "generate"
+        return (T_GEN, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_GEN_OK:
+            return {"type": "generate_ok", "id": f"n{int(a)}-{int(b)}"}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        return {**op, "type": "ok", "value": body["id"]}

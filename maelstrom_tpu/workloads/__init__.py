@@ -7,7 +7,7 @@ from __future__ import annotations
 
 def registry() -> dict:
     from . import (broadcast, echo, g_counter, g_set, lin_kv, pn_counter,
-                   txn_list_append)
+                   txn_list_append, unique_ids)
     return {
         "broadcast": broadcast.workload,
         "echo": echo.workload,
@@ -16,6 +16,7 @@ def registry() -> dict:
         "pn-counter": pn_counter.workload,
         "lin-kv": lin_kv.workload,
         "txn-list-append": txn_list_append.workload,
+        "unique-ids": unique_ids.workload,
     }
 
 

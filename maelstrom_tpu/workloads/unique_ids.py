@@ -1,0 +1,43 @@
+"""Unique-ID generation workload (doc/tutorial/09-workloads.md's worked
+example; classic Maelstrom's `unique-ids`, absent from the reference's
+seven workloads).
+
+Clients ask any node for a fresh id; the system's only obligation is
+that no two acknowledged ids are equal — total availability is
+trivially reachable (a node can mint from local state alone), which is
+exactly why the workload makes a good first custom one: the protocol
+is one RPC, and all the interest lives in the checker."""
+
+from __future__ import annotations
+
+from .. import generators as g
+from .. import schema as S
+from ..checkers.unique_ids import UniqueIdsChecker
+from ..client import defrpc, with_errors
+from . import BaseClient
+
+generate_rpc = defrpc(
+    "generate",
+    "Asks a node to generate a globally unique identifier. Servers "
+    "respond with a `generate_ok` carrying the fresh id in `id`; any "
+    "JSON value is a legal id, and two acknowledged ids must never be "
+    "equal — across nodes, clients, and time.",
+    {"type": S.Eq("generate")},
+    {"type": S.Eq("generate_ok"), "id": S.Any},
+    ns="maelstrom_tpu.workloads.unique_ids")
+
+
+class UniqueIdsClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            res = generate_rpc(self.conn, self.node, {})
+            return {**op, "type": "ok", "value": res["id"]}
+        return with_errors(op, set(), go)
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": UniqueIdsClient(opts["net"]),
+        "generator": g.Repeat({"f": "generate"}),
+        "checker": UniqueIdsChecker(),
+    }

@@ -1,0 +1,77 @@
+"""The unique-ids workload (doc/tutorial/09-workloads.md's worked
+example): checker unit tests on literal histories — legal, forged-
+duplicate, and vacuous — plus the batched program's same-round minting
+rank logic."""
+
+import jax.numpy as jnp
+
+from maelstrom_tpu.checkers.unique_ids import UniqueIdsChecker
+from maelstrom_tpu.history import History, Op
+
+
+def _h(ops):
+    return History([Op(**o) for o in ops])
+
+
+def _gen(process, t, value, type="ok"):
+    return [
+        {"type": "invoke", "f": "generate", "process": process,
+         "time": t, "value": None},
+        {"type": type, "f": "generate", "process": process,
+         "time": t + 1, "value": value},
+    ]
+
+
+def test_distinct_ids_valid():
+    ops = _gen(0, 0, "n0-1") + _gen(1, 10, "n1-1") + _gen(0, 20, "n0-2")
+    r = UniqueIdsChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+    assert r["distinct-count"] == 3
+
+
+def test_duplicate_named_with_witness():
+    ops = _gen(0, 0, 12345) + _gen(1, 10, 777) + _gen(2, 20, 12345)
+    r = UniqueIdsChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["duplicated-count"] == 1
+    (dup,) = r["duplicated"].values()
+    assert [d["process"] for d in dup] == [0, 2]
+
+
+def test_indeterminate_ids_unconstrained():
+    # an info op's id was never observed: reissuing it is legal
+    ops = _gen(0, 0, 99, type="info") + _gen(1, 10, 99)
+    r = UniqueIdsChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+
+
+def test_vacuous_run_unknown():
+    # zero observations can't certify uniqueness: "unknown" (which
+    # merge_valid treats as not-valid), never a clean True
+    ops = _gen(0, 0, None, type="info")
+    r = UniqueIdsChecker().check({}, _h(ops), {})
+    assert r["valid"] == "unknown"
+    assert "error" in r
+
+
+def test_batched_program_same_round_ranks():
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+
+    program = get_program("unique-ids", {}, ["n0", "n1"])
+    state = program.init_state()
+    inbox = T.Msgs.empty((2, 3))
+    # node 0 gets two same-round requests, node 1 gets one
+    inbox = inbox.replace(
+        valid=jnp.asarray([[True, True, False], [True, False, False]]),
+        type=jnp.full((2, 3), 10, T.I32),
+        src=jnp.full((2, 3), 2, T.I32),
+        mid=jnp.asarray([[5, 6, 0], [7, 0, 0]], T.I32))
+    state, out = program.step(state, inbox,
+                              {"round": jnp.int32(0), "key": None})
+    ids = [(int(a), int(b)) for v, a, b in
+           zip(out.valid.reshape(-1), out.a.reshape(-1),
+               out.b.reshape(-1)) if bool(v)]
+    assert len(ids) == len(set(ids)) == 3
+    assert (0, 1) in ids and (0, 2) in ids and (1, 1) in ids
+    assert [int(x) for x in state["counter"]] == [2, 1]
