@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 TPU evidence recapture (run when the axon tunnel is back).
+# Serial on purpose: one TPU client at a time (never kill these
+# mid-flight — a killed client can wedge the tunnel for the whole box).
+set -u
+cd /root/repo
+mkdir -p artifacts
+echo "=== $(date +%H:%M:%S) broadcast headline bench ==="
+timeout 1800 python bench.py 2>artifacts/bench-r4-broadcast.log \
+    | tee artifacts/bench-r4-broadcast.json
+echo "rc=$?"
+echo "=== $(date +%H:%M:%S) raft bench + partition-graded sample ==="
+BENCH_MODE=raft timeout 3600 python bench.py \
+    2>artifacts/bench-r4-raft.log | tee artifacts/bench-raft-r4.json
+echo "rc=$?"
+echo "=== $(date +%H:%M:%S) raft TPU phase profile ==="
+timeout 3600 python -m maelstrom_tpu.profile_raft --clusters 10000 \
+    --rounds 300 --chunk 100 2>artifacts/profile-raft-r4.log \
+    | tee artifacts/profile-raft-r4.json
+echo "rc=$?"
+echo "=== $(date +%H:%M:%S) done ==="
