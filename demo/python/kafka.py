@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Kafka-style replicated log over the built-in services (the `kafka`
+workload): per-key logs live in `lin-kv` as JSON lists appended by a
+CAS loop (offset = length before the append — the CAS makes the
+assignment exclusive, so offsets never diverge), committed offsets in
+`lin-kv` advanced by a monotone CAS (a stale commit never regresses
+the mark). Polls read the whole list: full-prefix observation, which
+is exactly what the checker's lost-write rule leans on."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+
+
+def kv_read(key, default):
+    try:
+        return node.sync_rpc("lin-kv", {"type": "read", "key": key})["value"]
+    except RPCError as e:
+        if e.code != 20:
+            raise
+        return default
+
+
+@node.on("send")
+def send(msg):
+    b = msg["body"]
+    key = f"log-{b['key']}"
+    while True:
+        cur = kv_read(key, [])
+        try:
+            node.sync_rpc("lin-kv", {"type": "cas", "key": key,
+                                     "from": cur, "to": cur + [b["msg"]],
+                                     "create_if_not_exists": True})
+        except RPCError as e:
+            if e.code in (20, 22):
+                continue              # lost the race: re-read, retry
+            raise
+        node.reply(msg, {"type": "send_ok", "offset": len(cur)})
+        return
+
+
+@node.on("poll")
+def poll(msg):
+    out = {}
+    for k in msg["body"]["keys"]:
+        log = kv_read(f"log-{k}", [])
+        if log:
+            out[str(k)] = [[i, m] for i, m in enumerate(log)]
+    node.reply(msg, {"type": "poll_ok", "msgs": out})
+
+
+@node.on("commit_offsets")
+def commit_offsets(msg):
+    for k, o in msg["body"]["offsets"].items():
+        key = f"commit-{k}"
+        while True:
+            cur = kv_read(key, -1)
+            if cur >= o:
+                break                 # a later commit already landed
+            try:
+                node.sync_rpc("lin-kv", {"type": "cas", "key": key,
+                                         "from": cur, "to": o,
+                                         "create_if_not_exists": True})
+                break
+            except RPCError as e:
+                if e.code in (20, 22):
+                    continue
+                raise
+    node.reply(msg, {"type": "commit_offsets_ok"})
+
+
+@node.on("list_committed_offsets")
+def list_committed(msg):
+    out = {}
+    for k in msg["body"]["keys"]:
+        o = kv_read(f"commit-{k}", None)
+        if o is not None:
+            out[str(k)] = o
+    node.reply(msg, {"type": "list_committed_offsets_ok", "offsets": out})
+
+
+if __name__ == "__main__":
+    node.run()

@@ -1,0 +1,169 @@
+"""Kafka-style replicated-log checker (classic Maelstrom's `kafka`
+workload, beyond the reference's seven; jepsen.tests.kafka's core
+invariants, restated for full-prefix polls).
+
+History value conventions (see workloads/kafka.py):
+  send ok:   [key, msg, offset]
+  poll ok:   {key: [[offset, msg], ...]}    (server returns the full
+                                             prefix, from offset 0)
+  commit ok: {key: offset}
+  list ok:   {key: offset}
+
+Checked invariants:
+  1. **No divergence**: (key, offset) maps to one msg across every ok
+     send and every poll, ever.
+  2. **Order**: within a single poll, each key's offsets are strictly
+     increasing AND start at the log head (offset 0) — the poll RPC's
+     contract is a full prefix, so a truncated head is an order
+     violation, not lag.
+  3. **No lost writes**: a send acked at offset o must appear in every
+     poll that *begins after the ack completes* and observes any offset
+     >= o for that key (reading past a hole means the hole is a loss,
+     not lag).
+  4. **Committed-offset monotonicity**: the stored committed offset of
+     a key only advances. Observable as: a `list` that *begins after* a
+     `commit` completed must report at least the committed offset, and
+     a `list` that begins after another `list` completed must never
+     report less. (A commit *requesting* a lower offset is legal — the
+     server clamps — so commit requests are lower bounds, not
+     observations.)
+
+Indeterminate (`info`) sends constrain nothing (their offset was never
+observed); indeterminate commits may or may not advance the committed
+offset, so they widen what a later list may legally return.
+"""
+
+from __future__ import annotations
+
+from . import Checker
+from ..history import coerce_history
+
+
+class KafkaChecker(Checker):
+    name = "kafka"
+
+    def check(self, test, history, opts=None):
+        history = coerce_history(history)
+        assign: dict = {}        # (key, offset) -> msg (first observer)
+        divergent = []
+        order_violations = []
+        lost = []
+        commit_regressions = []
+
+        def observe(k, o, m, where):
+            cur = assign.get((k, o))
+            if cur is None:
+                assign[(k, o)] = m
+            elif cur != m:
+                divergent.append({"key": k, "offset": o,
+                                  "values": [cur, m], "in": where})
+
+        acked_sends = []         # (ack_time, key, offset, msg)
+        polls = []               # (invoke_time, {key: [[o, m], ...]})
+        commits = []             # (complete_time, {key: offset})
+        lists = []               # (invoke_time, complete_time, {k: o})
+
+        for invoke, complete in history.pairs():
+            ok = complete is not None and complete.is_ok()
+            if invoke.f == "send":
+                if ok:
+                    k, m, o = complete.value
+                    observe(str(k), int(o), m, "send_ok")
+                    acked_sends.append((complete.time, str(k), int(o), m))
+            elif invoke.f == "poll":
+                if ok and isinstance(complete.value, dict):
+                    polls.append((invoke.time, complete.value))
+                    for k, pairs in complete.value.items():
+                        if pairs and int(pairs[0][0]) != 0:
+                            order_violations.append(
+                                {"key": k, "head-offset": int(pairs[0][0]),
+                                 "error": "full-prefix poll must start "
+                                          "at offset 0"})
+                        last = -1
+                        for o, m in pairs:
+                            if int(o) <= last:
+                                order_violations.append(
+                                    {"key": k, "offsets": [last, int(o)]})
+                            last = int(o)
+                            observe(str(k), int(o), m, "poll_ok")
+            elif invoke.f == "commit":
+                if ok and isinstance(complete.value, dict):
+                    commits.append(
+                        (complete.time,
+                         {str(k): int(v) for k, v in
+                          complete.value.items()}))
+            elif invoke.f == "list":
+                if ok and isinstance(complete.value, dict):
+                    lists.append(
+                        (invoke.time, complete.time,
+                         {str(k): int(v) for k, v in
+                          complete.value.items()}))
+
+        # 3. lost writes. Polls are full prefixes, so a poll's "holes"
+        # (offsets below its max that it does NOT contain) are the only
+        # places a loss can show — and a correct server has none, which
+        # makes this sweep effectively linear: enumerate each poll's
+        # holes once, then check acked sends only against the (rare)
+        # holey polls that started after their ack.
+        holes_by_key: dict = {}     # key -> [(poll_t, max_o, holes set)]
+        for poll_t, value in polls:
+            for k, pairs in value.items():
+                if not pairs:
+                    continue
+                offsets = {int(p[0]) for p in pairs}
+                max_o = max(offsets)
+                holes = set(range(max_o + 1)) - offsets
+                if holes:
+                    holes_by_key.setdefault(str(k), []).append(
+                        (poll_t, max_o, holes))
+        for ack_t, k, o, m in acked_sends:
+            for poll_t, max_o, holes in holes_by_key.get(k, ()):
+                if poll_t > ack_t and o in holes:
+                    lost.append({"key": k, "offset": o, "msg": m,
+                                 "poll-max-offset": max_o})
+                    break
+
+        # 4. the stored committed mark only advances: every list that
+        # BEGAN after a commit (or an earlier list) COMPLETED must
+        # observe at least that offset per key. One time-sorted sweep
+        # with a running per-key floor; at equal timestamps checks run
+        # before floor-raises (lenient toward concurrency).
+        events = ([(c_t, 1, None, offs) for c_t, offs in commits]
+                  + [(c2, 1, None, offs) for _i, c2, offs in lists]
+                  + [(li_inv, 0, offs, None) for li_inv, _c, offs in lists])
+        floor: dict = {}
+        for _t, _kind, check_offs, raise_offs in sorted(
+                events, key=lambda e: (e[0], e[1])):
+            if check_offs is not None:
+                for k, lo in floor.items():
+                    if check_offs.get(k, -1) < lo:
+                        commit_regressions.append(
+                            {"key": k, "committed": lo,
+                             "observed": check_offs.get(k, -1)})
+            else:
+                for k, o in raise_offs.items():
+                    floor[k] = max(floor.get(k, -1), o)
+
+        problems = {}
+        if divergent:
+            problems["divergent"] = divergent[:16]
+        if order_violations:
+            problems["poll-order"] = order_violations[:16]
+        if lost:
+            problems["lost-writes"] = lost[:16]
+        if commit_regressions:
+            problems["commit-regressions"] = commit_regressions[:16]
+        out = {
+            "valid": not problems,
+            "acked-sends": len(acked_sends),
+            "polls": len(polls),
+            "distinct-offsets": len(assign),
+        }
+        out.update(problems)
+        # a run with no certifiable observations can't certify anything
+        # — but found anomalies always dominate (false beats unknown)
+        if not problems and not acked_sends and not polls and not lists:
+            out["valid"] = "unknown"
+            out["error"] = ("no certifiable kafka observation (send/poll/"
+                            "list) ever succeeded")
+        return out

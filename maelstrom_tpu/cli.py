@@ -27,7 +27,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("-w", "--workload", default="lin-kv",
                    choices=["broadcast", "echo", "g-set", "g-counter",
                             "pn-counter", "lin-kv", "txn-list-append",
-                            "unique-ids"],
+                            "unique-ids", "kafka"],
                    help="What workload to run")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
@@ -186,6 +186,7 @@ DEMOS = [
     {"workload": "txn-list-append",
      "bin": "demo/python/datomic_list_append.py"},
     {"workload": "unique-ids", "bin": "demo/python/unique_ids.py"},
+    {"workload": "kafka", "bin": "demo/python/kafka.py"},
     # native batched node programs (the TPU path's userland)
     {"workload": "broadcast", "node": "tpu:broadcast", "topology": "tree4"},
     {"workload": "g-set", "node": "tpu:g-set"},

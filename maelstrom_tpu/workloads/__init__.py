@@ -6,8 +6,8 @@ from __future__ import annotations
 
 
 def registry() -> dict:
-    from . import (broadcast, echo, g_counter, g_set, lin_kv, pn_counter,
-                   txn_list_append, unique_ids)
+    from . import (broadcast, echo, g_counter, g_set, kafka, lin_kv,
+                   pn_counter, txn_list_append, unique_ids)
     return {
         "broadcast": broadcast.workload,
         "echo": echo.workload,
@@ -17,6 +17,7 @@ def registry() -> dict:
         "lin-kv": lin_kv.workload,
         "txn-list-append": txn_list_append.workload,
         "unique-ids": unique_ids.workload,
+        "kafka": kafka.workload,
     }
 
 

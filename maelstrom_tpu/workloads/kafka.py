@@ -1,0 +1,134 @@
+"""Kafka-style replicated log workload (classic Maelstrom's `kafka`,
+beyond the reference's seven workloads).
+
+Clients append messages to per-key logs (`send`, acked with the
+assigned offset), read logs back (`poll` — servers return each
+requested key's full prefix so every poll is a complete observation),
+and track consumption (`commit_offsets` / `list_committed_offsets`).
+Graded by `checkers/kafka.py`: offset assignments must never diverge,
+polls must be ordered and never lose an acknowledged write, and
+committed offsets must be monotone."""
+
+from __future__ import annotations
+
+import random
+
+from .. import generators as g
+from .. import schema as S
+from ..checkers.kafka import KafkaChecker
+from ..client import defrpc, with_errors
+from . import BaseClient
+
+send_rpc = defrpc(
+    "send",
+    "Appends `msg` to the log named `key`. Servers assign the next "
+    "offset in that log and reply `send_ok` with it; two acknowledged "
+    "sends may never share a (key, offset), and an assignment is "
+    "permanent.",
+    {"type": S.Eq("send"), "key": S.Any, "msg": S.Any},
+    {"type": S.Eq("send_ok"), "offset": S.Any},
+    ns="maelstrom_tpu.workloads.kafka")
+
+poll_rpc = defrpc(
+    "poll",
+    "Requests the contents of the logs named in `keys`. Servers reply "
+    "`poll_ok` with `msgs`: for each key, the list of [offset, msg] "
+    "pairs from the head of that log, in strictly increasing offset "
+    "order.",
+    {"type": S.Eq("poll"), "keys": [S.Any]},
+    {"type": S.Eq("poll_ok"), "msgs": S.Any},
+    ns="maelstrom_tpu.workloads.kafka")
+
+commit_rpc = defrpc(
+    "commit_offsets",
+    "Records that the client has consumed each named log up to the "
+    "given offset. Committed offsets only ever advance.",
+    {"type": S.Eq("commit_offsets"), "offsets": S.Any},
+    {"type": S.Eq("commit_offsets_ok")},
+    ns="maelstrom_tpu.workloads.kafka")
+
+list_rpc = defrpc(
+    "list_committed_offsets",
+    "Requests the committed offset of each named log; replies "
+    "`list_committed_offsets_ok` with an `offsets` map (keys with no "
+    "commit yet may be omitted).",
+    {"type": S.Eq("list_committed_offsets"), "keys": [S.Any]},
+    {"type": S.Eq("list_committed_offsets_ok"), "offsets": S.Any},
+    ns="maelstrom_tpu.workloads.kafka")
+
+
+class KafkaClient(BaseClient):
+    """Workers poll everything (full observation) and commit what they
+    have seen: `last_polled` tracks each key's max polled offset, so a
+    commit claims exactly what this worker actually consumed."""
+
+    def __init__(self, net, conn=None, node=None, keys=4):
+        super().__init__(net, conn, node)
+        self.keys = keys
+        self.last_polled: dict = {}
+
+    def open(self, test, node):
+        from ..client import SyncClient
+        return type(self)(self.net, SyncClient(self.net), node,
+                          keys=self.keys)
+
+    def invoke(self, test, op):
+        key_names = [str(k) for k in range(self.keys)]
+
+        def go():
+            if op["f"] == "send":
+                k, m = op["value"]
+                res = send_rpc(self.conn, self.node,
+                               {"key": str(k), "msg": m})
+                return {**op, "type": "ok",
+                        "value": [str(k), m, res["offset"]]}
+            if op["f"] == "poll":
+                res = poll_rpc(self.conn, self.node, {"keys": key_names})
+                msgs = res["msgs"]
+                for k, pairs in msgs.items():
+                    if pairs:
+                        self.last_polled[k] = max(
+                            self.last_polled.get(k, -1),
+                            max(int(p[0]) for p in pairs))
+                return {**op, "type": "ok", "value": msgs}
+            if op["f"] == "commit":
+                offs = dict(self.last_polled)
+                if not offs:
+                    return {**op, "type": "ok", "value": {}}
+                commit_rpc(self.conn, self.node, {"offsets": offs})
+                return {**op, "type": "ok", "value": offs}
+            res = list_rpc(self.conn, self.node, {"keys": key_names})
+            return {**op, "type": "ok", "value": res["offsets"]}
+        return with_errors(op, {"poll", "list"}, go)
+
+
+class KafkaOpGen:
+    """Picklable op source: weighted mix of sends (per-key counters so
+    every message is unique), polls, commits, and committed-offset
+    reads."""
+
+    def __init__(self, seed: int, keys: int = 4):
+        self.rng = random.Random(seed)
+        self.keys = keys
+        self.counter = 0
+
+    def __call__(self):
+        r = self.rng.random()
+        if r < 0.5:
+            self.counter += 1
+            k = self.counter % self.keys
+            return {"f": "send", "value": [k, self.counter]}
+        if r < 0.8:
+            return {"f": "poll"}
+        if r < 0.9:
+            return {"f": "commit"}
+        return {"f": "list"}
+
+
+def workload(opts: dict) -> dict:
+    keys = int(opts.get("key_count") or 4)
+    return {
+        "client": KafkaClient(opts["net"], keys=keys),
+        "generator": g.Fn(KafkaOpGen(opts.get("seed", 0), keys)),
+        "checker": KafkaChecker(),
+    }

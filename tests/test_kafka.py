@@ -1,0 +1,88 @@
+"""Kafka workload: checker unit tests on literal histories — legal and
+forged (divergent assignment, unordered poll, lost write, committed-
+offset regression) — plus indeterminate-op semantics."""
+
+from maelstrom_tpu.checkers.kafka import KafkaChecker
+from maelstrom_tpu.history import History, Op
+
+
+def _h(ops):
+    return History([Op(**o) for o in ops])
+
+
+def _op(f, t, value, type="ok", process=0):
+    return [
+        {"type": "invoke", "f": f, "process": process, "time": t,
+         "value": None},
+        {"type": type, "f": f, "process": process, "time": t + 1,
+         "value": value},
+    ]
+
+
+def test_legal_history():
+    ops = (_op("send", 0, ["0", 10, 0])
+           + _op("send", 10, ["0", 11, 1])
+           + _op("poll", 20, {"0": [[0, 10], [1, 11]]})
+           + _op("commit", 30, {"0": 1})
+           + _op("list", 40, {"0": 1}))
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+    assert r["acked-sends"] == 2 and r["distinct-offsets"] == 2
+
+
+def test_divergent_offset_detected():
+    ops = (_op("send", 0, ["0", 10, 0])
+           + _op("poll", 20, {"0": [[0, 999]]}))     # same offset, other msg
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["divergent"][0]["offset"] == 0
+
+
+def test_unordered_poll_detected():
+    ops = _op("poll", 0, {"0": [[1, 11], [0, 10]]})
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert "poll-order" in r
+
+
+def test_lost_write_detected():
+    # send acked at offset 0; a later poll reads past it without it
+    ops = (_op("send", 0, ["0", 10, 0])
+           + _op("poll", 20, {"0": [[1, 11]]}))
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["lost-writes"][0]["offset"] == 0
+
+
+def test_commit_regression_detected():
+    ops = (_op("commit", 0, {"0": 5})
+           + _op("list", 20, {"0": 3}))              # observed < committed
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["commit-regressions"][0]["committed"] == 5
+
+
+def test_lower_commit_request_is_legal():
+    # a second worker committing a lower offset must NOT fail the run:
+    # the stored mark clamps, and the later list sees the higher one
+    ops = (_op("commit", 0, {"0": 5})
+           + _op("commit", 10, {"0": 2}, process=1)
+           + _op("list", 20, {"0": 5}))
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+
+
+def test_indeterminate_send_unconstrained():
+    # an info send's offset was never observed: later polls owe nothing
+    ops = (_op("send", 0, None, type="info")
+           + _op("poll", 20, {"0": [[0, 10]]}))
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+
+
+def test_concurrent_list_not_flagged():
+    # list B invoked BEFORE commit completed: no ordering obligation
+    ops = (_op("commit", 10, {"0": 5})
+           + _op("list", 10, {"0": 3}))     # overlaps the commit
+    r = KafkaChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
