@@ -194,6 +194,7 @@ DEMOS = [
     {"workload": "lin-kv", "node": "tpu:lin-kv"},
     {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
     {"workload": "unique-ids", "node": "tpu:unique-ids"},
+    {"workload": "kafka", "node": "tpu:kafka"},
 ]
 
 

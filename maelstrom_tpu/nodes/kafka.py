@@ -1,0 +1,355 @@
+"""Batched kafka-style replicated log (serving `workloads/kafka.py`;
+the TPU-native counterpart of `demo/python/kafka.py`).
+
+Design — ownership for assignment, anti-entropy for reads:
+
+  - key k is OWNED by node k % N: only the owner appends (exclusive
+    offset assignment with no coordination — the CAS loop of the demo
+    becomes a plain array append, because ownership already serializes)
+    and a send arriving elsewhere fails definitely with error 11, which
+    the workload records as a clean :fail and retries elsewhere;
+  - every node REPLICATES every log: each round, each edge carries one
+    lane per key with (my_len, offset_being_sent, msg) — a node sends
+    the entry at the offset its neighbor last advertised, and appends
+    an incoming entry only when it lands exactly at its own length.
+    In-order, idempotent, loss-tolerant (the next round re-offers), and
+    hole-free by construction — which is exactly the full-prefix
+    contract the kafka checker's lost-write rule leans on;
+  - polls are served from ANY node's replica, materialized host-side
+    from the node's state row at completion time (needs_state_reads);
+  - committed offsets live on node 0 (the coordinator): commit/list
+    elsewhere fail definitely with error 11. Commit maps pack into the
+    three wire words (up to 6 keys x 15-bit offsets, checked at
+    encode), so the marks advance on-device with a max — monotone by
+    construction, and rule 4's real-time obligation holds because every
+    observation serializes through the one coordinator row."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
+from ..net.tpu import I32
+from ..workloads.broadcast import TOPOLOGIES, topology_indices
+from . import EncodeCapacityError, NodeProgram, register
+
+T_SEND = 10        # a = key, b = interned msg
+T_SEND_OK = 11     # a = offset
+T_POLL = 12
+T_POLL_OK = 13     # payload materialized host-side (needs_state_reads)
+T_COMMIT = 14      # a|b|c = packed per-key offsets (+1, 16 bits each)
+T_COMMIT_OK = 15
+T_LIST = 16
+T_LIST_OK = 17     # a|b|c = packed committed offsets (+1)
+T_ERROR = 1        # a = code
+T_REPL = 20        # edge lane k: a = sender len, b = offset, c = msg
+
+MAX_PACK_KEYS = 6  # 2 x 16-bit fields per wire word, 3 words
+
+
+def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
+    words = [0, 0, 0]
+    for k in range(keys):
+        o = offs.get(str(k), offs.get(k))
+        if o is None:
+            continue
+        if o >= 0x7FFF:
+            raise EncodeCapacityError(
+                f"kafka committed offset {o} exceeds the 15-bit wire "
+                f"field")
+        words[k // 2] |= (int(o) + 1) << (16 * (k % 2))
+    return words[0], words[1], words[2]
+
+
+def _unpack_offsets(a: int, b: int, c: int, keys: int) -> dict:
+    out = {}
+    for k in range(keys):
+        v = ((a, b, c)[k // 2] >> (16 * (k % 2))) & 0xFFFF
+        if v:
+            out[str(k)] = v - 1
+    return out
+
+
+@register
+class KafkaProgram(NodeProgram):
+    name = "kafka"
+    is_edge = True
+    needs_state_reads = True            # polls materialize replica rows
+    # logs are append-only and replicas hole-free, and poll replies
+    # carry their reply-round lengths — an end-of-stretch state read
+    # sliced to those lengths is exact, so the collect-replies fast
+    # path stays sound (same argument as txn_list_append)
+    state_reads_final = True
+    tolerates_channel_overwrites = True  # lanes re-offer every round
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        self.K = int(opts.get("key_count") or 4)
+        if self.K > MAX_PACK_KEYS:
+            raise ValueError(
+                f"kafka supports at most {MAX_PACK_KEYS} keys on the "
+                f"wire (got {self.K}); raise MAX_PACK_KEYS or shard")
+        rate = float(opts.get("rate") or 0.0)
+        tl = float(opts.get("time_limit") or 0.0)
+        # cap+1 must fit a 15-bit packed length field ((len+1) << 16
+        # stays positive in int32)
+        self.cap = int(opts.get("log_cap",
+                                min(max(64, int(rate * tl) + 32), 0x7FFE)))
+        topo = TOPOLOGIES["total"](nodes)
+        nb = topology_indices(topo, nodes)
+        self.neighbors = jnp.asarray(nb)
+        self.rev = jnp.asarray(reverse_index(nb))
+        self.D = int(self.neighbors.shape[1])
+        self.lanes = self.K                 # one replication lane per key
+        from . import edge_capacity, edge_timing
+        self.ring, _retry, _lat = edge_timing(opts, len(nodes))
+        self.inbox_cap = int(opts.get("inbox_cap", 4))
+        self.outbox_cap = self.inbox_cap
+        spill, chan_lanes, uniform = edge_capacity(opts, self)
+        if spill or chan_lanes != self.lanes:
+            raise ValueError("kafka lanes are positional (one per key); "
+                             "spill must be off")
+        self._host_polled: dict = {}   # key -> max offset seen by polls
+        self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
+                                   lanes=self.lanes, ring=self.ring,
+                                   uniform_arrival=uniform)
+
+    def init_state(self):
+        N, K, C = self.n_nodes, self.K, self.cap
+        z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+        return {
+            "log": z(N, K, C),           # interned msg per offset
+            "log_len": z(N, K),
+            "peer_len": z(N, self.D, K),  # neighbor's last advertised len
+            "committed": jnp.full((N, K), -1, I32),   # node 0's row rules
+            "log_overflow": z(N),
+        }
+
+    def invalid_counters(self, state):
+        return {"log-overflow": state["log_overflow"]}
+
+    def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        N, K, C, D = self.n_nodes, self.K, self.cap, self.D
+        s = dict(state)
+        me = jnp.arange(N, dtype=I32)
+
+        # ---------------- inbound replication (lane k = key k)
+        rep_valid = edge_in.valid & (edge_in.type == T_REPL)  # [N, D, K]
+        s["peer_len"] = jnp.where(rep_valid, edge_in.a, s["peer_len"])
+        # accept the offered entry iff it lands exactly at my length
+        # (in-order => hole-free replicas); several edges may offer the
+        # same next entry — owners assign uniquely, so any accepted
+        # duplicate writes the same value and a single pick suffices
+        offer = rep_valid & (edge_in.b == s["log_len"][:, None, :]) \
+            & (edge_in.b < edge_in.a) & (edge_in.b < C)
+        any_offer = offer.any(axis=1)                         # [N, K]
+        pick = jnp.argmax(offer, axis=1)                      # [N, K]
+        val = jnp.take_along_axis(edge_in.c, pick[:, None, :],
+                                  axis=1)[:, 0]               # [N, K]
+        pos = jnp.where(any_offer, s["log_len"], C)   # C = dropped
+        s["log"] = s["log"].at[
+            me[:, None], jnp.arange(K, dtype=I32)[None, :], pos].set(
+                val, mode="drop")
+        s["log_len"] = s["log_len"] + any_offer.astype(I32)
+
+        # ---------------- client requests (inbox_cap is tiny: unrolled)
+        A = client_in.valid.shape[1]
+        outs = []
+        is_leader0 = me == 0
+        for j in range(A):
+            v = client_in.valid[:, j]
+            t = client_in.type[:, j]
+            key = jnp.clip(client_in.a[:, j], 0, K - 1)
+            owner = (key % N) == me
+            # send: owner appends (offset = len before)
+            is_send = v & (t == T_SEND)
+            full = jnp.take_along_axis(s["log_len"], key[:, None],
+                                       axis=1)[:, 0] >= C
+            do_send = is_send & owner & ~full
+            off = jnp.take_along_axis(s["log_len"], key[:, None],
+                                      axis=1)[:, 0]
+            s["log"] = s["log"].at[
+                me, key, jnp.where(do_send, off, C)].set(
+                    client_in.b[:, j], mode="drop")
+            s["log_len"] = s["log_len"].at[me, key].add(
+                do_send.astype(I32))
+            s["log_overflow"] = s["log_overflow"] + (
+                is_send & owner & full).astype(I32)
+            # commit: node 0 maxes its committed row with the packed map
+            is_cmt = v & (t == T_COMMIT) & is_leader0
+            for k in range(K):
+                w = (client_in.a[:, j], client_in.b[:, j],
+                     client_in.c[:, j])[k // 2]
+                o = ((w >> (16 * (k % 2))) & 0xFFFF) - 1
+                s["committed"] = s["committed"].at[:, k].max(
+                    jnp.where(is_cmt, o, -1))
+            is_list = v & (t == T_LIST) & is_leader0
+            la, lb, lc = [jnp.zeros((N,), I32) for _ in range(3)]
+            for k in range(K):
+                word = jnp.where(s["committed"][:, k] >= 0,
+                                 (s["committed"][:, k] + 1)
+                                 << (16 * (k % 2)), 0)
+                if k // 2 == 0:
+                    la = la | word
+                elif k // 2 == 1:
+                    lb = lb | word
+                else:
+                    lc = lc | word
+            is_poll = v & (t == T_POLL)
+            misrouted = v & (((t == T_SEND) & ~owner)
+                             | (((t == T_COMMIT) | (t == T_LIST))
+                                & ~is_leader0))
+            send_full = is_send & owner & full
+            # poll replies carry the per-key log lengths in the same
+            # packed form as committed offsets: completions slice the
+            # (append-only) log to the REPLY-round lengths, which makes
+            # end-of-stretch state reads exact and lets the runner keep
+            # the collect-replies fast path (state_reads_final)
+            pa, pb, pc = [jnp.zeros((N,), I32) for _ in range(3)]
+            for k in range(K):
+                word = (s["log_len"][:, k] + 1) << (16 * (k % 2))
+                if k // 2 == 0:
+                    pa = pa | word
+                elif k // 2 == 1:
+                    pb = pb | word
+                else:
+                    pc = pc | word
+            rtype = jnp.where(
+                do_send, T_SEND_OK,
+                jnp.where(is_cmt, T_COMMIT_OK,
+                          jnp.where(is_list, T_LIST_OK,
+                                    jnp.where(is_poll, T_POLL_OK,
+                                              T_ERROR))))
+            # commit replies echo the committed map (the history's
+            # completion must carry it for the checker's rule 4);
+            # errors: 11 = misrouted, 14 = log full (both definite)
+            ra = jnp.where(
+                do_send, off,
+                jnp.where(is_cmt, client_in.a[:, j],
+                          jnp.where(is_list, la,
+                                    jnp.where(is_poll, pa,
+                                              jnp.where(send_full, 14,
+                                                        11)))))
+            rb = jnp.where(is_cmt, client_in.b[:, j],
+                           jnp.where(is_list, lb,
+                                     jnp.where(is_poll, pb, 0)))
+            rc = jnp.where(is_cmt, client_in.c[:, j],
+                           jnp.where(is_list, lc,
+                                     jnp.where(is_poll, pc, 0)))
+            say = v & (do_send | is_cmt | is_list | is_poll | misrouted
+                       | send_full)
+            outs.append((say, client_in.src[:, j], rtype, ra, rb, rc,
+                         client_in.mid[:, j]))
+
+        out_valid = jnp.stack([o[0] for o in outs], axis=1)
+        client_out = client_in.replace(
+            valid=out_valid,
+            dest=jnp.stack([o[1] for o in outs], axis=1),
+            type=jnp.stack([o[2] for o in outs], axis=1),
+            a=jnp.stack([o[3] for o in outs], axis=1),
+            b=jnp.stack([o[4] for o in outs], axis=1),
+            c=jnp.stack([o[5] for o in outs], axis=1),
+            reply_to=jnp.stack([o[6] for o in outs], axis=1),
+            src=jnp.broadcast_to(me[:, None], (N, A)))
+
+        # ---------------- outbound replication: offer each neighbor,
+        # per key, the entry at the offset it last advertised as its len
+        want = s["peer_len"]                                   # [N, D, K]
+        have = s["log_len"][:, None, :]
+        posT = jnp.clip(want, 0, C - 1).transpose(0, 2, 1)     # [N, K, D]
+        entry = jnp.take_along_axis(s["log"], posT,
+                                    axis=2).transpose(0, 2, 1)  # [N,D,K]
+        edge_out = EdgeMsgs(
+            valid=jnp.ones((N, D, K), bool) & (self.neighbors >= 0)[:, :, None],
+            type=jnp.full((N, D, K), T_REPL, I32),
+            a=jnp.broadcast_to(have, (N, D, K)),
+            b=want,
+            c=jnp.where(want < have, entry, 0))
+
+        return s, edge_out, client_out
+
+    def quiescent(self, state):
+        # replication lanes re-offer every round; never quiescent while
+        # any neighbor trails (conservative: always active)
+        return jnp.array(False)
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        f = op["f"]
+        if f == "send":
+            k, m = op["value"]
+            return {"type": "send", "key": int(k), "msg": m}
+        if f == "poll":
+            return {"type": "poll"}
+        if f == "commit":
+            # the TPU path drives ops through the program, not the
+            # workload's stateful client, so the program tracks what
+            # has been polled (host-side bookkeeping: the max offset
+            # any completed poll observed per key — a legal commit
+            # claim, deterministic given the history)
+            offs = op.get("value") or dict(self._host_polled)
+            return {"type": "commit_offsets", "offsets": offs}
+        return {"type": "list_committed_offsets"}
+
+    def encode_body(self, body, intern):
+        t = body["type"]
+        if t == "send":
+            return (T_SEND, int(body["key"]), intern.id(body["msg"]), 0)
+        if t == "poll":
+            return (T_POLL, 0, 0, 0)
+        if t == "commit_offsets":
+            a, b, c = _pack_offsets(body["offsets"], self.K)
+            return (T_COMMIT, a, b, c)
+        return (T_LIST, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_SEND_OK:
+            return {"type": "send_ok", "offset": int(a)}
+        if t == T_COMMIT_OK:
+            return {"type": "commit_offsets_ok",
+                    "offsets": _unpack_offsets(int(a), int(b), int(c),
+                                               self.K)}
+        if t == T_LIST_OK:
+            return {"type": "list_committed_offsets_ok",
+                    "offsets": _unpack_offsets(int(a), int(b), int(c),
+                                               self.K)}
+        if t == T_POLL_OK:
+            return {"type": "poll_ok",
+                    "lens": _unpack_offsets(int(a), int(b), int(c),
+                                            self.K)}
+        if t == T_ERROR:
+            return {"type": "error", "code": int(a),
+                    "text": ("log full" if int(a) == 14 else
+                             "misrouted (owner/coordinator elsewhere)")}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        import numpy as np
+        if body["type"] == "send_ok":
+            k, m = op["value"]
+            return {**op, "type": "ok",
+                    "value": [str(k), m, body["offset"]]}
+        if body["type"] == "poll_ok":
+            # the reply words carry the REPLY-round per-key lengths;
+            # slicing the final (append-only) log to them reconstructs
+            # the exact replica prefix of the reply round, which is
+            # what makes end-of-stretch state reads sound here
+            row = read_state()
+            log = np.asarray(row["log"])
+            reply_lens = body.get("lens", {})
+            msgs = {}
+            for k in range(self.K):
+                n = int(reply_lens.get(str(k), 0))
+                if n:
+                    msgs[str(k)] = [[o, intern.value(int(log[k, o]))]
+                                    for o in range(n)]
+                    self._host_polled[str(k)] = max(
+                        self._host_polled.get(str(k), -1), n - 1)
+            return {**op, "type": "ok", "value": msgs}
+        if body["type"] == "commit_offsets_ok":
+            return {**op, "type": "ok", "value": body.get("offsets", {})}
+        if body["type"] == "list_committed_offsets_ok":
+            return {**op, "type": "ok", "value": body["offsets"]}
+        return {**op, "type": "ok"}
