@@ -8,13 +8,16 @@ Design — ownership for assignment, anti-entropy for reads:
     becomes a plain array append, because ownership already serializes)
     and a send arriving elsewhere fails definitely with error 11, which
     the workload records as a clean :fail and retries elsewhere;
-  - every node REPLICATES every log: each round, each edge carries one
-    lane per key with (my_len, offset_being_sent, msg) — a node sends
-    the entry at the offset its neighbor last advertised, and appends
-    an incoming entry only when it lands exactly at its own length.
-    In-order, idempotent, loss-tolerant (the next round re-offers), and
-    hole-free by construction — which is exactly the full-prefix
-    contract the kafka checker's lost-write rule leans on;
+  - every node REPLICATES every log over one edge lane per key carrying
+    (my_len, offset_being_sent, msg): a node offers the entry at the
+    offset its neighbor last advertised — re-offered EVERY round while
+    the neighbor trails, so entry loss/overwrite only delays — and a
+    node appends an incoming entry only when it lands exactly at its
+    own length (in-order, idempotent, hole-free: the full-prefix
+    contract the kafka checker's lost-write rule leans on). Length
+    advertisements (the ack channel) are event-driven — a node whose
+    length changed advertises next round — plus a `beat_rounds`
+    heartbeat that bounds recovery when an ack itself is lost;
   - polls are served from ANY node's replica, materialized host-side
     from the node's state row at completion time (needs_state_reads);
   - committed offsets live on node 0 (the coordinator): commit/list
@@ -81,7 +84,10 @@ class KafkaProgram(NodeProgram):
     # sliced to those lengths is exact, so the collect-replies fast
     # path stays sound (same argument as txn_list_append)
     state_reads_final = True
-    tolerates_channel_overwrites = True  # lanes re-offer every round
+    # entry offers repeat every round while a neighbor trails, and a
+    # lost length-ack is re-covered by the beat heartbeat — so a
+    # collision-overwritten lane message only ever delays
+    tolerates_channel_overwrites = True
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
@@ -111,6 +117,7 @@ class KafkaProgram(NodeProgram):
             raise ValueError("kafka lanes are positional (one per key); "
                              "spill must be off")
         self._host_polled: dict = {}   # key -> max offset seen by polls
+        self.beat_rounds = int(opts.get("beat_rounds", 64))
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=self.lanes, ring=self.ring,
                                    uniform_arrival=uniform)
@@ -152,6 +159,7 @@ class KafkaProgram(NodeProgram):
             me[:, None], jnp.arange(K, dtype=I32)[None, :], pos].set(
                 val, mode="drop")
         s["log_len"] = s["log_len"] + any_offer.astype(I32)
+        changed = any_offer                            # [N, K] len grew
 
         # ---------------- client requests (inbox_cap is tiny: unrolled)
         A = client_in.valid.shape[1]
@@ -174,6 +182,9 @@ class KafkaProgram(NodeProgram):
                     client_in.b[:, j], mode="drop")
             s["log_len"] = s["log_len"].at[me, key].add(
                 do_send.astype(I32))
+            changed = changed | (do_send[:, None]
+                                 & (jnp.arange(K, dtype=I32)[None, :]
+                                    == key[:, None]))
             s["log_overflow"] = s["log_overflow"] + (
                 is_send & owner & full).astype(I32)
             # commit: node 0 maxes its committed row with the packed map
@@ -260,8 +271,19 @@ class KafkaProgram(NodeProgram):
         posT = jnp.clip(want, 0, C - 1).transpose(0, 2, 1)     # [N, K, D]
         entry = jnp.take_along_axis(s["log"], posT,
                                     axis=2).transpose(0, 2, 1)  # [N,D,K]
+        # a lane fires when it has an entry to offer (every round while
+        # the neighbor trails — the loss-tolerant re-offer), when this
+        # node's length CHANGED this round (the ack: an accepted entry
+        # advertises the new length immediately, so catch-up pipelines
+        # at ~1 entry per 2 rounds instead of 1 per beat), or on the
+        # low-cadence beat (default 64 rounds = 64 ms — the anti-
+        # entropy timer that bounds recovery when an ack is lost).
+        # Always-on lanes cost ~2,400 server msgs-per-op at interactive
+        # rates for zero information.
+        beat = (ctx["round"] % self.beat_rounds) == 0
         edge_out = EdgeMsgs(
-            valid=jnp.ones((N, D, K), bool) & (self.neighbors >= 0)[:, :, None],
+            valid=(((want < have) | beat | changed[:, None, :])
+                   & (self.neighbors >= 0)[:, :, None]),
             type=jnp.full((N, D, K), T_REPL, I32),
             a=jnp.broadcast_to(have, (N, D, K)),
             b=want,
@@ -270,8 +292,7 @@ class KafkaProgram(NodeProgram):
         return s, edge_out, client_out
 
     def quiescent(self, state):
-        # replication lanes re-offer every round; never quiescent while
-        # any neighbor trails (conservative: always active)
+        # conservative: the beat timer ticks forever
         return jnp.array(False)
 
     # --- host boundary ---
