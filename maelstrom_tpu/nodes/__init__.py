@@ -128,6 +128,14 @@ class NodeProgram:
         """Generator op -> protocol body dict, or HOST."""
         raise NotImplementedError
 
+    def node_for_op(self, op: dict):
+        """Optional smart-client routing: the node index this op should
+        be sent to, or None for the worker's bound node (the default).
+        Real Maelstrom clients choose who they talk to (e.g. kafka
+        clients route to partition owners); programs whose RPCs have a
+        natural home override this."""
+        return None
+
     def encode_body(self, body: dict, intern: Intern):
         """Protocol body -> (type, a, b, c) words."""
         raise NotImplementedError

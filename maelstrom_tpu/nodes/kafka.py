@@ -49,6 +49,7 @@ T_ERROR = 1        # a = code
 T_REPL = 20        # edge lane k: a = sender len, b = offset, c = msg
 
 MAX_PACK_KEYS = 6  # 2 x 16-bit fields per wire word, 3 words
+COORDINATOR = 0    # node holding the authoritative committed-offset row
 
 
 def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
@@ -164,7 +165,7 @@ class KafkaProgram(NodeProgram):
         # ---------------- client requests (inbox_cap is tiny: unrolled)
         A = client_in.valid.shape[1]
         outs = []
-        is_leader0 = me == 0
+        is_leader0 = me == COORDINATOR
         for j in range(A):
             v = client_in.valid[:, j]
             t = client_in.type[:, j]
@@ -296,6 +297,22 @@ class KafkaProgram(NodeProgram):
         return jnp.array(False)
 
     # --- host boundary ---
+
+    def owner_of(self, key: int) -> int:
+        """The single source of truth for key ownership — edge_step's
+        on-device owner mask and the host-side routing must agree."""
+        return int(key) % self.n_nodes
+
+    def node_for_op(self, op):
+        # smart-client routing (like real kafka clients): sends go to
+        # the key's owner, commit/list to the coordinator; polls are
+        # served by any replica (the worker's bound node — which is
+        # what makes polls observe replication, not just the owner)
+        if op["f"] == "send":
+            return self.owner_of(op["value"][0])
+        if op["f"] in ("commit", "list"):
+            return COORDINATOR
+        return None
 
     def request_for_op(self, op):
         f = op["f"]

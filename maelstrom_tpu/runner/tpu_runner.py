@@ -433,7 +433,20 @@ class TpuRunner:
                     gen = self._complete(history, gen, ctx, process,
                                          completed, free)
                 else:
-                    node_idx = process % N
+                    # default routing: worker's bound node. A program
+                    # may route specific ops (smart-client routing, the
+                    # way real kafka clients route to partition
+                    # leaders): node_for_op returns an index or None
+                    routed = self.program.node_for_op(op)
+                    if routed is None:
+                        node_idx = process % N
+                    else:
+                        node_idx = int(routed)
+                        if not 0 <= node_idx < N:
+                            raise ValueError(
+                                f"{self.program.name}.node_for_op "
+                                f"returned {routed} for a {N}-node "
+                                f"cluster")
                     body = program.request_for_op(op)
                     if body is HOST:
                         completed = program.host_op(
