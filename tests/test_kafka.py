@@ -88,6 +88,21 @@ def test_concurrent_list_not_flagged():
     assert r["valid"] is True
 
 
+def test_node_for_op_routing():
+    # the smart-client hook routes sends to owners and commit/list to
+    # the coordinator; polls stay on the worker's bound node (None)
+    from maelstrom_tpu.nodes import get_program
+
+    p = get_program("kafka", {"key_count": 4}, ["n0", "n1", "n2"])
+    assert p.node_for_op({"f": "send", "value": [5, 99]}) == 5 % 3
+    assert p.node_for_op({"f": "commit", "value": None}) == 0
+    assert p.node_for_op({"f": "list", "value": None}) == 0
+    assert p.node_for_op({"f": "poll", "value": None}) is None
+    # default hook: no routing
+    echo = get_program("echo", {}, ["n0", "n1"])
+    assert echo.node_for_op({"f": "echo", "value": "x"}) is None
+
+
 def test_kafka_tpu_e2e():
     """The batched program end to end: ownership-assigned offsets,
     anti-entropy replication feeding full-prefix polls, coordinator-
