@@ -66,6 +66,18 @@ def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
     return words[0], words[1], words[2]
 
 
+def _device_pack(vals_plus1):
+    """[N, K] int32 (0 = absent, v+1 otherwise) -> three packed wire
+    words, the device half of _pack_offsets' convention (16-bit fields,
+    2 per word)."""
+    import jax.numpy as jnp
+    words = [jnp.zeros((vals_plus1.shape[0],), I32) for _ in range(3)]
+    for k in range(vals_plus1.shape[1]):
+        words[k // 2] = words[k // 2] | (vals_plus1[:, k]
+                                         << (16 * (k % 2)))
+    return words
+
+
 def _unpack_offsets(a: int, b: int, c: int, keys: int) -> dict:
     out = {}
     for k in range(keys):
@@ -197,17 +209,8 @@ class KafkaProgram(NodeProgram):
                 s["committed"] = s["committed"].at[:, k].max(
                     jnp.where(is_cmt, o, -1))
             is_list = v & (t == T_LIST) & is_leader0
-            la, lb, lc = [jnp.zeros((N,), I32) for _ in range(3)]
-            for k in range(K):
-                word = jnp.where(s["committed"][:, k] >= 0,
-                                 (s["committed"][:, k] + 1)
-                                 << (16 * (k % 2)), 0)
-                if k // 2 == 0:
-                    la = la | word
-                elif k // 2 == 1:
-                    lb = lb | word
-                else:
-                    lc = lc | word
+            la, lb, lc = _device_pack(
+                jnp.where(s["committed"] >= 0, s["committed"] + 1, 0))
             is_poll = v & (t == T_POLL)
             misrouted = v & (((t == T_SEND) & ~owner)
                              | (((t == T_COMMIT) | (t == T_LIST))
@@ -218,15 +221,7 @@ class KafkaProgram(NodeProgram):
             # (append-only) log to the REPLY-round lengths, which makes
             # end-of-stretch state reads exact and lets the runner keep
             # the collect-replies fast path (state_reads_final)
-            pa, pb, pc = [jnp.zeros((N,), I32) for _ in range(3)]
-            for k in range(K):
-                word = (s["log_len"][:, k] + 1) << (16 * (k % 2))
-                if k // 2 == 0:
-                    pa = pa | word
-                elif k // 2 == 1:
-                    pb = pb | word
-                else:
-                    pc = pc | word
+            pa, pb, pc = _device_pack(s["log_len"] + 1)
             rtype = jnp.where(
                 do_send, T_SEND_OK,
                 jnp.where(is_cmt, T_COMMIT_OK,
@@ -300,16 +295,20 @@ class KafkaProgram(NodeProgram):
 
     def owner_of(self, key: int) -> int:
         """The single source of truth for key ownership — edge_step's
-        on-device owner mask and the host-side routing must agree."""
+        on-device owner mask and the host-side routing must agree.
+        Only defined for in-range keys (encode_body rejects the rest)."""
         return int(key) % self.n_nodes
 
     def node_for_op(self, op):
         # smart-client routing (like real kafka clients): sends go to
         # the key's owner, commit/list to the coordinator; polls are
         # served by any replica (the worker's bound node — which is
-        # what makes polls observe replication, not just the owner)
+        # what makes polls observe replication, not just the owner).
+        # Out-of-range keys aren't routed: encode_body fails them
+        # definitely before they reach any node.
         if op["f"] == "send":
-            return self.owner_of(op["value"][0])
+            k = int(op["value"][0])
+            return self.owner_of(k) if 0 <= k < self.K else None
         if op["f"] in ("commit", "list"):
             return COORDINATOR
         return None
@@ -334,6 +333,12 @@ class KafkaProgram(NodeProgram):
     def encode_body(self, body, intern):
         t = body["type"]
         if t == "send":
+            if not 0 <= int(body["key"]) < self.K:
+                # the device clips keys into range, which would silently
+                # append to the WRONG log; fail the op definitely instead
+                raise EncodeCapacityError(
+                    f"kafka key {body['key']} outside configured "
+                    f"key_count {self.K}")
             return (T_SEND, int(body["key"]), intern.id(body["msg"]), 0)
         if t == "poll":
             return (T_POLL, 0, 0, 0)

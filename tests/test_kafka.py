@@ -94,10 +94,18 @@ def test_node_for_op_routing():
     from maelstrom_tpu.nodes import get_program
 
     p = get_program("kafka", {"key_count": 4}, ["n0", "n1", "n2"])
-    assert p.node_for_op({"f": "send", "value": [5, 99]}) == 5 % 3
+    assert p.node_for_op({"f": "send", "value": [2, 99]}) == 2 % 3
     assert p.node_for_op({"f": "commit", "value": None}) == 0
     assert p.node_for_op({"f": "list", "value": None}) == 0
     assert p.node_for_op({"f": "poll", "value": None}) is None
+    # out-of-range keys aren't routed — and encode rejects them with a
+    # definite failure (the device would otherwise clip into the WRONG
+    # key's log)
+    assert p.node_for_op({"f": "send", "value": [5, 99]}) is None
+    import pytest as _pytest
+    from maelstrom_tpu.nodes import EncodeCapacityError, Intern
+    with _pytest.raises(EncodeCapacityError):
+        p.encode_body({"type": "send", "key": 5, "msg": 1}, Intern())
     # default hook: no routing
     echo = get_program("echo", {}, ["n0", "n1"])
     assert echo.node_for_op({"f": "echo", "value": "x"}) is None
