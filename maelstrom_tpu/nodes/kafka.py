@@ -70,7 +70,6 @@ def _device_pack(vals_plus1):
     """[N, K] int32 (0 = absent, v+1 otherwise) -> three packed wire
     words, the device half of _pack_offsets' convention (16-bit fields,
     2 per word)."""
-    import jax.numpy as jnp
     words = [jnp.zeros((vals_plus1.shape[0],), I32) for _ in range(3)]
     for k in range(vals_plus1.shape[1]):
         words[k // 2] = words[k // 2] | (vals_plus1[:, k]

@@ -95,6 +95,7 @@ def test_node_for_op_routing():
 
     p = get_program("kafka", {"key_count": 4}, ["n0", "n1", "n2"])
     assert p.node_for_op({"f": "send", "value": [2, 99]}) == 2 % 3
+    assert p.node_for_op({"f": "send", "value": [3, 99]}) == 0  # wraps
     assert p.node_for_op({"f": "commit", "value": None}) == 0
     assert p.node_for_op({"f": "list", "value": None}) == 0
     assert p.node_for_op({"f": "poll", "value": None}) is None
