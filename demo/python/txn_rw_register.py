@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Transactional read/write registers behind one lin-kv register (the
+txn-rw-register workload): the whole key space is a JSON map under a
+single linearizable root, transactions apply functionally to a copy,
+and a compare-and-set commits — the same shared-state transactor shape
+as demo/python/datomic_shared_state.py, with register semantics. A
+lost CAS race aborts with error 30 (txn-conflict, definite)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from node import Node, RPCError  # noqa: E402
+
+node = Node()
+ROOT = "root"
+
+
+def apply_txn(db: dict, txn: list):
+    db = dict(db)
+    out = []
+    for f, k, v in txn:
+        key = str(k)
+        if f == "r":
+            out.append([f, k, db.get(key)])
+        elif f == "w":
+            db[key] = v
+            out.append([f, k, v])
+        else:
+            raise RPCError.not_supported(f"unknown micro-op {f!r}")
+    return db, out
+
+
+@node.on("txn")
+def handle_txn(msg):
+    txn = msg["body"]["txn"]
+    try:
+        cur = node.sync_rpc("lin-kv", {"type": "read", "key": ROOT})
+        db = cur["value"] or {}
+    except RPCError as e:
+        if e.code != 20:
+            raise
+        db = {}
+    db2, completed = apply_txn(db, txn)
+    try:
+        node.sync_rpc("lin-kv", {"type": "cas", "key": ROOT,
+                                 "from": db, "to": db2,
+                                 "create_if_not_exists": True})
+    except RPCError as e:
+        if e.code in (20, 22):
+            raise RPCError.txn_conflict(
+                "CAS of the database root failed; txn aborted")
+        raise
+    node.reply(msg, {"type": "txn_ok", "txn": completed})
+
+
+if __name__ == "__main__":
+    node.run()

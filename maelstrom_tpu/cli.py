@@ -27,7 +27,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("-w", "--workload", default="lin-kv",
                    choices=["broadcast", "echo", "g-set", "g-counter",
                             "pn-counter", "lin-kv", "txn-list-append",
-                            "unique-ids", "kafka"],
+                            "unique-ids", "kafka", "txn-rw-register"],
                    help="What workload to run")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
@@ -187,6 +187,7 @@ DEMOS = [
      "bin": "demo/python/datomic_list_append.py"},
     {"workload": "unique-ids", "bin": "demo/python/unique_ids.py"},
     {"workload": "kafka", "bin": "demo/python/kafka.py"},
+    {"workload": "txn-rw-register", "bin": "demo/python/txn_rw_register.py"},
     # native batched node programs (the TPU path's userland)
     {"workload": "broadcast", "node": "tpu:broadcast", "topology": "tree4"},
     {"workload": "g-set", "node": "tpu:g-set"},
@@ -195,6 +196,7 @@ DEMOS = [
     {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
     {"workload": "unique-ids", "node": "tpu:unique-ids"},
     {"workload": "kafka", "node": "tpu:kafka"},
+    {"workload": "txn-rw-register", "node": "tpu:txn-rw-register"},
 ]
 
 

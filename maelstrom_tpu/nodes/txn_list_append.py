@@ -45,6 +45,10 @@ def apply_txn(db: dict, txn) -> tuple[dict, list]:
 @register
 class TxnRaftProgram(RaftProgram):
     name = "txn-list-append"
+    # the replicated command machinery is micro-op-agnostic; subclasses
+    # swap the interpreter to serve other transactional workloads
+    # (nodes/txn_rw_register.py)
+    apply = staticmethod(apply_txn)
     needs_state_reads = True
     # completion() reads only committed log entries (final and
     # replica-identical), so end-of-stretch state reads are exact and the
@@ -103,7 +107,7 @@ class TxnRaftProgram(RaftProgram):
                     continue
                 tid = ((log_b[i] >> 8) & 0xFF) << 8 | (log_b[i] & 0xFF)
                 txn = intern.value(int(tid))
-                self._replay_db, out = apply_txn(self._replay_db, txn)
+                self._replay_db, out = self.apply(self._replay_db, txn)
                 self._replay_outs[i] = out
             self._replay_next = p + 1
         completed = self._replay_outs.get(p)

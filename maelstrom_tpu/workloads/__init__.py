@@ -7,7 +7,8 @@ from __future__ import annotations
 
 def registry() -> dict:
     from . import (broadcast, echo, g_counter, g_set, kafka, lin_kv,
-                   pn_counter, txn_list_append, unique_ids)
+                   pn_counter, txn_list_append, txn_rw_register,
+                   unique_ids)
     return {
         "broadcast": broadcast.workload,
         "echo": echo.workload,
@@ -18,6 +19,7 @@ def registry() -> dict:
         "txn-list-append": txn_list_append.workload,
         "unique-ids": unique_ids.workload,
         "kafka": kafka.workload,
+        "txn-rw-register": txn_rw_register.workload,
     }
 
 

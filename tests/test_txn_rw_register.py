@@ -1,0 +1,98 @@
+"""The txn-rw-register observable-subset checker on literal histories:
+legal chains, forged G1a/G1b/internal anomalies, and the wr+realtime
+cycle (a read of a write from the future) it must catch — plus the
+concurrent case it must NOT flag."""
+
+from maelstrom_tpu.checkers.txn_rw_register import RWRegisterChecker
+from maelstrom_tpu.history import History, Op
+
+
+def _h(ops):
+    return History([Op(**o) for o in ops])
+
+
+def _txn(t_inv, t_ok, mops, completed=None, type="ok", process=0):
+    return [
+        {"type": "invoke", "f": "txn", "process": process, "time": t_inv,
+         "value": mops},
+        {"type": type, "f": "txn", "process": process, "time": t_ok,
+         "value": completed if completed is not None else mops},
+    ]
+
+
+def test_legal_chain():
+    ops = (_txn(0, 1, [["w", 1, 10]])
+           + _txn(2, 3, [["r", 1, None]], [["r", 1, 10]])
+           + _txn(4, 5, [["w", 1, 11], ["r", 1, None]],
+                  [["w", 1, 11], ["r", 1, 11]]))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+    assert r["wr-edge-count"] == 1
+
+
+def test_internal_violation():
+    ops = _txn(0, 1, [["w", 1, 10], ["r", 1, None]],
+               [["w", 1, 10], ["r", 1, 3]])
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["internal"][0]["expected"] == 10
+
+
+def test_g1a_aborted_read():
+    ops = (_txn(0, 1, [["w", 1, 99]], type="fail")
+           + _txn(2, 3, [["r", 1, None]], [["r", 1, 99]]))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["G1a"][0]["value"] == 99
+
+
+def test_g1b_intermediate_read():
+    ops = (_txn(0, 1, [["w", 1, 10], ["w", 1, 11]])
+           + _txn(2, 3, [["r", 1, None]], [["r", 1, 10]]))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["G1b"][0]["value"] == 10
+
+
+def test_read_from_the_future_cycle():
+    # T1 completed before T2 even invoked, yet T1 observed T2's write:
+    # wr edge T2->T1 plus realtime T1->T2 closes a cycle
+    ops = (_txn(0, 1, [["r", 1, None]], [["r", 1, 50]])
+           + _txn(10, 11, [["w", 1, 50]], process=1))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["cycles"][0]["txns"] == [0, 1]
+    assert r["cycles"][0]["via-realtime"] is True
+
+
+def test_concurrent_read_not_flagged():
+    # same shape but OVERLAPPING ops: no realtime edge, no cycle
+    ops = (_txn(0, 20, [["r", 1, None]], [["r", 1, 50]])
+           + _txn(5, 15, [["w", 1, 50]], process=1))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is True
+
+
+def test_duplicate_writes_reported():
+    ops = (_txn(0, 1, [["w", 1, 7]])
+           + _txn(2, 3, [["w", 1, 7]], process=1))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert r["duplicate-writes"][0]["key"] == 1
+
+
+def test_vacuous_unknown():
+    ops = _txn(0, 1, [["w", 1, 5]], type="info")
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] == "unknown"
+
+
+def test_rw_register_tpu_e2e():
+    from maelstrom_tpu import core
+
+    res = core.run(dict(store_root="/tmp/maelstrom-tpu-test-store",
+                        seed=7, rate=15.0, time_limit=3.0,
+                        journal_rows=False, workload="txn-rw-register",
+                        node="tpu:txn-rw-register", node_count=3))
+    assert res["valid"] is True, res["workload"]
+    assert res["workload"]["ok-count"] > 5
