@@ -16,6 +16,8 @@ CONFIGS = [
     ("lin-kv", "tpu:lin-kv", {}),
     ("unique-ids", "tpu:unique-ids", {}),
     ("kafka", "tpu:kafka", {}),
+    ("txn-list-append", "tpu:txn-list-append", {}),
+    ("txn-rw-register", "tpu:txn-rw-register", {}),
 ]
 
 
