@@ -9,12 +9,21 @@ core of Elle's list-append analysis:
    read must be a *prefix* of it (list semantics), else `incompatible-order`.
 2. Direct anomalies: G1a (aborted read: observing a value whose append
    failed), G1b (intermediate read: observing a state mid-transaction),
-   duplicate elements.
+   duplicate elements; cyclic-version-order (the union of all observed
+   adjacencies for a key contains a cycle — reads imply contradictory
+   version orders, beyond a mere prefix fork); lost-update (two
+   transactions load the same version of a key — a read in the same
+   transaction, own appends stripped — and both append to it; flagged
+   even when no later read ever observes the colliding appends, the
+   case the dependency graph alone cannot see).
 3. Dependency graph over transactions: ww (version succession), wr (read
    observes a version), rw (anti-dependency: read of v precedes writer of
    v+1), plus rt (real-time) edges for strict serializability.
 4. Cycle detection via Tarjan SCC; cycles are classified G0 (write cycle),
-   G1c (ww/wr cycle), G-single (one rw edge), G2 (multiple rw edges).
+   G1c (ww/wr cycle), G-single (one rw edge), G2 (multiple rw edges,
+   some pair adjacent in the witness cycle), G-nonadjacent (multiple rw
+   edges, no two adjacent — the shape that additionally violates
+   snapshot isolation, per Cerone-Gotsman's adjacent-rw criterion).
 
 Consistency models map to which anomalies are violations:
   read-uncommitted:    G0, dirty reads of aborted state (G1a)
@@ -58,6 +67,36 @@ def _fail_appends(history):
             if f == "append":
                 out.add((_hk(k), _hv(v)))
     return out
+
+
+def _digraph_cycle(g: dict):
+    """One cycle in {node: set(succ)} as a closed node list, or None.
+    Iterative coloring DFS (histories can be deep)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in g}
+    for root in sorted(g):
+        if color[root] != WHITE:
+            continue
+        path = []
+        stack = [(root, iter(sorted(g.get(root, ()))))]
+        color[root] = GRAY
+        path.append(root)
+        while stack:
+            node, it = stack[-1]
+            for w in it:
+                c = color.get(w, WHITE)
+                if c == GRAY:
+                    return path[path.index(w):] + [w]
+                if c == WHITE:
+                    color[w] = GRAY
+                    path.append(w)
+                    stack.append((w, iter(sorted(g.get(w, ())))))
+                    break
+            else:
+                color[node] = BLACK
+                path.pop()
+                stack.pop()
+    return None
 
 
 def _hk(k):
@@ -130,6 +169,68 @@ def analyze(history) -> dict:
                     if w_appends and vv[-1] != w_appends[-1]:
                         add_anom("G1b", {"key": k, "read": v,
                                          "writer-appends": w_appends})
+
+    # --- cyclic version order: union the adjacencies every observed
+    # read asserts for a key; a cycle means no version order can satisfy
+    # all reads (a fork is merely incompatible-order; this is stronger)
+    vgraph: dict = {}                 # kk -> {a: set of b with a < b}
+    raw_key: dict = {}                # kk -> original key object
+    raw_val: dict = {}                # (kk, vv) -> original value
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f == "r" and isinstance(v, list):
+                kk = _hk(k)
+                raw_key[kk] = k
+                vv = [_hv(x) for x in v]
+                for x, xv in zip(v, vv):
+                    raw_val[(kk, xv)] = x
+                g = vgraph.setdefault(kk, {})
+                for a, b in zip(vv, vv[1:]):
+                    g.setdefault(a, set()).add(b)
+    for kk, g in vgraph.items():
+        cyc = _digraph_cycle(g)
+        if cyc is not None:
+            add_anom("cyclic-version-order",
+                     {"key": raw_key[kk],
+                      "cycle": [raw_val.get((kk, n), n) for n in cyc]})
+
+    # --- lost update: transactions that loaded the SAME version of a
+    # key (a read in the same txn; the txn's own tail appends stripped,
+    # so a post-append read still reveals the loaded state) and both
+    # appended to it. Both cannot serialize after the state they read,
+    # so one update is lost. Detected directly from the loads because
+    # the colliding appends may never be observed by any later read —
+    # the one anomaly here the dependency graph cannot express.
+    lu_groups: dict = {}   # (kk, loaded-tuple) -> [txn ids], raw witness
+    for t in txns:
+        if not t["ok"]:
+            continue
+        own: dict = {}                # kk -> own values appended so far
+        loaded: dict = {}             # kk -> first loaded version
+        for f, k, v in t["micro"]:
+            kk = _hk(k)
+            if f == "append":
+                own.setdefault(kk, []).append(_hv(v))
+            elif f == "r" and isinstance(v, list) and kk not in loaded:
+                vv = [_hv(x) for x in v]
+                raw = list(v)
+                mine = own.get(kk, [])
+                if mine and vv[-len(mine):] == mine:
+                    vv, raw = vv[:len(vv) - len(mine)], \
+                        raw[:len(raw) - len(mine)]
+                loaded[kk] = (tuple(vv), k, raw)
+        for kk in own:
+            if kk in loaded:
+                vv, k, raw = loaded[kk]
+                ids, _k, _raw = lu_groups.setdefault(
+                    (kk, vv), ([], k, raw))
+                ids.append(t["id"])
+    for (kk, _vv), (ids, k, raw) in sorted(lu_groups.items()):
+        if len(ids) > 1:
+            add_anom("lost-update",
+                     {"key": k, "loaded": raw, "txns": ids})
 
     # --- dependency graph ---
     # edges: (src, dst, kind) with kind in ww/wr/rw/rt
@@ -349,7 +450,23 @@ def analyze(history) -> dict:
         if inner <= {"ww", "wr"}:
             return "G1c"
         rw = sum(1 for k in kinds_used if k == "rw")
-        return "G-single" if rw == 1 else "G2"
+        if rw == 1:
+            return "G-single"
+        # the witness cycle's steps, cyclically: two rw edges in a row
+        # is plain G2; none adjacent anywhere is the shape that also
+        # breaks snapshot isolation (every SI-legal cycle has an
+        # adjacent rw pair) — report the stronger label. Only claimed
+        # for pure data cycles: a cycle that needs an rt hop to close
+        # is not an SI-graph cycle, so the SI assertion would overstate
+        # the evidence
+        if "rt" not in kinds_used:
+            n = len(kinds_used)
+            adjacent = any(kinds_used[i] == "rw"
+                           and kinds_used[(i + 1) % n] == "rw"
+                           for i in range(n))
+            if not adjacent:
+                return "G-nonadjacent"
+        return "G2"
 
     base_sccs = cycles_with(edges)
     for scc in base_sccs:
@@ -372,18 +489,28 @@ def analyze(history) -> dict:
 
 
 ILLEGAL = {
+    # cyclic-version-order is a data-integrity contradiction (no version
+    # order exists at all), illegal under every model, like
+    # incompatible-order; lost-update is permitted at read-committed
+    # (Adya P4 is only proscribed from cursor stability up), so it
+    # gates the serializable models only
     "read-uncommitted": {"G0", "G1a", "duplicate-appends",
-                         "incompatible-order", "phantom-element"},
+                         "incompatible-order", "phantom-element",
+                         "cyclic-version-order"},
     "read-committed": {"G0", "G1a", "G1b", "G1c", "duplicate-appends",
-                       "incompatible-order", "phantom-element"},
+                       "incompatible-order", "phantom-element",
+                       "cyclic-version-order"},
     "serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
+                     "G-nonadjacent", "lost-update",
                      "duplicate-appends", "incompatible-order",
-                     "phantom-element"},
+                     "phantom-element", "cyclic-version-order"},
     "strict-serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
+                            "G-nonadjacent", "lost-update",
                             "G0-realtime", "G1c-realtime",
                             "G-single-realtime", "G2-realtime",
+                            "G-nonadjacent-realtime",
                             "duplicate-appends", "incompatible-order",
-                            "phantom-element"},
+                            "phantom-element", "cyclic-version-order"},
 }
 
 

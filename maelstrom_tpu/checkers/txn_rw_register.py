@@ -57,7 +57,7 @@ class RWRegisterChecker(Checker):
             if complete is not None and complete.is_fail():
                 for f, k, v in invoke.value or ():
                     if f == "w":
-                        failed_writes[(str(k), repr(v))] = None
+                        failed_writes[(str(k), repr(v))] = (k, v)
                 continue
             ok = complete is not None and complete.is_ok()
             value = complete.value if ok else invoke.value
@@ -73,6 +73,18 @@ class RWRegisterChecker(Checker):
                             {"key": k, "value": v,
                              "txns": [writer_of[key], idx]})
                     writer_of[key] = idx
+
+        # a (key, value) written by both a definitely-failed txn and an
+        # ok/info txn is the generator contract broken, not an aborted
+        # read — report it as duplicate-writes so a read of that value
+        # isn't mislabeled G1a
+        for key in sorted(set(failed_writes) & set(writer_of)):
+            k, v = failed_writes[key]
+            duplicate_writes.append(
+                {"key": k, "value": v,
+                 "txns": [writer_of[key]],
+                 "also-failed-writer": True})
+            del failed_writes[key]
 
         # last own write per key per txn (for internal + G1b)
         final_write = {}        # txn idx -> {k: v}

@@ -114,6 +114,13 @@ class KafkaProgram(NodeProgram):
         # stays positive in int32)
         self.cap = int(opts.get("log_cap",
                                 min(max(64, int(rate * tl) + 32), 0x7FFE)))
+        if self.cap > 0x7FFE:
+            # (len+1) << 16 must stay positive in int32 for the packed
+            # poll-length fields; an explicit override past that would
+            # silently corrupt poll completions
+            raise ValueError(
+                f"kafka log_cap {self.cap} exceeds the 15-bit packed "
+                f"length field (max {0x7FFE})")
         topo = TOPOLOGIES["total"](nodes)
         nb = topology_indices(topo, nodes)
         self.neighbors = jnp.asarray(nb)

@@ -93,8 +93,10 @@ class KafkaClient(BaseClient):
                 return {**op, "type": "ok", "value": msgs}
             if op["f"] == "commit":
                 offs = dict(self.last_polled)
-                if not offs:
-                    return {**op, "type": "ok", "value": {}}
+                # always round-trip, even with an empty offsets map —
+                # every ok in the history must correspond to a real
+                # server ack (an empty commit raises no offset floor,
+                # but fabricating the ok would skew op counts/latency)
                 commit_rpc(self.conn, self.node, {"offsets": offs})
                 return {**op, "type": "ok", "value": offs}
             res = list_rpc(self.conn, self.node, {"keys": key_names})

@@ -11,10 +11,14 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # XLA compiles cost ~1 s each in this environment, so cache them across
-# test runs (first run pays, reruns are fast).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(__file__), "..",
-                                   ".jax_cache"))
+# test runs (first run pays, reruns are fast). Same directory bench.py
+# uses: one shared persistent cache (entries are keyed by backend, so
+# CPU test compiles and TPU bench compiles coexist).
+_cache_default = os.path.join(os.path.dirname(__file__), "..",
+                              "artifacts", "xla-cache")
+if os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                         _cache_default) == _cache_default:
+    os.makedirs(_cache_default, exist_ok=True)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 

@@ -321,3 +321,145 @@ def test_parity_known_shift_quantiles():
     # shifting past the last absent read: the 20ms miss no longer counts
     # (reads must begin strictly after known)
     assert quantiles_with_shift(h, 25)["max"] == 0.0
+
+
+# --- lost-update: same loaded version, both append ---
+
+def test_lost_update_fires_unobserved():
+    # both txns load key 1 at version [] and append; NO later read ever
+    # observes the colliding appends, so the dependency graph is empty —
+    # this is the case only the load-collision rule can see
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 1, 1]],
+              [["r", 1, []], ["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 2]],
+              [["r", 1, []], ["append", 1, 2]], 1, 11, proc=1)
+    a = analyze(h)
+    assert "lost-update" in a, a
+    assert sorted(a["lost-update"][0]["txns"]) == [0, 1]
+    r = _check(h, ["serializable"])
+    assert r["valid"] is False and "lost-update" in r["anomalies"]
+
+
+def test_lost_update_not_illegal_at_read_committed():
+    # Adya P4 is only proscribed from cursor stability up; the same
+    # history passes a read-committed-only check
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 1, 1]],
+              [["r", 1, []], ["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 2]],
+              [["r", 1, []], ["append", 1, 2]], 1, 11, proc=1)
+    assert _check(h, ["read-committed"])["valid"] is True
+
+
+def test_lost_update_near_miss_sequential_loads():
+    # the second txn loaded the FIRST txn's append: a legal sequential
+    # read-modify-append chain
+    h = []
+    _txn_pair(h, [["r", 1, None], ["append", 1, 1]],
+              [["r", 1, []], ["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 2]],
+              [["r", 1, [1]], ["append", 1, 2]], 11, 20, proc=1)
+    a = analyze(h)
+    assert "lost-update" not in a, a
+    assert _check(h)["valid"] is True
+
+
+def test_lost_update_near_miss_blind_append():
+    # a blind append (no read in the txn) is not a load-save collision
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 2]],
+              [["r", 1, []], ["append", 1, 2]], 1, 11, proc=1)
+    a = analyze(h)
+    assert "lost-update" not in a, a
+
+
+def test_lost_update_fires_via_own_append_stripped_read():
+    # T0's read comes AFTER its own append; stripping its own tail
+    # recovers the loaded version [] — colliding with T1's load
+    h = []
+    _txn_pair(h, [["append", 1, 7], ["r", 1, None]],
+              [["append", 1, 7], ["r", 1, [7]]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 8]],
+              [["r", 1, []], ["append", 1, 8]], 1, 11, proc=1)
+    a = analyze(h)
+    assert "lost-update" in a, a
+
+
+# --- cyclic-version-order ---
+
+def test_cyclic_version_order_fires():
+    # one txn appends 1 then 2; readers observe [1,2] AND [2,1]: the
+    # union of asserted adjacencies is the cycle 1<2<1 — no version
+    # order exists at all (stronger than a prefix fork)
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2]],
+              [["append", 1, 1], ["append", 1, 2]], 0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 2, 3)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [2, 1]]], 4, 5)
+    a = analyze(h)
+    assert "cyclic-version-order" in a, a
+    r = _check(h, ["read-uncommitted"])
+    assert r["valid"] is False and "cyclic-version-order" in r["anomalies"]
+
+
+def test_cyclic_version_order_near_miss_fork():
+    # forked reads [1,2] vs [1,3]: incompatible-order, but a version
+    # order per branch still exists — NOT cyclic
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 1, 2],
+                  ["append", 1, 3]],
+              [["append", 1, 1], ["append", 1, 2], ["append", 1, 3]],
+              0, 1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 2, 3)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 3]]], 4, 5)
+    a = analyze(h)
+    assert "cyclic-version-order" not in a, a
+    assert "incompatible-order" in a
+
+
+# --- G-nonadjacent: >=2 rw edges, none adjacent ---
+
+def test_g_nonadjacent_fires():
+    # T0 -rw-> T1 -ww-> T2 -rw-> T3 -ww-> T0: two anti-dependencies
+    # separated by write dependencies on both sides — the cycle shape
+    # that additionally violates snapshot isolation
+    h = []
+    # T0: reads a=[], appends d<-2 (ww tail from T3)
+    _txn_pair(h, [["r", "a", None], ["append", "d", 2]],
+              [["r", "a", []], ["append", "d", 2]], 0, 10, proc=0)
+    # T1: appends a<-1 (making T0's read an rw edge), appends b<-1
+    _txn_pair(h, [["append", "a", 1], ["append", "b", 1]],
+              [["append", "a", 1], ["append", "b", 1]], 1, 11, proc=1)
+    # T2: reads c=[], appends b<-2 (ww from T1)
+    _txn_pair(h, [["r", "c", None], ["append", "b", 2]],
+              [["r", "c", []], ["append", "b", 2]], 2, 12, proc=2)
+    # T3: appends c<-1 (T2's rw target), appends d<-1 (ww into T0)
+    _txn_pair(h, [["append", "c", 1], ["append", "d", 1]],
+              [["append", "c", 1], ["append", "d", 1]], 3, 13, proc=3)
+    # observer pins every version order
+    _txn_pair(h, [["r", "a", None], ["r", "b", None],
+                  ["r", "c", None], ["r", "d", None]],
+              [["r", "a", [1]], ["r", "b", [1, 2]],
+               ["r", "c", [1]], ["r", "d", [1, 2]]], 4, 14, proc=4)
+    a = analyze(h)
+    assert "G-nonadjacent" in a, a
+    assert "G2" not in a, a
+    r = _check(h, ["serializable"])
+    assert r["valid"] is False and "G-nonadjacent" in r["anomalies"]
+
+
+def test_g_nonadjacent_near_miss_write_skew_is_g2():
+    # classic write skew: T0 -rw-> T1 -rw-> T0 — the two rw edges ARE
+    # adjacent (cyclically), so this stays G2, not G-nonadjacent
+    h = []
+    _txn_pair(h, [["r", "a", None], ["append", "b", 1]],
+              [["r", "a", []], ["append", "b", 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", "b", None], ["append", "a", 1]],
+              [["r", "b", []], ["append", "a", 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", "a", None], ["r", "b", None]],
+              [["r", "a", [1]], ["r", "b", [1]]], 12, 13, proc=2)
+    a = analyze(h)
+    assert "G2" in a, a
+    assert "G-nonadjacent" not in a, a

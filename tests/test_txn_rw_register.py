@@ -96,3 +96,30 @@ def test_rw_register_tpu_e2e():
                         node="tpu:txn-rw-register", node_count=3))
     assert res["valid"] is True, res["workload"]
     assert res["workload"]["ok-count"] > 5
+
+
+def test_duplicate_write_with_failed_writer_is_not_g1a():
+    # generator-contract break: key 1 value 7 written by BOTH a
+    # definitely-failed txn and an ok txn — a later read of 7 must be
+    # reported as duplicate-writes (contract violation), not mislabeled
+    # as an aborted read
+    ops = (_txn(0, 1, [["w", 1, 7]], type="fail")
+           + _txn(2, 3, [["w", 1, 7]])
+           + _txn(4, 5, [["r", 1, None]], [["r", 1, 7]]))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    dups = r["duplicate-writes"]
+    assert any(d.get("also-failed-writer") and d["key"] == 1
+               and d["value"] == 7 for d in dups), r
+    assert "G1a" not in r, r
+
+
+def test_failed_write_alone_is_not_duplicate():
+    # the same failed write WITHOUT an ok twin stays a plain G1a when
+    # read, and raises no duplicate-writes
+    ops = (_txn(0, 1, [["w", 1, 7]], type="fail")
+           + _txn(2, 3, [["r", 1, None]], [["r", 1, 7]]))
+    r = RWRegisterChecker().check({}, _h(ops), {})
+    assert r["valid"] is False
+    assert "duplicate-writes" not in r, r
+    assert r["G1a"][0]["value"] == 7
