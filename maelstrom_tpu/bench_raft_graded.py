@@ -47,8 +47,9 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                     ops_per_client: int = 12, clients: int = 2,
                     chunk: int = 10, seed: int = 0, warmup_chunks: int = 8,
                     max_chunks: int = 400, partition_at: int | None = None,
-                    partition_chunks: int = 0,
-                    verbose: bool = True) -> dict:
+                    partition_chunks: int = 0, p_loss: float = 0.0,
+                    latency: dict | None = None, verbose: bool = True,
+                    return_failures: bool = False) -> dict:
     import sys
 
     import jax
@@ -63,10 +64,13 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                              T_WRITE_OK)
     from .parallel import make_cluster_round_fn, make_cluster_sims
 
+    latency = latency or {"mean": 0}
     nodes = [f"n{i}" for i in range(n)]
-    program = get_program("lin-kv", {"latency": {"mean": 0}}, nodes)
+    program = get_program("lin-kv", {"latency": latency}, nodes)
     cfg = T.NetConfig(n_nodes=n, n_clients=clients, pool_cap=64,
-                      inbox_cap=program.inbox_cap, client_cap=4)
+                      inbox_cap=program.inbox_cap, client_cap=4,
+                      latency_mean_rounds=float(latency.get("mean") or 0),
+                      latency_dist=latency.get("dist", "constant"))
     round_fn = make_cluster_round_fn(program, cfg)
 
     S = min(sample, n_clusters)
@@ -104,6 +108,11 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     set_partition = jax.jit(set_partition)
 
     sims = make_cluster_sims(program, cfg, n_clusters, seed=seed)
+    if p_loss:
+        # per-message loss on every cluster's net (raft's retries and
+        # election timeouts absorb it; lost client requests surface as
+        # indeterminate ops, which WGL grades as may-have-happened)
+        sims = sims.replace(net=T.flaky(sims.net, p_loss))
     empty_plan = T.Msgs.empty((chunk, S, M))
     t0 = time.perf_counter()
 
@@ -329,6 +338,7 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
     # --- grade every sampled cluster's history ---
     checker = LinearizableRegisterChecker()
     results = []
+    failures = []
     for s in range(S):
         # completions sort BEFORE invokes at equal round-quantized
         # timestamps: an op completing at round t must happen-before an
@@ -338,9 +348,36 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
                                                   o.type == "invoke"))
         res = checker.check({}, History(ops), {})
         results.append(res["valid"])
+        if return_failures and res["valid"] is not True:
+            cl = int(sampled[s])
+            st = jax.device_get(jax.tree.map(lambda a: a[cl], sims.nodes))
+            logs = []
+            for node in range(n):
+                ll = int(st["log_len"][node])
+                ents = []
+                for i in range(ll):
+                    a = int(st["log_a"][node][i])
+                    b = int(st["log_b"][node][i])
+                    c = int(st["log_c"][node][i])
+                    ents.append({"term": a >> 16, "key": (a >> 4) & 0xFFF,
+                                 "op": a & 0xF, "client": b >> 16,
+                                 "v1": (b >> 8) & 0xFF, "v2": b & 0xFF,
+                                 "mid": c})
+                logs.append({"node": node, "role": int(st["role"][node]),
+                             "term": int(st["term"][node]),
+                             "commit": int(st["commit"][node]),
+                             "applied": int(st["applied"][node]),
+                             "kv": st["kv"][node].tolist(),
+                             "log": ents})
+            failures.append({"cluster": cl, "sample": s,
+                             "verdict": res, "ops": ops, "state": logs})
     ok_count = sum(1 for v in results if v is True)
     info_ops = sum(1 for s in range(S) for o in histories[s]
                    if o.type == "info")
+    # conservation audit over the WHOLE fleet (stats_dict sums the
+    # per-cluster counters): silent drops are a simulator bug regardless
+    # of the fault mix, loss/partition drops are the injected faults
+    net_stats = T.stats_dict(sims.net)
     out = {
         "sampled_clusters": S,
         "clusters_total": n_clusters,
@@ -352,7 +389,15 @@ def run_raft_graded(n_clusters: int = 10_000, n: int = 5, sample: int = 64,
         "duplicate_replies": duplicate_replies,
         "rounds": round_base,
         "wall_s": round(time.perf_counter() - t0, 3),
+        "net_stats": net_stats,
+        "dropped_overflow": net_stats.get("dropped_overflow", 0),
     }
+    if p_loss:
+        out["p_loss"] = p_loss
+    if latency.get("mean"):
+        out["latency"] = latency
+    if return_failures:
+        out["failures"] = failures
     if p0 is not None:
         out["partition"] = {
             "from_round": warmup_chunks * chunk + p0 * chunk,

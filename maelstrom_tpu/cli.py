@@ -98,9 +98,15 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--rounds", type=int, default=None,
                    help="Round count (default: bench.py's BENCH_ROUNDS)")
 
-    f = sub.add_parser("fuzz", help="Broadcast fuzz: partitions + latency "
-                                    "sweep at scale (BASELINE config 5)")
-    f.add_argument("--nodes", type=int, default=4096)
+    f = sub.add_parser("fuzz", help="Fault-mix sweeps at scale "
+                                    "(BASELINE config 5): broadcast "
+                                    "set-full, graded raft fleet, kafka")
+    f.add_argument("--program", choices=["broadcast", "raft", "kafka"],
+                   default="broadcast")
+    f.add_argument("--nodes", type=int, default=None,
+                   help="broadcast: node count (default 4096); raft: "
+                        "cluster count (default 1000); kafka: node "
+                        "count (default 5)")
     f.add_argument("--values", type=int, default=32)
     f.add_argument("--seed", type=int, default=0)
 
@@ -192,6 +198,7 @@ DEMOS = [
     {"workload": "broadcast", "node": "tpu:broadcast", "topology": "tree4"},
     {"workload": "g-set", "node": "tpu:g-set"},
     {"workload": "pn-counter", "node": "tpu:pn-counter"},
+    {"workload": "g-counter", "node": "tpu:g-counter"},
     {"workload": "lin-kv", "node": "tpu:lin-kv"},
     {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
     {"workload": "unique-ids", "node": "tpu:unique-ids"},
@@ -276,7 +283,8 @@ def main(argv=None) -> int:
 
     if args.cmd == "fuzz":
         from .fuzz import main as fuzz_main
-        return fuzz_main(args.nodes, args.values, args.seed)
+        return fuzz_main(args.nodes, args.values, args.seed,
+                         program=args.program)
 
     if args.cmd == "parity":
         from .parity import main as parity_main

@@ -349,8 +349,12 @@ def flaky(net: NetState, p: float = 0.5) -> NetState:
 
 def stats_dict(net: NetState) -> dict:
     """Pull the on-device counters to host, in the shape the net-stats
-    checker reports (`net/checker.clj:43-70`)."""
+    checker reports (`net/checker.clj:43-70`). On a cluster-batched net
+    (leading cluster axis from `parallel.make_cluster_sims`) each
+    counter is summed over the fleet."""
     import dataclasses
+
+    import numpy as np
     st = jax.device_get(net.stats)
-    return {f.name: int(getattr(st, f.name))
+    return {f.name: int(np.asarray(getattr(st, f.name)).sum())
             for f in dataclasses.fields(st)}

@@ -89,6 +89,14 @@ class NodeProgram:
     # reads lanes positionally (0 = request, 1 = reply, 2 = proxy) and
     # must leave this False.
     edge_lanes_symmetric = False
+    # True when the program's per-round emission toward one neighbor is
+    # ONE logical RPC whose lanes must arrive together (raft: the AE
+    # header's prev_idx positions the entry lanes). The net then shares
+    # the latency and loss draws across that edge's lanes for the round
+    # — the packet travels whole — instead of drawing per lane. Leave
+    # False for programs whose lanes are independent self-describing
+    # messages (gossip values, kafka per-key offers).
+    edge_atomic_rpc = False
     # latency draws beyond the edge ring are clipped and counted; runs
     # that clip are invalid unless the program (or test opts) accept the
     # distortion explicitly

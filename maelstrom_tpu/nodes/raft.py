@@ -68,6 +68,12 @@ class RaftProgram(NodeProgram):
     needs_state_reads = False
     is_edge = True
     tolerates_channel_overwrites = True   # AE windows resend every round
+    # an AE is one RPC: its entry lanes are positioned by the header's
+    # prev_idx, so header and entries must share one fault draw per
+    # (edge, round) — per-lane reordering would write entries at wrong
+    # log indices (same-term log divergence, a real linearizability
+    # break found by the raft fault fuzz under exponential latency)
+    edge_atomic_rpc = True
     # trace-time phase ablation for in-context profiling ONLY
     # (maelstrom_tpu.profile_raft); production paths never set it
     ablate: frozenset = frozenset()

@@ -11,11 +11,100 @@ import socketserver
 from functools import partial
 
 
+def _badge(valid):
+    color = {"True": "#2ca02c", "False": "#d62728"}.get(
+        str(valid), "#ff7f0e")
+    return f'<span style="color:{color}">{valid}</span>'
+
+
+def _scan_runs(root):
+    """All runs under store/<workload>/<timestamp>/, newest first:
+    (workload, ts, valid, op-count, rel-path)."""
+    runs = []
+    skip = {"latest", "current"}
+    for wl in sorted(os.listdir(root) if os.path.isdir(root) else ()):
+        wdir = os.path.join(root, wl)
+        if wl in skip or not os.path.isdir(wdir):
+            continue
+        for ts in os.listdir(wdir):
+            rdir = os.path.join(wdir, ts)
+            if ts in skip or not os.path.isdir(rdir):
+                continue
+            # a run dir is one the test harness wrote: results.json (or
+            # at least a history) — anything else (net-journal/, logs)
+            # is reachable through the per-run listing, not the index
+            results = os.path.join(rdir, "results.json")
+            if not (os.path.exists(results)
+                    or os.path.exists(os.path.join(rdir,
+                                                   "history.jsonl"))):
+                continue
+            valid, ops = "?", ""
+            try:
+                with open(results) as f:
+                    res = json.load(f)
+                valid = res.get("valid")
+                ops = (res.get("stats") or {}).get("count", "")
+            except Exception:
+                pass
+            runs.append((wl, ts, valid, ops, f"{wl}/{ts}/"))
+    runs.sort(key=lambda r: r[1], reverse=True)
+    return runs
+
+
 class StoreHandler(http.server.SimpleHTTPRequestHandler):
-    """Serves store files, rendering directory listings with validity
-    badges pulled from results.json."""
+    """Serves store files; the root renders a run-index table (jepsen's
+    serve gives the same sortable overview, `core.clj:230`), deeper
+    directories render listings with validity badges from results.json."""
 
     def list_directory(self, path):
+        if os.path.abspath(path) == os.path.abspath(self.directory):
+            return self._index(path)
+        return self._listing(path)
+
+    def _index(self, path):
+        rows = []
+        for wl, ts, valid, ops, rel in _scan_runs(path):
+            links = " ".join(
+                f'<a href="{rel}{name}">{label}</a>'
+                for name, label in [("results.json", "results"),
+                                    ("history.jsonl", "history"),
+                                    ("node-logs/", "logs"),
+                                    ("", "files")]
+                if name == "" or os.path.exists(os.path.join(path, rel,
+                                                             name)))
+            rows.append(f"<tr><td><a href='{rel}'>{ts}</a></td>"
+                        f"<td>{wl}</td><td>{_badge(valid)}</td>"
+                        f"<td style='text-align:right'>{ops}</td>"
+                        f"<td>{links}</td></tr>")
+        # raw listing escape hatch: in-progress runs (no results.json
+        # yet) and loose store entries stay reachable per-workload
+        dirs = " ".join(
+            f'<a href="{d}/">{d}/</a>'
+            for d in sorted(os.listdir(path))
+            if os.path.isdir(os.path.join(path, d)))
+        body = (
+            "<html><head><title>maelstrom-tpu runs</title><style>"
+            "body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}"
+            "td,th{padding:.3em .8em;border-bottom:1px solid #ddd;"
+            "text-align:left}</style></head><body>"
+            f"<h2>runs ({len(rows)})</h2>"
+            "<table><tr><th>time</th><th>workload</th><th>valid</th>"
+            "<th>ops</th><th>links</th></tr>"
+            f"{''.join(rows)}</table>"
+            f"<p>browse: {dirs}</p></body></html>")
+        return self._send_html(body)
+
+    def _send_html(self, body):
+        encoded = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(encoded)))
+        self.end_headers()
+        self.wfile.write(encoded)
+        return None
+
+    def _listing(self, path):
         try:
             entries = sorted(os.listdir(path))
         except OSError:
@@ -41,14 +130,9 @@ class StoreHandler(http.server.SimpleHTTPRequestHandler):
             rows.append(f'<li><a href="{name}{slash}">{name}{slash}</a>'
                         f'{badge}</li>')
         body = (f"<html><head><title>store: {rel}</title></head><body>"
+                f'<p><a href="/">run index</a></p>'
                 f"<h2>{rel}</h2><ul>{''.join(rows)}</ul></body></html>")
-        encoded = body.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/html; charset=utf-8")
-        self.send_header("Content-Length", str(len(encoded)))
-        self.end_headers()
-        self.wfile.write(encoded)
-        return None
+        return self._send_html(body)
 
 
 def serve(store_root: str = "store", port: int = 8080):

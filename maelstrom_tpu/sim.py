@@ -111,9 +111,25 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     blocked = ((comp[jnp.arange(N)][:, None] != comp[safe_nb])
                & (nb >= 0))                                   # [N, D]
     shape = edge_out.valid.shape
-    lost = jax.random.uniform(k4, shape) < net.p_loss
+    # atomic-RPC programs (raft: AE header on lane 0, its entry window
+    # on lanes 3+) emit ONE logical message per (edge, round): the fault
+    # draws are shared across lanes — one delay, one loss — so a batch
+    # is never torn apart by per-lane reordering. Without this, an AE
+    # header can arrive alongside entry lanes from a DIFFERENT AE under
+    # randomized latency, and entries (positioned by the paired header's
+    # prev_idx) land at wrong log indices — same-term log divergence,
+    # observed as a linearizability violation under partition+exp
+    # latency. Per-lane independence stays the default: every other
+    # program's lanes are self-describing messages. With constant
+    # latency and p_loss=0 the two modes are value-identical.
+    draw_shape = (shape[0], shape[1], 1) if program.edge_atomic_rpc \
+        else shape
+    lost = jnp.broadcast_to(
+        jax.random.uniform(k4, draw_shape) < net.p_loss, shape)
     deliver_mask = ~blocked[:, :, None] & ~lost
-    lat = T.draw_latency_rounds(cfg, k5, net.latency_scale, shape)
+    lat = jnp.broadcast_to(
+        T.draw_latency_rounds(cfg, k5, net.latency_scale, draw_shape),
+        shape)
     # ecfg.spill (decided by the program, see EdgeConfig): randomized
     # latency can land two sends in one (edge, round) cell; programs
     # whose inbox lanes are interchangeable get the collision-free spill

@@ -7,6 +7,10 @@ stock checker")."""
 import json
 import os
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def test_graded_broadcast_small(tmp_path):
     from maelstrom_tpu.bench_graded import run_graded

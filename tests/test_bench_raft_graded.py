@@ -3,6 +3,10 @@ CI scale: sampled vmapped clusters driven with real contending client
 traffic, per-cluster histories graded by the stock WGL linearizability
 checker — the grading half of the 10k-cluster benchmark config."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def test_raft_clusters_graded_small():
     from maelstrom_tpu.bench_raft_graded import run_raft_graded

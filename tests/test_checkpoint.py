@@ -21,6 +21,9 @@ from maelstrom_tpu.runner.tpu_runner import TpuRunner
 from conftest import ops_projection as _ops
 
 
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
+
 def _build(tmp_path, **over):
     opts = {"workload": "pn-counter", "node": "tpu:pn-counter",
             "node_count": 5, "rate": 20.0, "time_limit": 3.0,

@@ -20,6 +20,10 @@ from hypothesis import given, settings, strategies as st
 from maelstrom_tpu.net import static as S
 from maelstrom_tpu.net.tpu import I32
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 # a fixed 4-node line: n0 - n1 - n2 - n3
 NEIGHBORS = np.array([[1, -1], [0, 2], [1, 3], [2, -1]], np.int32)
 REV = S.reverse_index(NEIGHBORS)

@@ -9,10 +9,14 @@ import pytest
 
 from maelstrom_tpu import core
 
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 CONFIGS = [
     ("broadcast", "tpu:broadcast", {"topology": "grid"}),
     ("g-set", "tpu:g-set", {}),
     ("pn-counter", "tpu:pn-counter", {}),
+    ("g-counter", "tpu:g-counter", {}),
     ("lin-kv", "tpu:lin-kv", {}),
     ("unique-ids", "tpu:unique-ids", {}),
     ("kafka", "tpu:kafka", {}),

@@ -6,6 +6,10 @@ from __future__ import annotations
 
 from maelstrom_tpu.fuzz import DEFAULT_SWEEP, fuzz_broadcast
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def test_fuzz_broadcast_partitions_and_loss():
     results = fuzz_broadcast(n_nodes=36, values=6, sweep=DEFAULT_SWEEP[:2],
@@ -16,3 +20,29 @@ def test_fuzz_broadcast_partitions_and_loss():
         assert r["dropped_overflow"] == 0
     # the partition actually bit: cross-component sends were dropped
     assert any(r["dropped_partition"] > 0 for r in results)
+
+
+def test_fuzz_raft_sweep_small():
+    from maelstrom_tpu.fuzz import fuzz_raft
+
+    rows = fuzz_raft(n_clusters=12, sample=4, seed=3, log=lambda s: None)
+    assert len(rows) == 4
+    for r in rows:
+        assert r["ok"] is True, r
+        assert r["dropped_overflow"] == 0
+    # the sweep genuinely exercised each fault class somewhere
+    assert any(r["net_stats"]["lost"] > 0 for r in rows)
+    assert any(r["net_stats"]["dropped_partition"] > 0 for r in rows)
+
+
+def test_fuzz_kafka_sweep_small():
+    from maelstrom_tpu.fuzz import fuzz_kafka
+
+    rows = fuzz_kafka(seed=5, time_limit=3.0, rate=12.0,
+                      log=lambda s: None)
+    assert len(rows) == 4
+    for r in rows:
+        assert r["ok"] is True, r
+        assert r["dropped_overflow"] == 0
+    assert any((r["lost"] or 0) > 0 for r in rows)
+    assert any((r["dropped_partition"] or 0) > 0 for r in rows)

@@ -10,6 +10,9 @@ import pytest
 
 from maelstrom_tpu import core
 
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEMO = os.path.join(REPO, "demo", "python")
 

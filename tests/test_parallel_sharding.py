@@ -17,6 +17,8 @@ from maelstrom_tpu.nodes import get_program
 from maelstrom_tpu.parallel import (make_cluster_round_fn, make_cluster_sims,
                                     mesh_for, sim_shardings)
 
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def _build(n_nodes=8, n_clusters=4, name="broadcast"):
     nodes = [f"n{i}" for i in range(n_nodes)]

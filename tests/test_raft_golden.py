@@ -25,6 +25,10 @@ from maelstrom_tpu.nodes import get_program
 from maelstrom_tpu.nodes.raft import T_CAS, T_READ, T_WRITE
 from maelstrom_tpu.parallel import make_cluster_round_fn, make_cluster_sims
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 GOLDEN = "e88bcde5428c5e33594854d9a60fc5f5456a5adeb793581cb5c6b7a3fae059d2"
 
 

@@ -13,6 +13,10 @@ from maelstrom_tpu.runner.tpu_runner import TpuRunner
 
 from conftest import ops_projection as _ops
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def _run(tmp_path, **over):
     opts = {"workload": "pn-counter", "node": "tpu:pn-counter",

@@ -53,3 +53,35 @@ def test_serve_renders_validity_badges(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_serve_root_run_index(tmp_path):
+    # runs across two workloads, plus a latest symlink-alike dir name
+    # that must be excluded; newest run sorts first
+    for wl, ts, valid, count in (
+            ("lin-kv", "20260101T000000", True, 40),
+            ("broadcast", "20260201T000000", False, 7)):
+        d = tmp_path / wl / ts
+        d.mkdir(parents=True)
+        (d / "results.json").write_text(json.dumps(
+            {"valid": valid, "stats": {"count": count}}))
+        (d / "history.jsonl").write_text("")
+    (tmp_path / "lin-kv" / "latest").mkdir()
+
+    handler = partial(StoreHandler, directory=str(tmp_path))
+    httpd = socketserver.TCPServer(("127.0.0.1", 0), handler)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as resp:
+            body = resp.read().decode()
+        assert "runs (2)" in body, body
+        # newest (broadcast) row renders before the older lin-kv row
+        assert body.index("broadcast") < body.index("lin-kv")
+        assert "history.jsonl" in body and ">results<" in body
+        assert "#2ca02c" in body and "#d62728" in body
+        assert "latest" not in body
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -4,6 +4,10 @@ message-loss configurations."""
 
 from maelstrom_tpu import core
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def run(opts):
     # journal_rows off: engages the compiled scan-ahead fast path (the

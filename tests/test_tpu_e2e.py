@@ -8,6 +8,9 @@ import pytest
 from maelstrom_tpu import core
 
 
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
+
 def run(opts):
     # journal_rows off by default: engages the compiled scan-ahead fast
     # path. The grid test below keeps it on to cover TPU-path journaling.

@@ -22,6 +22,10 @@ from hypothesis import given, settings, strategies as st
 from maelstrom_tpu.net import tpu as T
 from test_tpu_net import mk
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def drive(cfg, schedule, rounds, seed=0):
     """Runs the device network over `schedule` = {round: [(src, dest, a)]}.

@@ -12,6 +12,10 @@ from maelstrom_tpu.net import tpu as T
 from maelstrom_tpu.nodes import get_program
 from maelstrom_tpu.sim import _round_edge, make_sim
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def drive_until_quiet(name, opts, inject_type, inject_a, n=5,
                       max_rounds=120):

@@ -10,6 +10,10 @@ import numpy as np
 from maelstrom_tpu import core
 from maelstrom_tpu.net import tpu as T
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
 
 def run(opts):
     base = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=3,
@@ -102,3 +106,22 @@ def test_raft_many_clusters_vmap():
     assert (leaders == 1).mean() > 0.9, leaders
     terms = np.asarray(jax.device_get(sims.nodes["term"]))
     assert (terms >= 1).all()
+
+
+def test_raft_survives_reordering_exponential_latency_partition():
+    """Regression: per-lane latency draws tore AE batches apart — an AE
+    header arriving with entry lanes from a DIFFERENT AE wrote entries
+    at wrong log indices (same-term log divergence), surfacing as a
+    committed write reverting after a partition-window election. The
+    exact fuzz config that caught it (64 clusters, seed 303); raft's
+    edge_atomic_rpc shares one fault draw per (edge, round) so the RPC
+    travels whole."""
+    from maelstrom_tpu.bench_raft_graded import run_raft_graded
+
+    r = run_raft_graded(n_clusters=64, sample=16, seed=303, p_loss=0.0,
+                        latency={"mean": 3, "dist": "exponential"},
+                        warmup_chunks=14, max_chunks=600,
+                        partition_at=4, partition_chunks=12,
+                        verbose=False)
+    assert r["all_linearizable"] is True, r
+    assert r["dropped_overflow"] == 0

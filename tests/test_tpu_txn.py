@@ -8,6 +8,9 @@ import pytest
 from maelstrom_tpu import core
 
 
+pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
+
+
 def test_txn_list_append_host_datomic_demo():
     res = core.run({"workload": "txn-list-append",
                     "bin": "demo/python/datomic_list_append.py",
