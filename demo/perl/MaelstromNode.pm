@@ -1,0 +1,160 @@
+package MaelstromNode;
+
+# A tiny node library for writing Maelstrom-protocol nodes in Perl —
+# the third userland language next to demo/python/node.py and
+# demo/c/maelstrom_node.h (the reference ships Ruby/Python/Clojure
+# libraries; demo/ruby/node.rb:1-186 is the capability anchor).
+#
+# Newline-delimited JSON on stdin/stdout, logs on stderr
+# (doc/protocol.md). Single-threaded: one select() loop dispatches
+# incoming messages and fires periodic tasks between lines, so no
+# locking is needed in handlers (the same run-to-completion model the
+# C library uses).
+#
+# Surface:
+#   my $node = MaelstromNode->new;
+#   $node->on(echo => sub { my ($node, $msg) = @_; ... });
+#   $node->every(0.5 => sub { ... });        # after init
+#   $node->reply($msg, { type => "echo_ok" });
+#   $node->rpc($dest, { type => ... }, sub { my ($node, $reply) = @_ });
+#   $node->run;
+
+use strict;
+use warnings;
+use JSON::PP;
+use IO::Select;
+use Time::HiRes qw(time);
+
+my $json = JSON::PP->new->utf8->canonical;
+
+sub new {
+    my ($class) = @_;
+    my $self = bless {
+        node_id     => undef,
+        node_ids    => [],
+        next_msg_id => 0,
+        handlers    => {},
+        callbacks   => {},
+        periodic    => [],    # [interval_s, next_due, fn]
+        initialized => 0,
+    }, $class;
+    $self->on(init => sub {
+        my ($node, $msg) = @_;
+        $node->{node_id}  = $msg->{body}{node_id};
+        $node->{node_ids} = $msg->{body}{node_ids};
+        $node->{initialized} = 1;
+        my $now = time;
+        $_->[1] = $now + $_->[0] for @{ $node->{periodic} };
+        $node->log("Node $node->{node_id} initialized");
+        $node->reply($msg, { type => "init_ok" });
+    });
+    return $self;
+}
+
+sub on {
+    my ($self, $type, $fn) = @_;
+    die "already a handler for $type" if $self->{handlers}{$type};
+    $self->{handlers}{$type} = $fn;
+    return $self;
+}
+
+sub every {
+    my ($self, $interval_s, $fn) = @_;
+    push @{ $self->{periodic} }, [$interval_s, time + $interval_s, $fn];
+    return $self;
+}
+
+sub log {
+    my ($self, $text) = @_;
+    print STDERR "$text\n";
+    STDERR->flush;
+}
+
+sub send_msg {
+    my ($self, $dest, $body) = @_;
+    my $line = $json->encode(
+        { src => $self->{node_id}, dest => $dest, body => $body });
+    print STDOUT "$line\n";
+    STDOUT->flush;
+}
+
+sub reply {
+    my ($self, $request, $body) = @_;
+    $self->send_msg($request->{src},
+                    { %$body, in_reply_to => $request->{body}{msg_id} });
+}
+
+sub rpc {
+    my ($self, $dest, $body, $callback) = @_;
+    my $msg_id = ++$self->{next_msg_id};
+    $self->{callbacks}{$msg_id} = $callback if $callback;
+    $self->send_msg($dest, { %$body, msg_id => $msg_id });
+    return $msg_id;
+}
+
+sub _dispatch {
+    my ($self, $msg) = @_;
+    my $body = $msg->{body};
+    if (defined $body->{in_reply_to}) {
+        my $cb = delete $self->{callbacks}{ $body->{in_reply_to} };
+        $cb->($self, $msg) if $cb;
+        return;
+    }
+    my $h = $self->{handlers}{ $body->{type} };
+    if (!$h) {
+        $self->log("No handler for $body->{type}");
+        $self->reply($msg, { type => "error", code => 10,
+                             text => "unsupported: $body->{type}" })
+            if defined $body->{msg_id};
+        return;
+    }
+    $h->($self, $msg);
+}
+
+sub _fire_periodic {
+    my ($self) = @_;
+    return unless $self->{initialized};
+    my $now = time;
+    for my $task (@{ $self->{periodic} }) {
+        if ($now >= $task->[1]) {
+            $task->[1] = $now + $task->[0];
+            eval { $task->[2]->($self); 1 }
+                or $self->log("periodic task error: $@");
+        }
+    }
+}
+
+sub _next_deadline {
+    my ($self) = @_;
+    return 1.0 unless $self->{initialized} && @{ $self->{periodic} };
+    my $now = time;
+    my $min = 1.0;
+    for my $task (@{ $self->{periodic} }) {
+        my $dt = $task->[1] - $now;
+        $min = $dt if $dt < $min;
+    }
+    return $min > 0.01 ? $min : 0.01;
+}
+
+sub run {
+    my ($self) = @_;
+    my $sel = IO::Select->new(\*STDIN);
+    my $buf = "";
+    while (1) {
+        $self->_fire_periodic;
+        my @ready = $sel->can_read($self->_next_deadline);
+        next unless @ready;
+        my $n = sysread(STDIN, my $chunk, 65536);
+        last unless $n;               # EOF: maelstrom is done with us
+        $buf .= $chunk;
+        while ($buf =~ s/^(.*?)\n//) {
+            my $line = $1;
+            next unless length $line;
+            my $msg = eval { $json->decode($line) };
+            if (!$msg) { $self->log("bad JSON: $@"); next; }
+            $self->_dispatch($msg);
+        }
+    }
+}
+
+1;

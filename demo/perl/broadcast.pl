@@ -1,0 +1,60 @@
+#!/usr/bin/env perl
+# Broadcast demo node in Perl: neighbor gossip with retry until
+# acknowledged, so values survive partitions (counterpart of
+# demo/ruby/broadcast.rb and demo/python/broadcast.py).
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+use MaelstromNode;
+
+my $node = MaelstromNode->new;
+my %messages;           # value -> 1
+my @neighbors;
+my %unacked;            # neighbor -> { value -> 1 }
+
+$node->on(topology => sub {
+    my ($n, $msg) = @_;
+    @neighbors = @{ $msg->{body}{topology}{ $n->{node_id} } // [] };
+    $unacked{$_} //= {} for @neighbors;
+    $n->log("My neighbors are @neighbors");
+    $n->reply($msg, { type => "topology_ok" });
+});
+
+sub accept_value {
+    my ($value, $sender) = @_;
+    return if exists $messages{$value};
+    $messages{$value} = 1;
+    for my $nb (@neighbors) {
+        $unacked{$nb}{$value} = 1
+            unless defined $sender && $nb eq $sender;
+    }
+}
+
+$node->on(broadcast => sub {
+    my ($n, $msg) = @_;
+    accept_value($msg->{body}{message}, $msg->{src});
+    $n->reply($msg, { type => "broadcast_ok" })
+        if defined $msg->{body}{msg_id};
+});
+
+$node->on(read => sub {
+    my ($n, $msg) = @_;
+    my @vals = sort { $a <=> $b } keys %messages;
+    # numeric values round-trip as numbers
+    $n->reply($msg, { type => "read_ok", messages => [map { $_ + 0 } @vals] });
+});
+
+# re-send unacknowledged values until the neighbor acks
+$node->every(0.5 => sub {
+    my ($n) = @_;
+    for my $nb (keys %unacked) {
+        for my $v (keys %{ $unacked{$nb} }) {
+            $n->rpc($nb, { type => "broadcast", message => $v + 0 }, sub {
+                delete $unacked{$nb}{$v};
+            });
+        }
+    }
+});
+
+$node->run;

@@ -1,0 +1,17 @@
+#!/usr/bin/env perl
+# Echo server demo node in Perl (counterpart of demo/ruby/echo.rb and
+# demo/python/echo.py).
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+use MaelstromNode;
+
+my $node = MaelstromNode->new;
+
+$node->on(echo => sub {
+    my ($n, $msg) = @_;
+    $n->reply($msg, { type => "echo_ok", echo => $msg->{body}{echo} });
+});
+
+$node->run;

@@ -1,0 +1,40 @@
+#!/usr/bin/env perl
+# Grow-only-set CRDT demo node in Perl: periodic full-state gossip to
+# every peer, merge by union (counterpart of the reference's generic
+# CRDT server, demo/ruby/crdt.rb, serving workload/g_set.clj).
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+use MaelstromNode;
+
+my $node = MaelstromNode->new;
+my %elements;
+
+$node->on(add => sub {
+    my ($n, $msg) = @_;
+    $elements{ $msg->{body}{element} } = 1;
+    $n->reply($msg, { type => "add_ok" });
+});
+
+$node->on(read => sub {
+    my ($n, $msg) = @_;
+    my @vals = sort { $a <=> $b } keys %elements;
+    $n->reply($msg, { type => "read_ok", value => [map { $_ + 0 } @vals] });
+});
+
+$node->on(replicate => sub {
+    my ($n, $msg) = @_;
+    $elements{$_} = 1 for @{ $msg->{body}{value} };
+});
+
+$node->every(2.0 => sub {
+    my ($n) = @_;
+    my @vals = map { $_ + 0 } sort { $a <=> $b } keys %elements;
+    for my $peer (@{ $n->{node_ids} }) {
+        next if $peer eq $n->{node_id};
+        $n->send_msg($peer, { type => "replicate", value => \@vals });
+    }
+});
+
+$node->run;

@@ -1,19 +1,23 @@
-"""Linearizability checking for registers (the Knossos role).
+"""Linearizability checking over pluggable models (the Knossos role).
 
 The reference checks lin-kv with jepsen.tests.linearizable-register —
 per-key Knossos linearizability over independent keys
-(`workload/lin_kv.clj:95-102`). This module implements the
-Wing & Gong / Lowe (WGL) algorithm with memoization over
-(linearized-set, register-state) pairs, for a register supporting
-read / write / cas:
+(`workload/lin_kv.clj:95-102`). Knossos itself checks *arbitrary*
+models (knossos.model: register, cas-register, mutex, set, queue...);
+this module implements the Wing & Gong / Lowe (WGL) algorithm with
+memoization over (linearized-set, model-state) pairs, parameterized the
+same way: a `Model` maps (state, op, outcome) to the possible successor
+states.
 
   - ok ops must linearize with their observed results
   - info (indeterminate) ops may take effect at any point after their
     invocation, or never
   - fail ops definitely didn't happen and are excluded
 
-Histories are partitioned by key (values are [k, v] tuples, mirroring
-jepsen.independent), which keeps each search small.
+The register model carries the production lin-kv path (histories are
+partitioned by key — values are [k, v] tuples, mirroring
+jepsen.independent — which keeps each search small); the other models
+prove the engine's generality, pinned by the adversarial corpus.
 """
 
 from __future__ import annotations
@@ -24,28 +28,102 @@ from ..history import coerce_history
 INF = float("inf")
 
 
-def _apply(f, value, ok: bool, state):
-    """Possible next states for linearizing an op against `state`.
-    Returns a list of states (empty = inconsistent here)."""
-    if f == "read":
-        if ok:
-            return [state] if state == value else []
-        return [state]              # indeterminate read: no effect
-    if f == "write":
-        if ok:
-            return [value]
-        return [value, state]       # may or may not have happened
-    if f == "cas":
-        frm, to = value
-        if ok:
-            return [to] if state == frm else []
-        if state == frm:
-            return [to, state]
-        return [state]
-    raise ValueError(f"unknown register op {f!r}")
+class Model:
+    """A sequential specification. States must be hashable (they key
+    the WGL memo). `apply` returns every state the object could be in
+    after linearizing op (f, value) with the given outcome — empty list
+    means the op cannot linearize here. For ok ops `value` carries the
+    observed result where the op has one (knossos.model/step's ops)."""
+
+    initial = None
+
+    def apply(self, state, f, value, ok: bool) -> list:
+        raise NotImplementedError
 
 
-def check_register_history(ops, max_states: int = 5_000_000):
+class RegisterModel(Model):
+    """read / write / cas register — jepsen's cas-register model."""
+
+    initial = None
+
+    def apply(self, state, f, value, ok):
+        if f == "read":
+            if ok:
+                return [state] if state == value else []
+            return [state]          # indeterminate read: no effect
+        if f == "write":
+            if ok:
+                return [value]
+            return [value, state]   # may or may not have happened
+        if f == "cas":
+            frm, to = value
+            if ok:
+                return [to] if state == frm else []
+            if state == frm:
+                return [to, state]
+            return [state]
+        raise ValueError(f"unknown register op {f!r}")
+
+
+class MutexModel(Model):
+    """acquire / release lock — knossos.model/mutex. State: held?"""
+
+    initial = False
+
+    def apply(self, state, f, value, ok):
+        if f == "acquire":
+            if ok:
+                return [True] if not state else []
+            return [True, False] if not state else [True]
+        if f == "release":
+            if ok:
+                return [False] if state else []
+            return [False, True] if state else [False]
+        raise ValueError(f"unknown mutex op {f!r}")
+
+
+class SetModel(Model):
+    """Linearizable add / read set — knossos.model/set (NOT the CRDT
+    g-set checker: a read must observe exactly the linearized set)."""
+
+    initial = frozenset()
+
+    def apply(self, state, f, value, ok):
+        if f == "add":
+            s2 = state | frozenset((value,))
+            return [s2] if ok else [s2, state]
+        if f == "read":
+            if ok:
+                return [state] if state == frozenset(value) else []
+            return [state]
+        raise ValueError(f"unknown set op {f!r}")
+
+
+class QueueModel(Model):
+    """FIFO enqueue / dequeue — knossos.model/unordered-queue's ordered
+    sibling. State: tuple of pending values; a dequeue's observed value
+    must be the head."""
+
+    initial = ()
+
+    def apply(self, state, f, value, ok):
+        if f == "enqueue":
+            s2 = state + (value,)
+            return [s2] if ok else [s2, state]
+        if f == "dequeue":
+            if ok:
+                return ([state[1:]] if state and state[0] == value
+                        else [])
+            return [state[1:], state] if state else [state]
+        raise ValueError(f"unknown queue op {f!r}")
+
+
+MODELS = {"register": RegisterModel, "mutex": MutexModel,
+          "set": SetModel, "queue": QueueModel}
+
+
+def check_history(ops, model: Model | None = None,
+                  max_states: int = 5_000_000):
     """ops: [{f, value, inv, ret, ok}] with ret=INF for indeterminate ops.
     Returns {"valid": bool|"unknown", ...}.
 
@@ -58,6 +136,7 @@ def check_register_history(ops, max_states: int = 5_000_000):
     this form's memo key and candidate scan are O(concurrent window)
     (bounded by worker count + open indeterminate ops), so histories of
     many thousands of ops check definitively in seconds."""
+    model = model or RegisterModel()
     n = len(ops)
     if n == 0:
         return {"valid": True}
@@ -94,9 +173,10 @@ def check_register_history(ops, max_states: int = 5_000_000):
         return out
 
     seen = set()
-    best = (0, frozenset(), None)    # deepest configuration reached
+    s0 = model.initial
+    best = (0, frozenset(), s0)      # deepest configuration reached
     best_n = -1
-    stack = [((0, frozenset(), None), None)]
+    stack = [((0, frozenset(), s0), None)]
     while stack:
         (i, extra, state), it = stack.pop()
         if it is None:
@@ -112,8 +192,9 @@ def check_register_history(ops, max_states: int = 5_000_000):
                 return {"valid": "unknown",
                         "error": "WGL configuration cap exceeded"}
             it = iter([(j, s2) for j in candidates(i, extra)
-                       for s2 in _apply(ops[j]["f"], ops[j]["value"],
-                                        ops[j]["ok"], state)])
+                       for s2 in model.apply(state, ops[j]["f"],
+                                             ops[j]["value"],
+                                             ops[j]["ok"])])
         nxt = next(it, None)
         if nxt is None:
             continue
@@ -133,6 +214,12 @@ def check_register_history(ops, max_states: int = 5_000_000):
             {"f": stuck["f"], "value": stuck["value"],
              "ok": stuck["ok"], "inv": stuck["inv"],
              "ret": None if stuck["ret"] == INF else stuck["ret"]}}
+
+
+def check_register_history(ops, max_states: int = 5_000_000):
+    """The register instance of `check_history` (production lin-kv
+    path; kept as the stable entry point)."""
+    return check_history(ops, RegisterModel(), max_states)
 
 
 class LinearizableRegisterChecker(Checker):

@@ -180,6 +180,10 @@ DEMOS = [
     # nodes on the reusable C library (demo/c/maelstrom_node.h)
     {"workload": "echo", "bin": "demo/c/echo_lib"},
     {"workload": "g-set", "bin": "demo/c/gset"},
+    # perl nodes on demo/perl/MaelstromNode.pm (third userland language)
+    {"workload": "echo", "bin": "demo/perl/echo.pl"},
+    {"workload": "broadcast", "bin": "demo/perl/broadcast.pl"},
+    {"workload": "g-set", "bin": "demo/perl/g_set.pl"},
     {"workload": "broadcast", "bin": "demo/python/broadcast.py"},
     {"workload": "g-set", "bin": "demo/python/g_set.py"},
     {"workload": "g-counter", "bin": "demo/python/g_counter.py"},

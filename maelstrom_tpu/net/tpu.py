@@ -90,11 +90,25 @@ class NetStats:
     lost: jnp.ndarray
     dropped_partition: jnp.ndarray
     dropped_overflow: jnp.ndarray   # pool-full drops: MUST be 0 for a valid run
+    # [64] sends per wire-type code: the per-RPC-type breakdown the
+    # reference's tesser folds produce from the Fressian journal
+    # (net/journal.clj:339-347) — here it survives bench scale, where
+    # per-message journal rows don't
+    sent_by_type: jnp.ndarray
 
     @classmethod
     def zeros(cls) -> "NetStats":
         z = jnp.zeros((), I32)
-        return cls(z, z, z, z, z, z, z)
+        return cls(z, z, z, z, z, z, z, jnp.zeros(TYPE_BUCKETS, I32))
+
+
+TYPE_BUCKETS = 64     # wire type codes are small ints; 63 = overflow bin
+
+
+def count_by_type(counter, types, valid):
+    """Scatter-add valid message counts into per-type-code buckets."""
+    return counter.at[jnp.clip(types.reshape(-1), 0, TYPE_BUCKETS - 1)
+                      ].add(valid.reshape(-1).astype(I32))
 
 
 @struct.dataclass
@@ -214,7 +228,8 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
         sent_servers=st.sent_servers + jnp.sum((new & ~client).astype(I32)),
         lost=st.lost + jnp.sum(lost.astype(I32)),
         dropped_overflow=st.dropped_overflow
-        + jnp.sum((keep & ~ok).astype(I32)))
+        + jnp.sum((keep & ~ok).astype(I32)),
+        sent_by_type=count_by_type(st.sent_by_type, out.type, new))
     net = net.replace(pool=pool, stats=st,
                       next_mid=net.next_mid + jnp.sum(new.astype(I32)))
     return net, sent_view
@@ -351,10 +366,19 @@ def stats_dict(net: NetState) -> dict:
     """Pull the on-device counters to host, in the shape the net-stats
     checker reports (`net/checker.clj:43-70`). On a cluster-batched net
     (leading cluster axis from `parallel.make_cluster_sims`) each
-    counter is summed over the fleet."""
+    counter is summed over the fleet. `sent_by_type` becomes a
+    {type-code: count} map of the nonzero buckets."""
     import dataclasses
 
     import numpy as np
     st = jax.device_get(net.stats)
-    return {f.name: int(np.asarray(getattr(st, f.name)).sum())
-            for f in dataclasses.fields(st)}
+    out = {}
+    for f in dataclasses.fields(st):
+        a = np.asarray(getattr(st, f.name))
+        if f.name == "sent_by_type":
+            per_type = a.reshape(-1, TYPE_BUCKETS).sum(axis=0)
+            out[f.name] = {int(t): int(c) for t, c in
+                           enumerate(per_type) if c}
+        else:
+            out[f.name] = int(a.sum())
+    return out

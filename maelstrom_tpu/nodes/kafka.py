@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
 from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from . import EncodeCapacityError, NodeProgram, register
+from . import EncodeCapacityError, NodeProgram, T_ERROR, register
 
 T_SEND = 10        # a = key, b = interned msg
 T_SEND_OK = 11     # a = offset
@@ -45,7 +45,7 @@ T_COMMIT = 14      # a|b|c = packed per-key offsets (+1, 16 bits each)
 T_COMMIT_OK = 15
 T_LIST = 16
 T_LIST_OK = 17     # a|b|c = packed committed offsets (+1)
-T_ERROR = 1        # a = code
+# T_ERROR (= 1) comes from the shared reply vocabulary in nodes/__init__
 T_REPL = 20        # edge lane k: a = sender len, b = offset, c = msg
 
 MAX_PACK_KEYS = 6  # 2 x 16-bit fields per wire word, 3 words

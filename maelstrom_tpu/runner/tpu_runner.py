@@ -135,6 +135,26 @@ class TpuNetStats(Checker):
         out["lost"] = c["lost"]
         out["dropped-partition"] = c["dropped_partition"]
         out["dropped-overflow"] = c["dropped_overflow"]
+        # per-RPC-type send breakdown (the reference derives this from
+        # journal folds; the device counter survives bench scale where
+        # journal rows don't). Wire codes name themselves through the
+        # program module's T_* constants.
+        by_type = c.get("sent_by_type") or {}
+        if by_type:
+            import sys
+
+            from .. import nodes as _nodes_mod
+            mod = sys.modules.get(type(self.runner.program).__module__)
+            names = {}
+            # the program's own codes win; the shared reply vocabulary
+            # (nodes/__init__: T_ERROR etc.) names the rest
+            for source in (mod, _nodes_mod):
+                for k, v in (vars(source) if source else {}).items():
+                    if k.startswith("T_") and isinstance(v, int):
+                        names.setdefault(v, k[2:].lower())
+            out["send-count-by-type"] = {
+                names.get(t, f"type-{t}"): n
+                for t, n in sorted(by_type.items())}
         ch = self.runner.sim.channels
         overwrites = 0
         lat_clipped = 0

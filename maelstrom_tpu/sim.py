@@ -97,7 +97,9 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     st0 = net.stats
     net = net.replace(stats=st0.replace(
         sent_all=st0.sent_all + n_all,
-        recv_all=st0.recv_all + n_all))
+        recv_all=st0.recv_all + n_all,
+        sent_by_type=T.count_by_type(st0.sent_by_type, flat.type,
+                                     flat.valid)))
     client_msgs = (replies if pool_client_msgs.valid.shape[0] == 0
                    else jax.tree.map(
                        lambda a, b: jnp.concatenate([a, b]),
@@ -156,7 +158,9 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         lost=st.lost + jnp.sum(
             (edge_out.valid & ~blocked[:, :, None] & lost).astype(I32)),
         dropped_partition=st.dropped_partition + jnp.sum(
-            (edge_out.valid & blocked[:, :, None]).astype(I32)))
+            (edge_out.valid & blocked[:, :, None]).astype(I32)),
+        sent_by_type=T.count_by_type(st.sent_by_type, edge_out.type,
+                                     edge_out.valid))
     net = net.replace(stats=st)
     net = T.advance(net)
     return (SimState(net=net, nodes=nodes, key=key, channels=ch),

@@ -463,3 +463,74 @@ def test_g_nonadjacent_near_miss_write_skew_is_g2():
     a = analyze(h)
     assert "G2" in a, a
     assert "G-nonadjacent" not in a, a
+
+
+# --- Knossos-style model generality: mutex / set / queue ---
+
+def _mop(f, value, inv, ret, ok=True):
+    return {"f": f, "value": value, "inv": inv, "ret": ret, "ok": ok}
+
+
+def test_mutex_double_acquire_fires():
+    from maelstrom_tpu.checkers.linearizable import (MutexModel,
+                                                     check_history)
+    # two non-overlapping acquires with no release between them
+    h = [_mop("acquire", None, 0, 1), _mop("acquire", None, 2, 3)]
+    r = check_history(h, MutexModel())
+    assert r["valid"] is False
+    assert r["stuck-op"]["f"] == "acquire"
+
+
+def test_mutex_handoff_legal():
+    from maelstrom_tpu.checkers.linearizable import (MutexModel,
+                                                     check_history)
+    h = [_mop("acquire", None, 0, 1), _mop("release", None, 2, 3),
+         _mop("acquire", None, 4, 5), _mop("release", None, 6, 7)]
+    assert check_history(h, MutexModel())["valid"] is True
+
+
+def test_mutex_indeterminate_release_allows_reacquire():
+    from maelstrom_tpu.checkers.linearizable import (MutexModel,
+                                                     check_history)
+    # the release never completed — it MAY have happened, so a later
+    # acquire stays legal; but a second acquire after that is not
+    h = [_mop("acquire", None, 0, 1),
+         _mop("release", None, 2, INF, ok=False),
+         _mop("acquire", None, 3, 4)]
+    assert check_history(h, MutexModel())["valid"] is True
+    h.append(_mop("acquire", None, 5, 6))
+    assert check_history(h, MutexModel())["valid"] is False
+
+
+def test_set_read_missing_add_fires():
+    from maelstrom_tpu.checkers.linearizable import (SetModel,
+                                                     check_history)
+    # add 1 completed before the read began, yet the read saw {}
+    h = [_mop("add", 1, 0, 1), _mop("read", [], 2, 3)]
+    r = check_history(h, SetModel())
+    assert r["valid"] is False
+    # concurrent version is legal (the add may linearize after)
+    h2 = [_mop("add", 1, 0, 5), _mop("read", [], 2, 3)]
+    assert check_history(h2, SetModel())["valid"] is True
+
+
+def test_queue_fifo_order_fires():
+    from maelstrom_tpu.checkers.linearizable import (QueueModel,
+                                                     check_history)
+    # enqueue 1 then 2 (sequential), dequeue observes 2 first: not FIFO
+    h = [_mop("enqueue", 1, 0, 1), _mop("enqueue", 2, 2, 3),
+         _mop("dequeue", 2, 4, 5)]
+    assert check_history(h, QueueModel())["valid"] is False
+    # dequeuing 1 first is the legal history
+    h2 = [_mop("enqueue", 1, 0, 1), _mop("enqueue", 2, 2, 3),
+          _mop("dequeue", 1, 4, 5), _mop("dequeue", 2, 6, 7)]
+    assert check_history(h2, QueueModel())["valid"] is True
+
+
+def test_queue_concurrent_enqueues_either_order():
+    from maelstrom_tpu.checkers.linearizable import (QueueModel,
+                                                     check_history)
+    # overlapping enqueues: both dequeue orders are linearizable
+    h = [_mop("enqueue", 1, 0, 10), _mop("enqueue", 2, 1, 9),
+         _mop("dequeue", 2, 11, 12), _mop("dequeue", 1, 13, 14)]
+    assert check_history(h, QueueModel())["valid"] is True

@@ -211,3 +211,23 @@ def test_c_broadcast_node_e2e_with_partitions(tmp_path):
     w = res["workload"]
     assert w["lost-count"] == 0
     assert w["stable-count"] > 0
+
+
+def test_perl_broadcast_node_e2e_with_partitions(tmp_path):
+    """The third-language node: the Perl broadcast (gossip +
+    retry-until-ack on demo/perl/MaelstromNode.pm, written against
+    doc/protocol.md alone) passes the set-full checker under partitions
+    — proving the any-language-over-stdio contract a third time
+    (reference ships Ruby/Python/Clojure node libraries)."""
+    import shutil
+
+    if shutil.which("perl") is None:
+        pytest.skip("no perl")
+    res = run(tmp_path, workload="broadcast",
+              bin=os.path.join(REPO, "demo", "perl", "broadcast.pl"),
+              node_count=5, topology="grid", rate=10.0, time_limit=6,
+              nemesis={"partition"}, nemesis_interval=2, recovery_s=3)
+    assert res["valid"] is True, res.get("workload")
+    w = res["workload"]
+    assert w["lost-count"] == 0
+    assert w["stable-count"] > 0

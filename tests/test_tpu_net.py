@@ -247,3 +247,26 @@ def test_deliver_under_jit_and_scan():
     assert int(counts.sum()) == 9       # delivered once per round from r1
     st = T.stats_dict(net)
     assert st["recv_all"] == 9 and st["dropped_overflow"] == 0
+
+
+def test_sent_by_type_counters():
+    """The per-RPC-type device counters: pool sends bucket by wire type
+    code, summed correctly across rounds (the journal-fold breakdown at
+    bench scale)."""
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+
+    cfg = T.NetConfig(n_nodes=2, n_clients=0, pool_cap=16, inbox_cap=4)
+    net = T.make_net(cfg)
+    key = jax.random.PRNGKey(0)
+    m = T.Msgs.empty(3).replace(
+        valid=jnp.array([True, True, False]),
+        src=jnp.array([0, 1, 0]), dest=jnp.array([1, 0, 1]),
+        type=jnp.array([10, 10, 12]))
+    net, _ = T._send(cfg, net, m, key)
+    m2 = m.replace(type=jnp.array([12, 10, 10]))
+    net, _ = T._send(cfg, net, m2, key)
+    st = T.stats_dict(net)
+    assert st["sent_by_type"] == {10: 3, 12: 1}, st["sent_by_type"]
+    assert st["sent_all"] == 4
