@@ -85,11 +85,23 @@ sub reply {
 }
 
 sub rpc {
-    my ($self, $dest, $body, $callback) = @_;
+    my ($self, $dest, $body, $callback, $timeout_s) = @_;
     my $msg_id = ++$self->{next_msg_id};
-    $self->{callbacks}{$msg_id} = $callback if $callback;
+    # callbacks are reaped after timeout_s (default 5 s): a reply eaten
+    # by a partition must not leak its closure forever (the C library
+    # reaps via mn_rpc timeouts the same way)
+    $self->{callbacks}{$msg_id} =
+        [$callback, time + ($timeout_s // 5)] if $callback;
     $self->send_msg($dest, { %$body, msg_id => $msg_id });
     return $msg_id;
+}
+
+sub _reap_callbacks {
+    my ($self) = @_;
+    my $now = time;
+    delete @{ $self->{callbacks} }
+        { grep { $self->{callbacks}{$_}[1] < $now }
+          keys %{ $self->{callbacks} } };
 }
 
 sub _dispatch {
@@ -97,7 +109,7 @@ sub _dispatch {
     my $body = $msg->{body};
     if (defined $body->{in_reply_to}) {
         my $cb = delete $self->{callbacks}{ $body->{in_reply_to} };
-        $cb->($self, $msg) if $cb;
+        $cb->[0]->($self, $msg) if $cb;
         return;
     }
     my $h = $self->{handlers}{ $body->{type} };
@@ -142,6 +154,7 @@ sub run {
     my $buf = "";
     while (1) {
         $self->_fire_periodic;
+        $self->_reap_callbacks;
         my @ready = $sel->can_read($self->_next_deadline);
         next unless @ready;
         my $n = sysread(STDIN, my $chunk, 65536);

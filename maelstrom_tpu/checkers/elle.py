@@ -15,7 +15,8 @@ core of Elle's list-append analysis:
    transactions load the same version of a key — a read in the same
    transaction, own appends stripped — and both append to it; flagged
    even when no later read ever observes the colliding appends, the
-   case the dependency graph alone cannot see).
+   case the dependency graph alone cannot see); internal (a read
+   disagreeing with the transaction's own earlier appends).
 3. Dependency graph over transactions: ww (version succession), wr (read
    observes a version), rw (anti-dependency: read of v precedes writer of
    v+1), plus rt (real-time) edges for strict serializability.
@@ -169,6 +170,41 @@ def analyze(history) -> dict:
                     if w_appends and vv[-1] != w_appends[-1]:
                         add_anom("G1b", {"key": k, "read": v,
                                          "writer-appends": w_appends})
+
+    # --- internal consistency: within one transaction, a read of k
+    # after the transaction's own appends to k must observe those
+    # appends, in order, as the list's suffix (the txn is one atomic
+    # point: it sees the pre-state plus its own writes so far). Elle's
+    # :internal anomaly class.
+    # Two rules: (a) own appends so far must be the read's suffix; (b)
+    # the pre-state a read reveals (the read minus that suffix) must
+    # match what the txn's FIRST read of the key revealed — a txn whose
+    # later read shows a different pre-state watched other commits move
+    # underneath it mid-transaction.
+    for t in txns:
+        if not t["ok"]:
+            continue
+        own_sofar: dict = {}
+        pre_seen: dict = {}            # kk -> pre-state from first read
+        for f, k, v in t["micro"]:
+            kk = _hk(k)
+            if f == "append":
+                own_sofar.setdefault(kk, []).append(_hv(v))
+            elif f == "r" and isinstance(v, list):
+                mine = own_sofar.get(kk, [])
+                vv = [_hv(x) for x in v]
+                if mine and vv[-len(mine):] != mine:
+                    add_anom("internal",
+                             {"txn": t["id"], "key": k, "read": v,
+                              "own-appends": list(mine)})
+                    continue
+                pre = vv[:len(vv) - len(mine)] if mine else vv
+                if kk in pre_seen and pre_seen[kk] != pre:
+                    add_anom("internal",
+                             {"txn": t["id"], "key": k, "read": v,
+                              "expected-pre-state": pre_seen[kk],
+                              "observed-pre-state": pre})
+                pre_seen.setdefault(kk, pre)
 
     # --- cyclic version order: union the adjacencies every observed
     # read asserts for a key; a cycle means no version order can satisfy
@@ -496,21 +532,21 @@ ILLEGAL = {
     # gates the serializable models only
     "read-uncommitted": {"G0", "G1a", "duplicate-appends",
                          "incompatible-order", "phantom-element",
-                         "cyclic-version-order"},
+                         "cyclic-version-order", "internal"},
     "read-committed": {"G0", "G1a", "G1b", "G1c", "duplicate-appends",
                        "incompatible-order", "phantom-element",
-                       "cyclic-version-order"},
+                       "cyclic-version-order", "internal"},
     "serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
                      "G-nonadjacent", "lost-update",
                      "duplicate-appends", "incompatible-order",
-                     "phantom-element", "cyclic-version-order"},
+                     "phantom-element", "cyclic-version-order", "internal"},
     "strict-serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
                             "G-nonadjacent", "lost-update",
                             "G0-realtime", "G1c-realtime",
                             "G-single-realtime", "G2-realtime",
                             "G-nonadjacent-realtime",
                             "duplicate-appends", "incompatible-order",
-                            "phantom-element", "cyclic-version-order"},
+                            "phantom-element", "cyclic-version-order", "internal"},
 }
 
 

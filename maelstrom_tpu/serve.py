@@ -86,12 +86,25 @@ class StoreHandler(http.server.SimpleHTTPRequestHandler):
             "<html><head><title>maelstrom-tpu runs</title><style>"
             "body{font-family:sans-serif;margin:2em}"
             "table{border-collapse:collapse}"
+            "th{cursor:pointer;text-decoration:underline dotted}"
             "td,th{padding:.3em .8em;border-bottom:1px solid #ddd;"
-            "text-align:left}</style></head><body>"
+            "text-align:left}</style>"
+            # column-click sorting, like jepsen's run table (core.clj:230)
+            "<script>function srt(c){const t=document.querySelector"
+            "('table'),r=[...t.rows].slice(1),d=t.dataset.d!==String(c)"
+            "||t.dataset.a!=='1';t.dataset.d=c;t.dataset.a=d?'1':'0';"
+            "const f=s=>/^-?\\d+(\\.\\d+)?$/.test(s)?parseFloat(s):null;"
+            "r.sort((x,y)=>{const a=x.cells[c].innerText,"
+            "b=y.cells[c].innerText,na=f(a),nb=f(b);"
+            "return (na!==null&&nb!==null?na-nb:a.localeCompare(b))"
+            "*(d?1:-1)});"
+            "r.forEach(e=>t.appendChild(e))}</script></head><body>"
             f"<h2>runs ({len(rows)})</h2>"
-            "<table><tr><th>time</th><th>workload</th><th>valid</th>"
-            "<th>ops</th><th>links</th></tr>"
-            f"{''.join(rows)}</table>"
+            "<table><tr>"
+            + "".join(f"<th onclick='srt({i})'>{h}</th>" for i, h in
+                      enumerate(["time", "workload", "valid", "ops",
+                                 "links"]))
+            + f"</tr>{''.join(rows)}</table>"
             f"<p>browse: {dirs}</p></body></html>")
         return self._send_html(body)
 

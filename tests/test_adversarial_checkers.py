@@ -534,3 +534,51 @@ def test_queue_concurrent_enqueues_either_order():
     h = [_mop("enqueue", 1, 0, 10), _mop("enqueue", 2, 1, 9),
          _mop("dequeue", 2, 11, 12), _mop("dequeue", 1, 13, 14)]
     assert check_history(h, QueueModel())["valid"] is True
+
+
+# --- internal (list-append): own appends must be the read's suffix ---
+
+def test_internal_fires():
+    # the txn appended 5 to key 1, then its own read misses it
+    h = []
+    _txn_pair(h, [["append", 1, 5], ["r", 1, None]],
+              [["append", 1, 5], ["r", 1, []]], 0, 1)
+    a = analyze(h)
+    assert "internal" in a, a
+    r = _check(h, ["read-uncommitted"])
+    assert r["valid"] is False and "internal" in r["anomalies"]
+
+
+def test_internal_near_miss_own_suffix():
+    # pre-state [3] plus the own append as suffix: consistent
+    h = []
+    _txn_pair(h, [["append", 1, 3]], [["append", 1, 3]], 0, 1)
+    _txn_pair(h, [["append", 1, 5], ["r", 1, None]],
+              [["append", 1, 5], ["r", 1, [3, 5]]], 2, 3)
+    a = analyze(h)
+    assert "internal" not in a, a
+
+
+def test_internal_fires_on_shifting_pre_state():
+    # B's later read reveals a different pre-state than its first read:
+    # the world moved underneath the transaction mid-flight
+    h = []
+    _txn_pair(h, [["append", 1, 3]], [["append", 1, 3]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 5], ["r", 1, None]],
+              [["r", 1, []], ["append", 1, 5], ["r", 1, [3, 5]]],
+              1, 11, proc=1)
+    a = analyze(h)
+    assert "internal" in a, a
+    r = _check(h, ["read-committed"])
+    assert r["valid"] is False and "internal" in r["anomalies"]
+
+
+def test_internal_near_miss_stable_pre_state():
+    # both reads reveal pre-state [3]: internally consistent
+    h = []
+    _txn_pair(h, [["append", 1, 3]], [["append", 1, 3]], 0, 1)
+    _txn_pair(h, [["r", 1, None], ["append", 1, 5], ["r", 1, None]],
+              [["r", 1, [3]], ["append", 1, 5], ["r", 1, [3, 5]]],
+              2, 3)
+    a = analyze(h)
+    assert "internal" not in a, a
