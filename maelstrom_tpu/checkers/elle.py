@@ -16,7 +16,9 @@ core of Elle's list-append analysis:
    transaction, own appends stripped — and both append to it; flagged
    even when no later read ever observes the colliding appends, the
    case the dependency graph alone cannot see); internal (a read
-   disagreeing with the transaction's own earlier appends).
+   disagreeing with the transaction's own earlier appends); fuzzy-read
+   (Adya P2: two reads in one transaction revealing different
+   pre-states — legal at read-committed, fatal at serializable).
 3. Dependency graph over transactions: ww (version succession), wr (read
    observes a version), rw (anti-dependency: read of v precedes writer of
    v+1), plus rt (real-time) edges for strict serializability.
@@ -198,12 +200,16 @@ def analyze(history) -> dict:
                              {"txn": t["id"], "key": k, "read": v,
                               "own-appends": list(mine)})
                     continue
+                # a later read revealing a DIFFERENT pre-state than the
+                # first is Adya's P2 (fuzzy / non-repeatable read) — a
+                # distinct anomaly, legal at read-committed and below,
+                # NOT an internal-atomicity break
                 pre = vv[:len(vv) - len(mine)] if mine else vv
                 if kk in pre_seen and pre_seen[kk] != pre:
-                    add_anom("internal",
+                    add_anom("fuzzy-read",
                              {"txn": t["id"], "key": k, "read": v,
-                              "expected-pre-state": pre_seen[kk],
-                              "observed-pre-state": pre})
+                              "first-pre-state": pre_seen[kk],
+                              "later-pre-state": pre})
                 pre_seen.setdefault(kk, pre)
 
     # --- cyclic version order: union the adjacencies every observed
@@ -536,12 +542,13 @@ ILLEGAL = {
     "read-committed": {"G0", "G1a", "G1b", "G1c", "duplicate-appends",
                        "incompatible-order", "phantom-element",
                        "cyclic-version-order", "internal"},
+    # fuzzy-read (Adya P2) is legal at read-committed and below
     "serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
-                     "G-nonadjacent", "lost-update",
+                     "G-nonadjacent", "lost-update", "fuzzy-read",
                      "duplicate-appends", "incompatible-order",
                      "phantom-element", "cyclic-version-order", "internal"},
     "strict-serializable": {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
-                            "G-nonadjacent", "lost-update",
+                            "G-nonadjacent", "lost-update", "fuzzy-read",
                             "G0-realtime", "G1c-realtime",
                             "G-single-realtime", "G2-realtime",
                             "G-nonadjacent-realtime",

@@ -1,0 +1,192 @@
+"""Multi-host DCN execution check (SURVEY.md section 5.8).
+
+`parallel.multihost_mesh` claims the sharded cluster round scales
+across hosts with no application changes — XLA routing the mesh
+collectives over DCN instead of ICI. This module EXECUTES that claim
+without TPU pod hardware: two OS processes, each owning 4 virtual CPU
+devices, join one `jax.distributed` cluster (gloo over loopback TCP —
+the same cross-process transport shape as DCN), build the global
+("dp", "sp") mesh over all 8 devices, and drive the REAL broadcast
+cluster round — partitions and message loss active — sharded across
+both processes.
+
+Every process also runs the identical simulation unsharded on its
+device 0 and digests both final states with the same order-sensitive
+checksum. The run passes iff the cross-process sharded digest equals
+the local unsharded digest on every process: the multi-host path
+preserves semantics bit-for-bit, executed over real cross-process
+collectives (not just compiled).
+
+Usage (the test and `python -m maelstrom_tpu.dcn_check` drive this):
+    dcn_check worker <process_id> <port>     # run one process
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _digest(tree):
+    """Order-sensitive int32 wrap-around checksum of every array leaf,
+    computed under jit so the sharded case reduces with the mesh's own
+    collectives; identical across backends for identical values."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree.leaves(tree):
+        flat = jnp.ravel(leaf).astype(jnp.int32)
+        w = (jnp.arange(flat.shape[0], dtype=jnp.int32) % 997) + 1
+        total = total + jnp.sum(flat * w, dtype=jnp.int32)
+    return total
+
+
+def worker(process_id: int, port: int, rounds: int = 12,
+           n_clusters: int = 4, n_nodes: int = 16) -> dict:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from .parallel import multihost_mesh
+
+    # before any other JAX API: distributed init must precede backend up
+    mesh = multihost_mesh(coordinator_address=f"localhost:{port}",
+                          num_processes=2, process_id=process_id, dp=2)
+
+    import jax.numpy as jnp
+
+    from .net import tpu as T
+    from .nodes import get_program
+    from .parallel import (make_cluster_round_fn, make_cluster_sims,
+                           sim_shardings)
+
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    sp = mesh.shape["sp"]
+
+    nodes = [f"n{i}" for i in range(n_nodes)]
+    program = get_program(
+        "broadcast",
+        {"topology": "grid", "max_values": 8, "latency": {"mean": 0}},
+        nodes)
+    cfg = T.NetConfig(n_nodes=n_nodes, n_clients=1, pool_cap=32 * sp,
+                      inbox_cap=program.inbox_cap, client_cap=4)
+    inject = T.Msgs.empty((n_clusters, 2))
+    inject = inject.replace(
+        valid=inject.valid.at[:, 0].set(True),
+        src=jnp.full_like(inject.src, n_nodes),
+        type=jnp.full_like(inject.type, 10))          # T_BCAST
+
+    split = jnp.asarray([0] * (n_nodes // 2) + [1] * (n_nodes // 2),
+                        jnp.int32)
+
+    def set_comp(sims, labels):
+        net = sims.net
+        return sims.replace(net=net.replace(
+            component=net.component.at[:, :n_nodes].set(labels[None, :])))
+
+    def drive(sims, fn):
+        for i in range(rounds):
+            if i == 3:
+                sims = set_comp(sims, split)
+            if i == 8:
+                sims = set_comp(sims, jnp.zeros_like(split))
+            sims, _cm, _io = fn(sims, inject)
+        return sims
+
+    def prep(sims):
+        return sims.replace(net=sims.net.replace(
+            p_loss=jnp.full_like(sims.net.p_loss, 0.05)))
+
+    # local unsharded reference (device 0 of this process)
+    sims_u = prep(make_cluster_sims(program, cfg, n_clusters, seed=0))
+    sims_u = drive(sims_u, make_cluster_round_fn(program, cfg))
+    digest_u = int(jax.device_get(jax.jit(_digest)(sims_u)))
+
+    # the same simulation sharded over the GLOBAL 2-process mesh:
+    # dp crosses the process boundary, so every round's collectives
+    # ride the cross-process (gloo/DCN) transport
+    sims_s = prep(make_cluster_sims(program, cfg, n_clusters, seed=0))
+    sims_s = jax.device_put(sims_s, sim_shardings(mesh, sims_s))
+    inj_s = jax.device_put(inject, sim_shardings(mesh, inject))
+    fn_s = make_cluster_round_fn(program, cfg, mesh=mesh,
+                                 example=sims_s, example_inject=inj_s)
+    with mesh:
+        sims_s = drive(sims_s, lambda s, i: fn_s(s, inj_s))
+        # a sharded array spans non-addressable devices: reduce to
+        # explicitly-replicated scalars on device, then read this
+        # process's local shard (every process sees the same values)
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(mesh, P())
+
+        def pull(s):
+            st = s.net.stats
+            return (_digest(s),
+                    jnp.sum(st.dropped_partition.astype(jnp.int32)),
+                    jnp.sum(st.lost.astype(jnp.int32)),
+                    jnp.sum(st.dropped_overflow.astype(jnp.int32)))
+        vals = jax.jit(pull, out_shardings=rep)(sims_s)
+        digest_s, drop_part, lost_n, drop_ovf = (
+            int(np.asarray(v.addressable_shards[0].data)) for v in vals)
+        stats = {"dropped_partition": drop_part, "lost": lost_n,
+                 "dropped_overflow": drop_ovf}
+
+    out = {"process": process_id,
+           "devices_global": len(jax.devices()),
+           "devices_local": len(jax.local_devices()),
+           "mesh": dict(mesh.shape),
+           "rounds": rounds,
+           "digest_unsharded": digest_u,
+           "digest_sharded": digest_s,
+           "match": digest_u == digest_s,
+           "dropped_partition": stats["dropped_partition"],
+           "lost": stats["lost"],
+           "dropped_overflow": stats["dropped_overflow"]}
+    print(json.dumps(out), flush=True)
+    if not out["match"]:
+        raise SystemExit(2)
+    if not (stats["dropped_partition"] > 0 and stats["lost"] > 0):
+        raise SystemExit(3)       # the faults must actually have fired
+    return out
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "worker":
+        worker(int(argv[1]), int(argv[2]))
+        return 0
+    # launcher: spawn both processes and require both to pass. Default
+    # port varies by pid so a stale coordinator from a killed run can't
+    # wedge the next one; on any failure/timeout both children are
+    # reaped and their stderr tails surfaced.
+    import subprocess
+    port = int(argv[0]) if argv else 12000 + os.getpid() % 4000
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "maelstrom_tpu.dcn_check", "worker",
+         str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=540))
+    except subprocess.TimeoutExpired:
+        outs.append(("", "(timed out)"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    ok = all(p.returncode == 0 for p in procs)
+    for o, err in outs:
+        print(o.strip().splitlines()[-1] if o.strip()
+              else f"(no output; stderr tail: {err.strip()[-400:]})")
+    print(json.dumps({"dcn_check": "ok" if ok else "FAIL"}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

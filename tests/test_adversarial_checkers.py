@@ -559,18 +559,21 @@ def test_internal_near_miss_own_suffix():
     assert "internal" not in a, a
 
 
-def test_internal_fires_on_shifting_pre_state():
+def test_fuzzy_read_fires_on_shifting_pre_state():
     # B's later read reveals a different pre-state than its first read:
-    # the world moved underneath the transaction mid-flight
+    # Adya P2 (non-repeatable read) — legal at read-committed, fatal at
+    # serializable
     h = []
     _txn_pair(h, [["append", 1, 3]], [["append", 1, 3]], 0, 10, proc=0)
     _txn_pair(h, [["r", 1, None], ["append", 1, 5], ["r", 1, None]],
               [["r", 1, []], ["append", 1, 5], ["r", 1, [3, 5]]],
               1, 11, proc=1)
     a = analyze(h)
-    assert "internal" in a, a
-    r = _check(h, ["read-committed"])
-    assert r["valid"] is False and "internal" in r["anomalies"]
+    assert "fuzzy-read" in a, a
+    assert "internal" not in a, a
+    assert _check(h, ["read-committed"])["valid"] is True
+    r = _check(h, ["serializable"])
+    assert r["valid"] is False and "fuzzy-read" in r["anomalies"]
 
 
 def test_internal_near_miss_stable_pre_state():
