@@ -66,19 +66,22 @@ class RegisterModel(Model):
 
 
 class MutexModel(Model):
-    """acquire / release lock — knossos.model/mutex. State: held?"""
+    """acquire / release lock — knossos.model/mutex, holder-aware.
+    State: None (free) or the holder id (`value`; an anonymous op is
+    its own holder). A release by a non-holder cannot linearize."""
 
-    initial = False
+    initial = None
 
     def apply(self, state, f, value, ok):
+        h = value if value is not None else True
         if f == "acquire":
             if ok:
-                return [True] if not state else []
-            return [True, False] if not state else [True]
+                return [h] if state is None else []
+            return [h, None] if state is None else [state]
         if f == "release":
             if ok:
-                return [False] if state else []
-            return [False, True] if state else [False]
+                return [None] if state == h else []
+            return [None, state] if state == h else [state]
         raise ValueError(f"unknown mutex op {f!r}")
 
 

@@ -26,8 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
                                   "tpu:broadcast (instead of --bin)")
     t.add_argument("-w", "--workload", default="lin-kv",
                    choices=["broadcast", "echo", "g-set", "g-counter",
-                            "pn-counter", "lin-kv", "txn-list-append",
-                            "unique-ids", "kafka", "txn-rw-register"],
+                            "pn-counter", "lin-kv", "lin-mutex",
+                            "txn-list-append", "unique-ids", "kafka",
+                            "txn-rw-register"],
                    help="What workload to run")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
@@ -204,6 +205,7 @@ DEMOS = [
     {"workload": "pn-counter", "node": "tpu:pn-counter"},
     {"workload": "g-counter", "node": "tpu:g-counter"},
     {"workload": "lin-kv", "node": "tpu:lin-kv"},
+    {"workload": "lin-mutex", "node": "tpu:lin-kv"},
     {"workload": "txn-list-append", "node": "tpu:txn-list-append"},
     {"workload": "unique-ids", "node": "tpu:unique-ids"},
     {"workload": "kafka", "node": "tpu:kafka"},

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from ..net.static import EdgeConfig, EdgeMsgs, reverse_index
 from ..net.tpu import I32
 from ..workloads.broadcast import TOPOLOGIES, topology_indices
-from . import NodeProgram, register
+from . import NodeProgram, T_ERROR as T_ERR, register
 
 # client RPCs
 T_READ = 10       # a = key
@@ -410,6 +410,7 @@ class RaftProgram(NodeProgram):
         proxy_a = jnp.zeros((N,), I32)
         proxy_b = jnp.zeros((N,), I32)
         proxy_c = jnp.zeros((N,), I32)
+        shed = jnp.zeros((N, K), bool)
         if "client" not in self.ablate and K > 0:
             is_txn = client_in.type == T_TXN                    # [N, K]
             keyk = jnp.where(is_txn, 0,
@@ -451,6 +452,15 @@ class RaftProgram(NodeProgram):
             proxy_a = pick((keyk << 4) | op_of)
             proxy_b = pick(eb)
             proxy_c = pick(client_in.mid)
+            # a request this node can NEITHER serve NOR forward —
+            # no known leader, or not the one proxy slot this round —
+            # fails fast with error 11 (temporarily-unavailable,
+            # definite), like the reference raft demo's not-a-leader
+            # reply: the client retries immediately instead of eating
+            # the full RPC timeout on a silently shed request
+            have_hint = (s["leader_hint"] >= 0)[:, None]
+            slot_i = jnp.arange(K, dtype=I32)[None, :]
+            shed = want & (~have_hint | (slot_i != k0[:, None]))
 
         # proxied requests arriving at the leader: append (one per edge)
         if "proxy" not in self.ablate:
@@ -600,6 +610,14 @@ class RaftProgram(NodeProgram):
             b=pack3(l0_b, l1_b, l2_b, window[:, :, :, 1]),
             c=pack3(l0_c, l1_c, l2_c, window[:, :, :, 2]))
 
+        # merge the shed-request error replies: apply replies exist only
+        # on leaders, sheds only on non-leaders — the slot sets are
+        # disjoint by construction
+        out_valid = out_valid | shed
+        out_dest = jnp.where(shed, client_in.src, out_dest)
+        out_type = jnp.where(shed, T_ERR, out_type)
+        out_a = jnp.where(shed, 11, out_a)
+        out_reply = jnp.where(shed, client_in.mid, out_reply)
         client_out = client_in.replace(
             valid=out_valid, dest=out_dest, type=out_type, a=out_a,
             b=jnp.zeros((N, A), I32), c=jnp.zeros((N, A), I32),

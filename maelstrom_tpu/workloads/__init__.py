@@ -7,9 +7,10 @@ from __future__ import annotations
 
 def registry() -> dict:
     from . import (broadcast, echo, g_counter, g_set, kafka, lin_kv,
-                   pn_counter, txn_list_append, txn_rw_register,
-                   unique_ids)
+                   lin_mutex, pn_counter, txn_list_append,
+                   txn_rw_register, unique_ids)
     return {
+        "lin-mutex": lin_mutex.workload,
         "broadcast": broadcast.workload,
         "echo": echo.workload,
         "g-set": g_set.workload,

@@ -209,3 +209,54 @@ def test_elle_realtime_anomaly_survives_data_subcycle():
     (anom,) = r["anomalies"][rt_keys[0]]
     assert 2 in anom["txns"]
     assert "-[rt]->" in anom["cycle"]
+
+
+def test_lin_mutex_checker_catches_double_hold():
+    """Forged history: two non-overlapping successful acquires with no
+    release between them — the mutex model must reject what the
+    register view of the same cas ops cannot see... (the register view
+    IS consistent only if the server misbehaved; here we forge the
+    mutual-exclusion break directly)."""
+    from maelstrom_tpu.history import History, Op
+    from maelstrom_tpu.workloads.lin_mutex import FREE, LinMutexChecker
+
+    def cas(t0, t1, frm, to, proc, typ="ok"):
+        return [Op(type="invoke", f="cas", value=[0, [frm, to]],
+                   process=proc, time=t0),
+                Op(type=typ, f="cas", value=[0, [frm, to]],
+                   process=proc, time=t1)]
+
+    # both workers acquire ok, sequentially, no release: impossible
+    h = (cas(0, 1, FREE, 2, 0) + cas(2, 3, FREE, 3, 1))
+    r = LinMutexChecker().check({}, History([o for p in [h] for o in p]))
+    assert r["valid"] is False, r
+    assert r["mutex"]["valid"] is False
+
+    # legal handoff: init, acquire(2), release(2), acquire(3)
+    init = [Op(type="invoke", f="write", value=[0, FREE], process=0,
+               time=-2),
+            Op(type="ok", f="write", value=[0, FREE], process=0,
+               time=-1)]
+    h2 = (init + cas(0, 1, FREE, 2, 0) + cas(2, 3, 2, FREE, 0)
+          + cas(4, 5, FREE, 3, 1))
+    r2 = LinMutexChecker().check({}, History(h2))
+    assert r2["valid"] is True, r2
+
+
+def test_lin_mutex_checker_rejects_foreign_release():
+    """A release by a worker that never held the lock linearizes
+    nowhere under the holder-aware model."""
+    from maelstrom_tpu.history import History, Op
+    from maelstrom_tpu.workloads.lin_mutex import FREE, LinMutexChecker
+
+    ops = [Op(type="invoke", f="cas", value=[0, [FREE, 2]], process=0,
+              time=0),
+           Op(type="ok", f="cas", value=[0, [FREE, 2]], process=0,
+              time=1),
+           # worker 1 "releases" holder 3's lock — never acquired
+           Op(type="invoke", f="cas", value=[0, [3, FREE]], process=1,
+              time=2),
+           Op(type="ok", f="cas", value=[0, [3, FREE]], process=1,
+              time=3)]
+    r = LinMutexChecker().check({}, History(ops))
+    assert r["valid"] is False, r

@@ -18,6 +18,7 @@ CONFIGS = [
     ("pn-counter", "tpu:pn-counter", {}),
     ("g-counter", "tpu:g-counter", {}),
     ("lin-kv", "tpu:lin-kv", {}),
+    ("lin-mutex", "tpu:lin-kv", {}),
     ("unique-ids", "tpu:unique-ids", {}),
     ("kafka", "tpu:kafka", {}),
     ("txn-list-append", "tpu:txn-list-append", {}),
