@@ -189,6 +189,11 @@ RAFT_SWEEP = [
      "latency": {"mean": 2, "dist": "uniform"}, "partition": False},
     {"name": "latency3-exponential+partition", "p_loss": 0.0,
      "latency": {"mean": 3, "dist": "exponential"}, "partition": True},
+    # everything at once: the mix most likely to compose failure modes
+    # (the torn-AE bug needed reordering AND elections; loss on top
+    # exercises the retry machinery under both)
+    {"name": "loss4%+latency2-exponential+partition", "p_loss": 0.04,
+     "latency": {"mean": 2, "dist": "exponential"}, "partition": True},
 ]
 
 
@@ -238,6 +243,8 @@ KAFKA_SWEEP = [
      "latency": {"mean": 3, "dist": "uniform"}, "partition": False},
     {"name": "latency5-exponential+partition", "p_loss": 0.0,
      "latency": {"mean": 5, "dist": "exponential"}, "partition": True},
+    {"name": "loss3%+latency3-exponential+partition", "p_loss": 0.03,
+     "latency": {"mean": 3, "dist": "exponential"}, "partition": True},
 ]
 
 
