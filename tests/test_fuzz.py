@@ -26,7 +26,7 @@ def test_fuzz_raft_sweep_small():
     from maelstrom_tpu.fuzz import fuzz_raft
 
     rows = fuzz_raft(n_clusters=12, sample=4, seed=3, log=lambda s: None)
-    assert len(rows) == 4
+    assert len(rows) == 5
     for r in rows:
         assert r["ok"] is True, r
         assert r["dropped_overflow"] == 0
@@ -40,7 +40,7 @@ def test_fuzz_kafka_sweep_small():
 
     rows = fuzz_kafka(seed=5, time_limit=3.0, rate=12.0,
                       log=lambda s: None)
-    assert len(rows) == 4
+    assert len(rows) == 5
     for r in rows:
         assert r["ok"] is True, r
         assert r["dropped_overflow"] == 0
