@@ -3,7 +3,13 @@ message loss, and nonzero latency together, end to end through the
 interactive runner, graded by each workload's stock checker. The point
 is breadth — every program's protocol machinery (retries, re-offers,
 election barriers, ownership routing) exercised under the same storm
-its tutorial chapter claims it survives."""
+its tutorial chapter claims it survives.
+
+Two storms per program: constant latency, and EXPONENTIAL latency —
+randomized delays reorder messages (including header-vs-payload within
+a protocol, the mode that exposed the torn-AE bug), so every program
+faces out-of-order delivery plus loss plus partitions. Constant
+latency can never reorder; the second storm is the one that can."""
 
 import pytest
 
@@ -25,15 +31,22 @@ CONFIGS = [
     ("txn-rw-register", "tpu:txn-rw-register", {}),
 ]
 
+STORMS = [
+    ("constant", 11, {"mean": 5, "dist": "constant"}, 0.03),
+    ("reordering", 23, {"mean": 3, "dist": "exponential"}, 0.02),
+]
 
+
+@pytest.mark.parametrize("storm,seed,latency,p_loss", STORMS,
+                         ids=[s[0] for s in STORMS])
 @pytest.mark.parametrize("workload,node,extra",
                          CONFIGS, ids=[c[0] for c in CONFIGS])
-def test_fault_soup(workload, node, extra):
+def test_fault_soup(workload, node, extra, storm, seed, latency, p_loss):
     res = core.run(dict(
-        store_root="/tmp/maelstrom-tpu-test-store", seed=11,
+        store_root="/tmp/maelstrom-tpu-test-store", seed=seed,
         workload=workload, node=node, node_count=5,
         rate=15.0, time_limit=4.0, journal_rows=False,
-        latency={"mean": 5, "dist": "constant"}, p_loss=0.03,
+        latency=latency, p_loss=p_loss,
         nemesis={"partition"}, nemesis_interval=2.0, **extra))
     assert res["valid"] is True, {
         k: v for k, v in res.items()
