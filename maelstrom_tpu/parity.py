@@ -129,8 +129,15 @@ def main(argv=None):
     # multi-hour re-measurement; the JSON is the measurement of record)
     render_only = "--render-only" in argv
     time_limit = float(os.environ.get("PARITY_TIME_LIMIT", 20.0))
-    out_json = os.environ.get("PARITY_OUT", "artifacts/parity.json")
-    out_md = os.environ.get("PARITY_MD", "doc/parity.md")
+    # --quick is a smoke run: never clobber the full-suite measurement
+    # of record (artifacts/parity.json + doc/parity.md)
+    out_json = os.environ.get(
+        "PARITY_OUT",
+        "artifacts/parity-quick.json" if quick else
+        "artifacts/parity.json")
+    out_md = os.environ.get(
+        "PARITY_MD",
+        "/tmp/parity-quick.md" if quick else "doc/parity.md")
 
     if render_only:
         with open(out_json) as f:
@@ -232,6 +239,24 @@ def main(argv=None):
         "    invisible at 100 ms/hop, exactly as observed. Per-hop",
         "    delivery here is exact by construction",
         "    (tests/test_edge_oracle.py).",
+        "  - *The offset is now measured, not just fitted*",
+        "    (`python -m maelstrom_tpu.parity_ackstamp`): driving this",
+        "    framework's own wall-clock host path at the exact parity",
+        "    config — 25 node processes, 25 concurrent synchronous",
+        "    client workers, rate 100, 10 ms hops — with the broadcast",
+        "    node stamping the monotonic instant it first holds each",
+        "    value, the measured (ack-stamp − server-had-value) lag has",
+        "    **median 22.9 ms** (p25 1.4 ms, artifacts/ackstamp_lag.json)",
+        "    on this 1-core box; the identical run at rate 25 (the box",
+        "    unsaturated) measures **median 0.77 ms** (p75 4.2 ms, p90",
+        "    14.5 ms, artifacts/ackstamp_lag_rate25.json). Client links",
+        "    are zero-latency in both harnesses, so this lag is pure",
+        "    handler/worker scheduling plus history stamping — it is",
+        "    strongly load-dependent, and the reference's fitted",
+        "    7.5–8.5 ms at rate 100 on its own (multi-core JVM) box sits",
+        "    squarely inside the measured band [0.8, 22.9] ms that the",
+        "    same mechanism produces here. The fit is thereby grounded",
+        "    in a measured distribution of the mechanism it names.",
         "- The **max of the exponential run** is a single order",
         "  statistic of an unbounded distribution (one latency draw);",
         "  the reference's own 630 ms is one sample of the same tail.",
