@@ -47,9 +47,20 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--p-loss", type=float, default=0.0,
                    help="Probability each message is lost in transit")
     t.add_argument("--nemesis", default="",
-                   help="Comma-separated faults (partition)")
+                   help="Comma-separated fault packages to compose: "
+                        "partition, kill, pause, duplicate "
+                        "(e.g. --nemesis kill,pause,partition,duplicate)")
     t.add_argument("--nemesis-interval", type=float, default=10.0,
                    help="Seconds between nemesis operations")
+    t.add_argument("--client-retries", type=int, default=0,
+                   help="Client RPC retry budget: failed/unavailable "
+                        "RPCs re-issue up to N times under exponential "
+                        "backoff with jitter (0 = no retries)")
+    t.add_argument("--client-backoff-ms", type=float, default=50.0,
+                   help="Base client retry backoff in ms (doubles per "
+                        "attempt)")
+    t.add_argument("--client-backoff-cap-ms", type=float, default=2000.0,
+                   help="Upper bound on a single client retry backoff")
     t.add_argument("--topology", default="grid",
                    choices=["line", "grid", "tree", "tree2", "tree3",
                             "tree4", "total"],
@@ -150,6 +161,9 @@ def opts_from_args(args) -> dict:
         "p_loss": args.p_loss,
         "nemesis": set(filter(None, args.nemesis.split(","))),
         "nemesis_interval": args.nemesis_interval,
+        "client_retries": args.client_retries,
+        "client_backoff_ms": args.client_backoff_ms,
+        "client_backoff_cap_ms": args.client_backoff_cap_ms,
         "topology": args.topology,
         "key_count": args.key_count,
         "max_txn_length": args.max_txn_length,

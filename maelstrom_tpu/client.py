@@ -48,7 +48,14 @@ class SyncClient:
             raise RuntimeError("Can't send more than one message at a time!")
         self._waiting_for = msg_id
         body = dict(body, msg_id=msg_id)
-        self.net.send({"src": self.node_id, "dest": dest, "body": body})
+        try:
+            self.net.send({"src": self.node_id, "dest": dest, "body": body})
+        except Exception:
+            # a failed send (e.g. node-not-found while the nemesis has
+            # the destination killed) leaves nothing outstanding; the
+            # client must stay usable for the next op
+            self._waiting_for = None
+            raise
         return msg_id
 
     def recv(self, timeout_ms: float = DEFAULT_TIMEOUT_MS) -> dict:
@@ -84,19 +91,85 @@ class SyncClient:
         return rbody
 
 
-def with_errors(op: dict, idempotent: set, thunk):
+class RetryPolicy:
+    """Client-side RPC retry: truncated exponential backoff with full
+    jitter and a retry budget cap. Where the client previously retried
+    nothing (one attempt, then the full RPC timeout decided the op),
+    a policy with a nonzero budget re-issues unavailability failures —
+    sleep ~ U(0, min(cap, base * 2^attempt)) between attempts — which is
+    what keeps availability up across kill/pause/partition windows.
+    Configured from the CLI: --client-retries / --client-backoff-ms /
+    --client-backoff-cap-ms."""
+
+    def __init__(self, retries: int = 0, base_ms: float = 50.0,
+                 cap_ms: float = 2000.0, seed=0):
+        import random
+        self.retries = int(retries)
+        self.base_ms = float(base_ms)
+        self.cap_ms = float(cap_ms)
+        self.rng = random.Random(f"retry:{seed}")
+
+    @classmethod
+    def from_test(cls, test: dict, salt="") -> "RetryPolicy | None":
+        """`salt` decorrelates jitter across clients (pass the client's
+        own id): a fault window fails many concurrent ops at once, and
+        identically-seeded policies would re-issue them in lockstep —
+        a thundering herd against the recovering node, exactly what the
+        jitter exists to prevent."""
+        n = int(test.get("client_retries") or 0)
+        if n <= 0:
+            return None
+        return cls(retries=n,
+                   base_ms=float(test.get("client_backoff_ms") or 50.0),
+                   cap_ms=float(test.get("client_backoff_cap_ms")
+                                or 2000.0),
+                   seed=f"{test.get('seed') or 0}:{salt}")
+
+    def sleep(self, attempt: int):
+        bound = min(self.cap_ms, self.base_ms * (2 ** attempt))
+        _time.sleep(self.rng.uniform(0, bound) / 1000.0)
+
+
+# Definite unavailability errors: the op definitely did NOT happen, so a
+# retry is safe even for non-idempotent ops (node-not-found covers RPCs
+# to a crash-killed node; temporarily-unavailable covers e.g. a raft
+# follower with no known leader).
+RETRYABLE_DEFINITE = {1, 11}
+
+
+def with_errors(op: dict, idempotent: set, thunk, retry=None):
     """Evaluates thunk() (which returns the completed op); maps RPC errors to
     completions: timeouts -> info (or fail if idempotent), definite errors ->
-    fail, indefinite -> info (reference `client.clj:214-233`)."""
-    try:
-        return thunk()
-    except Timeout:
-        t = FAIL if op.get("f") in idempotent else INFO
-        return {**op, "type": t, "error": "net-timeout"}
-    except RPCError as e:
-        t = FAIL if (e.definite or op.get("f") in idempotent) else INFO
-        return {**op, "type": t,
-                "error": [e.name, e.body.get("text")]}
+    fail, indefinite -> info (reference `client.clj:214-233`).
+
+    With a RetryPolicy, unavailability failures are retried under
+    exponential backoff before completing: definite unavailability
+    (RETRYABLE_DEFINITE) retries for any op — it definitely didn't
+    happen; timeouts and other indefinite errors retry only idempotent
+    ops (re-issuing an op that may have happened would double-apply)."""
+    attempt = 0
+    idem = op.get("f") in idempotent
+    while True:
+        budget_left = retry is not None and attempt < retry.retries
+        try:
+            return thunk()
+        except Timeout:
+            if budget_left and idem:
+                retry.sleep(attempt)
+                attempt += 1
+                continue
+            t = FAIL if idem else INFO
+            return {**op, "type": t, "error": "net-timeout"}
+        except RPCError as e:
+            retryable = (e.code in RETRYABLE_DEFINITE
+                         or (not e.definite and idem))
+            if budget_left and retryable:
+                retry.sleep(attempt)
+                attempt += 1
+                continue
+            t = FAIL if (e.definite or idem) else INFO
+            return {**op, "type": t,
+                    "error": [e.name, e.body.get("text")]}
 
 
 # --- Typed RPC definitions (reference client.clj:237-331) ---

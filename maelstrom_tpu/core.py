@@ -39,6 +39,7 @@ DEFAULTS = dict(
     key_count=None, max_txn_length=4, max_writes_per_key=16,
     consistency_models=["strict-serializable"], log_stderr=False,
     log_net_send=False, log_net_recv=False, seed=0, store_root="store",
+    client_retries=0, client_backoff_ms=50.0, client_backoff_cap_ms=2000.0,
 )
 
 
@@ -76,16 +77,19 @@ def build_test(opts: dict) -> dict:
         main = g.sleep(opts["time_limit"])
     main = g.time_limit(opts["time_limit"],
                         g.nemesis_wrap(nemesis_pkg["generator"], main))
+    # Final phases (reference core.clj:66-71): the nemesis ALWAYS heals
+    # every fault type it injected — restart killed nodes, resume paused
+    # ones, drop partitions, stop duplication — so checkers grade a
+    # recovered cluster; workloads with a final generator then get their
+    # recovery window and final reads.
+    phase_list = [main]
+    if nemesis_pkg["final_generator"] is not None:
+        phase_list.append(g.nemesis_gen(nemesis_pkg["final_generator"]))
     if workload.get("final_generator") is not None:
-        main = g.phases(
-            main,
-            (g.nemesis_gen(nemesis_pkg["final_generator"])
-             if nemesis_pkg["final_generator"] is not None else None),
-            g.Log("Waiting for recovery..."),
-            g.sleep(opts.get("recovery_s", 10)),
-            g.clients(workload["final_generator"]))
-    else:
-        main = g.phases(main)
+        phase_list += [g.Log("Waiting for recovery..."),
+                       g.sleep(opts.get("recovery_s", 10)),
+                       g.clients(workload["final_generator"])]
+    main = g.phases(*phase_list)
 
     checker = Compose({
         "perf": PerfChecker(),
@@ -142,8 +146,8 @@ def _run(test: dict, net: HostNet, test_dir: str) -> dict:
 
     db = HostDB(net, test["bin"], test.get("bin_args") or [],
                 service_seed=test["seed"])
-    test["nemesis"] = (nem.PartitionNemesis(net, test["nodes"],
-                                            seed=test["seed"])
+    test["nemesis"] = (nem.CombinedNemesis(net, test["nodes"],
+                                           seed=test["seed"], db=db)
                        if test["nemesis_pkg"]["generator"] is not None
                        else None)
     log.info("Running test %s with nodes %s", test["name"], test["nodes"])

@@ -60,10 +60,22 @@ class HostDB:
         self.services: ServiceRunner | None = None
         self.processes: dict[str, NodeProcess] = {}
         self.service_seed = service_seed
+        self.test: dict = {}
+        self._restarts: dict[str, int] = {}
+
+    def _spawn(self, node_id: str):
+        log_dir = os.path.join(self.test.get("store_dir", "store"),
+                               "node-logs")
+        gen = self._restarts.get(node_id, 0)
+        suffix = f".restart{gen}" if gen else ""
+        self.processes[node_id] = NodeProcess(
+            node_id=node_id, bin=self.bin, args=self.args, net=self.net,
+            log_file=os.path.join(log_dir, f"{node_id}{suffix}.log"),
+            log_stderr=self.test.get("log_stderr", False))
 
     def setup(self, test: dict):
+        self.test = test
         nodes = test["nodes"]
-        log_dir = os.path.join(test.get("store_dir", "store"), "node-logs")
         # services first (reference db.clj:24-29; primary-only there, but we
         # set up all nodes from one place)
         self.services = ServiceRunner(
@@ -71,12 +83,43 @@ class HostDB:
         self.services.start()
         for node_id in nodes:
             log.info("Setting up %s", node_id)
-            self.processes[node_id] = NodeProcess(
-                node_id=node_id, bin=self.bin, args=self.args, net=self.net,
-                log_file=os.path.join(log_dir, f"{node_id}.log"),
-                log_stderr=test.get("log_stderr", False))
+            self._spawn(node_id)
         for node_id in nodes:
             init_node(self.net, node_id, nodes)
+
+    # --- nemesis process control (kill/pause fault packages) ---
+
+    def kill_node(self, node_id: str):
+        """Crash-kill: SIGKILL, no crash report (intentional). The node
+        stays down until restart_node respawns it."""
+        log.info("nemesis: killing %s", node_id)
+        p = self.processes.pop(node_id, None)
+        if p is not None:
+            p.kill()
+
+    def restart_node(self, node_id: str):
+        """Respawn a killed node and rerun the init handshake: the
+        binary recovers whatever it persisted itself (its durable
+        store); everything in memory is gone."""
+        log.info("nemesis: restarting %s", node_id)
+        self._restarts[node_id] = self._restarts.get(node_id, 0) + 1
+        self._spawn(node_id)
+        init_node(self.net, node_id, self.test["nodes"])
+
+    def pause_node(self, node_id: str):
+        """SIGSTOP. A node the kill package took down in the meantime
+        has no process to stop — the pause is then vacuous (it is
+        already maximally stalled)."""
+        log.info("nemesis: pausing %s", node_id)
+        p = self.processes.get(node_id)
+        if p is not None:
+            p.pause()
+
+    def resume_node(self, node_id: str):
+        log.info("nemesis: resuming %s", node_id)
+        p = self.processes.get(node_id)
+        if p is not None and p.paused:
+            p.resume()
 
     def teardown(self) -> list[Exception]:
         """Stops everything; returns (rather than raises) crash exceptions

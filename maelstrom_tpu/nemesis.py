@@ -1,14 +1,37 @@
-"""Fault injection: the nemesis.
+"""Fault injection: the composable combined nemesis.
 
-Reimplements the reference's nemesis package (`src/maelstrom/nemesis.clj` +
-jepsen.nemesis.combined/partition-package): a special 'nemesis' process
-receives `start-partition` / `stop-partition` ops from its own generator and
-applies them to the network as directional block-sets (reference
-`net.clj:108-112`). Partition grudges: random halves, majorities-ring, or a
-single isolated node. The package generator emits a fault roughly every
-`interval` seconds and the final generator heals everything so
+Reimplements and extends the reference's nemesis package
+(`src/maelstrom/nemesis.clj` + jepsen.nemesis.combined): a special
+'nemesis' process receives fault ops from its own generator and applies
+them to the network and the nodes. Where the reference CLI stops at
+bidirectional partitions (`core.clj:40-42`), this module is a registry of
+*fault packages* in the style of jepsen.nemesis.combined:
+
+  - ``partition``  — network partitions with grudge shapes: random
+    halves, single isolated node, ``bridge`` (two halves joined by one
+    node), ``majorities-ring`` (every node sees a majority, but
+    different, ring-overlapping majorities — directional), and one-way
+    splits (traffic flows a->b but not b->a).
+  - ``kill``       — crash a minority of nodes: volatile state is wiped
+    and the node restarts from its durable store (`NodeProgram.
+    durable_keys`; SIGKILL + respawn on the host path).
+  - ``pause``      — a minority of nodes stop stepping but keep state
+    (GC/VM stalls; SIGSTOP/SIGCONT on the host path).
+  - ``duplicate``  — at-least-once delivery: inter-server messages are
+    re-enqueued with an independent latency draw with probability p.
+
+Each package runs its own on/off generator schedule (offset so packages
+interleave within the interval), built from the same ``g.Seq``/``cycle``
+combinators the rest of the suite uses; ``package`` composes the
+selected set and a final generator that heals *every* fault type so
 eventually-consistent workloads are graded post-recovery
 (reference `core.clj:63-70`).
+
+Determinism: every random decision (grudge shape, kill/pause targets,
+duplication probability) is drawn from a per-fault-package RNG stream
+seeded from (seed, fault name). Same seed => same decision sequence per
+package, regardless of how the packages interleave and identically on
+the host and TPU paths (`NemesisDecisions`).
 """
 
 from __future__ import annotations
@@ -16,6 +39,18 @@ from __future__ import annotations
 import random
 
 from . import generators as g
+
+FAULTS = ("partition", "kill", "pause", "duplicate")
+
+# duplication probabilities the duplicate package cycles through
+DUP_PROBS = (0.1, 0.25, 0.5)
+
+
+# --- partition grudges -----------------------------------------------------
+#
+# A grudge maps dest -> set of blocked srcs (directional: src->dest
+# messages are consumed and dropped). Symmetric grudges list both
+# directions explicitly.
 
 
 def split_half(nodes, rng: random.Random):
@@ -44,21 +79,142 @@ def isolate_node(nodes, rng: random.Random):
     return f"isolated {n}", grudge
 
 
-GRUDGES = [split_half, isolate_node]
+def bridge(nodes, rng: random.Random):
+    """Two halves joined only through one bridge node (jepsen
+    nemesis/bridge): the halves cannot talk directly, but both talk to
+    the bridge, so no component separation exists — this grudge needs
+    the directional pair representation."""
+    nodes = list(nodes)
+    rng.shuffle(nodes)
+    mid = nodes[len(nodes) // 2]
+    a = set(nodes[: len(nodes) // 2])
+    b = set(nodes) - a - {mid}
+    grudge = {}
+    for d in a:
+        grudge[d] = set(b)
+    for d in b:
+        grudge[d] = set(a)
+    return f"bridge {mid} between {sorted(a)} | {sorted(b)}", grudge
 
 
-class PartitionNemesis:
-    """Executes nemesis ops against the network's fault API."""
+def majorities_ring(nodes, rng: random.Random):
+    """Every node receives only from a majority-sized window starting at
+    itself in (shuffled) ring order — overlapping majorities, directional
+    (i hears i..i+m-1; i+m-1 does not hear i). The jepsen
+    nemesis/majorities-ring grudge."""
+    ring = list(nodes)
+    rng.shuffle(ring)
+    n = len(ring)
+    m = n // 2 + 1
+    grudge = {}
+    for i, d in enumerate(ring):
+        visible = {ring[(i + j) % n] for j in range(m)}
+        grudge[d] = set(ring) - visible
+    return f"majorities-ring {ring}", grudge
 
-    def __init__(self, net, nodes, seed: int = 0):
-        self.net = net
+
+def one_way_halves(nodes, rng: random.Random):
+    """Asymmetric split: half A's messages reach half B, but B's never
+    reach A — the stale-leader/one-way-link shape symmetric partitions
+    cannot express."""
+    nodes = list(nodes)
+    rng.shuffle(nodes)
+    k = len(nodes) // 2
+    a, b = set(nodes[:k]), set(nodes[k:])
+    grudge = {d: set(b) for d in a}     # B -> A blocked; A -> B flows
+    return f"one-way {sorted(b)} -/-> {sorted(a)}", grudge
+
+
+GRUDGES = [split_half, isolate_node, bridge, majorities_ring,
+           one_way_halves]
+
+
+# --- shared fault decisions ------------------------------------------------
+
+
+class NemesisDecisions:
+    """The random choices a nemesis makes, factored out so the host and
+    TPU executors draw IDENTICAL sequences from the same seed: one
+    independent RNG stream per fault package, keyed by (seed, fault), so
+    the decision sequence of each package does not depend on how the
+    packages happen to interleave in real vs virtual time."""
+
+    def __init__(self, nodes, seed: int = 0):
         self.nodes = list(nodes)
-        self.rng = random.Random(seed)
+        self.seed = seed
+        self.rngs = {f: random.Random(f"{seed}:{f}") for f in FAULTS}
+        # legacy alias: pre-combined checkpoints stored a single rng
+        self.rng = self.rngs["partition"]
+
+    def next_grudge(self):
+        rng = self.rngs["partition"]
+        return rng.choice(GRUDGES)(self.nodes, rng)
+
+    def _minority(self, fault: str):
+        """A non-empty set of target nodes: at most (n-1)//2 — a strict
+        minority, so clusters of n >= 3 keep quorum through the fault
+        window. Degenerate clusters (n <= 2) have no non-empty strict
+        minority; there the package still targets one node, accepting a
+        transient quorum loss that heals at the stop op."""
+        rng = self.rngs[fault]
+        k = rng.randint(1, max(1, (len(self.nodes) - 1) // 2))
+        return sorted(rng.sample(self.nodes, k))
+
+    def next_kill_targets(self):
+        return self._minority("kill")
+
+    def next_pause_targets(self):
+        return self._minority("pause")
+
+    def next_dup_prob(self) -> float:
+        return self.rngs["duplicate"].choice(DUP_PROBS)
+
+    # checkpoint/resume: the decision streams plus the active-fault
+    # bookkeeping must survive together
+    def rng_state(self):
+        return {"rngs": {f: r.getstate() for f, r in self.rngs.items()},
+                "killed": list(getattr(self, "killed", [])),
+                "paused_nodes": list(getattr(self, "paused_nodes", []))}
+
+    def set_rng_state(self, st):
+        if not isinstance(st, dict) or "rngs" not in st:
+            # legacy checkpoint: a single partition-rng state tuple
+            self.rngs["partition"].setstate(st)
+            return
+        for f, s in st["rngs"].items():
+            self.rngs[f].setstate(s)
+        if hasattr(self, "killed"):
+            self.killed = list(st.get("killed", []))
+        if hasattr(self, "paused_nodes"):
+            self.paused_nodes = list(st.get("paused_nodes", []))
+
+
+# --- host-path executor ----------------------------------------------------
+
+
+class CombinedNemesis(NemesisDecisions):
+    """Executes nemesis ops against the host network's fault API and the
+    node processes (via the DB): the host-path analogue of
+    jepsen.nemesis.combined/compose-packages."""
+
+    def __init__(self, net, nodes, seed: int = 0, db=None):
+        super().__init__(nodes, seed)
+        self.net = net
+        self.db = db
+        self.killed: list = []
+        self.paused_nodes: list = []
+
+    def _need_db(self, f):
+        if self.db is None:
+            raise ValueError(
+                f"nemesis op {f!r} needs process control, but no DB was "
+                "wired (kill/pause require the bin path's HostDB)")
+        return self.db
 
     def invoke(self, op: dict) -> dict:
         f = op["f"]
         if f == "start-partition":
-            name, grudge = self.rng.choice(GRUDGES)(self.nodes, self.rng)
+            name, grudge = self.next_grudge()
             for dest, srcs in grudge.items():
                 for src in srcs:
                     self.net.drop_link(src, dest)
@@ -66,24 +222,106 @@ class PartitionNemesis:
         if f == "stop-partition":
             self.net.heal()
             return {**op, "type": "info", "value": "healed"}
+        if f == "start-kill":
+            # targets come straight from the kill decision stream — no
+            # cross-package filtering, so the op's value depends only on
+            # this package's RNG (the determinism contract). Overlaps
+            # (killing a paused node) are handled at the process layer.
+            db = self._need_db(f)
+            targets = self.next_kill_targets()
+            for n in targets:
+                if n not in self.killed:
+                    db.kill_node(n)
+            self.killed = sorted(set(self.killed) | set(targets))
+            return {**op, "type": "info", "value": f"killed {targets}"}
+        if f == "stop-kill":
+            db = self._need_db(f)
+            restarted, self.killed = self.killed, []
+            for n in restarted:
+                db.restart_node(n)
+                if n in self.paused_nodes:
+                    # a still-open pause window covers this node: the
+                    # respawn must come back stalled, like the TPU
+                    # path's mask (stop-pause lifts it)
+                    db.pause_node(n)
+            return {**op, "type": "info",
+                    "value": f"restarted {restarted}"}
+        if f == "start-pause":
+            db = self._need_db(f)
+            targets = self.next_pause_targets()
+            for n in targets:
+                if n not in self.paused_nodes:
+                    db.pause_node(n)
+            self.paused_nodes = sorted(set(self.paused_nodes)
+                                       | set(targets))
+            return {**op, "type": "info", "value": f"paused {targets}"}
+        if f == "stop-pause":
+            db = self._need_db(f)
+            resumed, self.paused_nodes = self.paused_nodes, []
+            for n in resumed:
+                db.resume_node(n)
+            return {**op, "type": "info", "value": f"resumed {resumed}"}
+        if f == "start-duplicate":
+            p = self.next_dup_prob()
+            self.net.duplicate(p)
+            return {**op, "type": "info", "value": f"duplicate p={p}"}
+        if f == "stop-duplicate":
+            self.net.duplicate(0.0)
+            return {**op, "type": "info", "value": "duplicate off"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
 
-def package(faults: set, interval_s: float = 10.0):
-    """Builds {generator, final_generator} for the requested fault set
-    (only :partition, like the reference CLI, `core.clj:40-42`)."""
-    if "partition" not in faults:
-        return {"generator": None, "final_generator": None}
+# Backwards-compatible name: the partition-only executor grew into the
+# combined one (partition ops behave identically).
+PartitionNemesis = CombinedNemesis
 
-    # g.cycle pickles (checkpoint/resume); Seq never mutates the pristine
-    # Sleep instances it re-yields each lap
-    schedule = g.cycle([
-        g.sleep(interval_s),
-        {"f": "start-partition", "type": "invoke"},
-        g.sleep(interval_s),
-        {"f": "stop-partition", "type": "invoke"},
+
+# --- schedules -------------------------------------------------------------
+
+
+def fault_schedule(fault: str, interval_s: float, offset_s: float):
+    """One package's generator: wait out its stagger offset, then cycle
+    start -> hold an interval -> stop -> rest an interval, forever (the
+    outer time-limit cuts it; the final generator heals leftovers).
+    g.cycle pickles (checkpoint/resume); Seq never mutates the pristine
+    Sleep instances it re-yields each lap."""
+    return g.Seq([
+        g.sleep(offset_s),
+        g.Seq(g.cycle([
+            {"f": f"start-{fault}", "type": "invoke"},
+            g.sleep(interval_s),
+            {"f": f"stop-{fault}", "type": "invoke"},
+            g.sleep(interval_s),
+        ])),
     ])
 
-    return {"generator": g.Seq(schedule),
-            "final_generator": g.Once({"f": "stop-partition",
-                                       "type": "invoke"})}
+
+def package(faults: set, interval_s: float = 10.0):
+    """Builds {generator, final_generator, faults} for the requested
+    fault set — any subset of ``partition``, ``kill``, ``pause``,
+    ``duplicate`` (the reference CLI stops at partition,
+    `core.clj:40-42`). Packages compose: each keeps its own schedule,
+    staggered across the interval so a ``kill,pause,partition`` run
+    overlaps faults rather than synchronizing them. The final generator
+    emits a stop op for every selected package so ALL fault types heal
+    before recovery grading."""
+    faults = set(faults)
+    unknown = faults - set(FAULTS)
+    if unknown:
+        raise ValueError(f"unknown nemesis fault(s) {sorted(unknown)}; "
+                         f"expected any of {list(FAULTS)}")
+    ordered = [f for f in FAULTS if f in faults]
+    if not ordered:
+        return {"generator": None, "final_generator": None, "faults": ()}
+
+    n = len(ordered)
+    gens = [fault_schedule(f, interval_s, interval_s * (i + 1) / n)
+            for i, f in enumerate(ordered)]
+    sched = gens[0]
+    for sub in gens[1:]:
+        sched = g.Any2(sched, sub)
+
+    final = g.Seq([{"f": f"stop-{f}", "type": "invoke"}
+                   for f in ordered])
+    return {"generator": sched, "final_generator": final,
+            "faults": tuple(ordered)}

@@ -101,6 +101,7 @@ class HostNet:
         self.log_recv = log_recv
         self.journal: Journal | None = None
         self.p_loss = 0.0
+        self.p_dup = 0.0        # at-least-once duplication (servers only)
         self.partitions: dict[str, set[str]] = {}   # dest -> blocked srcs
         self.queues: dict[str, _NodeQueue] = {}
         self.next_client_id = itertools.count(0)
@@ -155,6 +156,12 @@ class HostNet:
     def flaky(self, p: float = 0.5):
         self.p_loss = p
 
+    def duplicate(self, p: float = 0.25):
+        """At-least-once delivery: each inter-server message is enqueued
+        a second time with probability p, under an independent latency
+        draw (same message id — it IS the same message, twice)."""
+        self.p_dup = p
+
     # --- send / recv (reference net.clj:188-246) ---
 
     def latency_for_ms(self, msg: Message) -> float:
@@ -183,6 +190,14 @@ class HostNet:
         if self.rng.random() < self.p_loss:
             return msg      # whoops, lost ur packet (net.clj:213-214)
         dest_q.put(deadline_ns, msg)
+        if (self.p_dup > 0 and not involves_client(msg)
+                and self.rng.random() < self.p_dup):
+            # duplicate fault: the copy takes its own latency draw
+            # (clients exempt, like partitions — the fault models the
+            # server-to-server network)
+            dup_deadline = self.time_ns() + int(
+                self.latency_for_ms(msg) * 1e6)
+            dest_q.put(dup_deadline, msg)
         return msg
 
     def recv(self, node: str, timeout_ms: float) -> Optional[Message]:

@@ -90,6 +90,12 @@ class NetStats:
     lost: jnp.ndarray
     dropped_partition: jnp.ndarray
     dropped_overflow: jnp.ndarray   # pool-full drops: MUST be 0 for a valid run
+    # messages consumed because their destination was crash-killed by
+    # the nemesis (the process is down: delivery is connection-refused,
+    # unlike pause where the message waits in the pool)
+    dropped_down: jnp.ndarray
+    # extra at-least-once copies enqueued by the duplicate fault
+    duplicated: jnp.ndarray
     # [64] sends per wire-type code: the per-RPC-type breakdown the
     # reference's tesser folds produce from the Fressian journal
     # (net/journal.clj:339-347) — here it survives bench scale, where
@@ -99,7 +105,7 @@ class NetStats:
     @classmethod
     def zeros(cls) -> "NetStats":
         z = jnp.zeros((), I32)
-        return cls(z, z, z, z, z, z, z, jnp.zeros(TYPE_BUCKETS, I32))
+        return cls(z, z, z, z, z, z, z, z, z, jnp.zeros(TYPE_BUCKETS, I32))
 
 
 TYPE_BUCKETS = 64     # wire type codes are small ints; 63 = overflow bin
@@ -119,6 +125,17 @@ class NetState:
     component: jnp.ndarray      # i32 [n_nodes + n_clients] partition labels
     p_loss: jnp.ndarray         # f32 scalar
     latency_scale: jnp.ndarray  # f32 scalar (slow! = x10, fast! = x1)
+    # --- combined-nemesis fault masks ---
+    # Directional partitions the component labels cannot express
+    # (one-way links, bridge, majorities-ring): node i belongs to block
+    # group block_groups[i], and src->dest traffic is blocked iff
+    # block_matrix[g_src, g_dest]. Sized by cfg.partition_groups (1 when
+    # no partition nemesis runs: a [1, 1] False matrix, inert).
+    block_groups: jnp.ndarray   # i32 [n_nodes + n_clients]
+    block_matrix: jnp.ndarray   # bool [G, G]
+    down: jnp.ndarray           # bool [n_nodes]: crash-killed (drops msgs)
+    paused: jnp.ndarray         # bool [n_nodes]: stalled (defers msgs)
+    p_dup: jnp.ndarray          # f32 scalar: at-least-once duplication
     stats: NetStats
 
 
@@ -133,6 +150,11 @@ class NetConfig:
     latency_mean_rounds: float = 0.0
     latency_dist: str = "constant"
     ms_per_round: float = 1.0     # rounds -> wall-ms mapping for histories
+    # --- static fault-capability switches (each enabled path costs a
+    # little every round, so runs that can't see the fault don't pay) ---
+    partition_groups: int = 1     # block-matrix side; 1 = component-only
+    enable_stall: bool = False    # kill/pause masks honored in the round
+    enable_duplication: bool = False  # duplicate fault path compiled in
 
     @property
     def n_total(self) -> int:
@@ -147,6 +169,12 @@ def make_net(cfg: NetConfig) -> NetState:
         component=jnp.zeros(cfg.n_total, I32),
         p_loss=jnp.zeros((), jnp.float32),
         latency_scale=jnp.ones((), jnp.float32),
+        block_groups=jnp.zeros(cfg.n_total, I32),
+        block_matrix=jnp.zeros((cfg.partition_groups,
+                                cfg.partition_groups), bool),
+        down=jnp.zeros(cfg.n_nodes, bool),
+        paused=jnp.zeros(cfg.n_nodes, bool),
+        p_dup=jnp.zeros((), jnp.float32),
         stats=NetStats.zeros())
 
 
@@ -170,6 +198,32 @@ def draw_latency_rounds(cfg: NetConfig, key, scale, shape):
     return jnp.round(base).astype(I32)
 
 
+def _scatter_new(cfg: NetConfig, pool: Msgs, incoming: Msgs):
+    """Scatter a flat batch of messages (rows where incoming.valid) into
+    free pool slots. Free-slot allocation without a sort: rank free slots
+    by prefix sum, build rank -> slot via a unique-index scatter, then
+    each kept message takes the slot matching its own rank. O(P) instead
+    of O(P log^2 P). Returns (pool', ok) where ok marks the rows that
+    found a slot."""
+    keep = incoming.valid
+    free = ~pool.valid
+    n_free = jnp.sum(free.astype(I32))
+    free_rank = jnp.cumsum(free.astype(I32)) - 1     # rank of each free slot
+    P = cfg.pool_cap
+    slot_by_rank = jnp.zeros(P, I32).at[
+        jnp.where(free, free_rank, P)].set(
+            jnp.arange(P, dtype=I32), mode="drop", unique_indices=True)
+    k_rank = jnp.cumsum(keep.astype(I32)) - 1
+    ok = keep & (k_rank < n_free)
+    slot = slot_by_rank[jnp.clip(k_rank, 0, P - 1)]
+    # out-of-bounds index => dropped by scatter mode='drop'
+    tgt = jnp.where(ok, slot, P)
+    pool = jax.tree.map(
+        lambda pf, nf: pf.at[tgt].set(nf, mode="drop", unique_indices=True),
+        pool, incoming.replace(valid=ok))
+    return pool, ok
+
+
 def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     """Enqueue a flat batch of outgoing messages `out` (`[M]`) into the
     flight pool: assign ids, draw latencies, roll loss, scatter into free
@@ -180,7 +234,10 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     `stats.dropped_overflow` — a correct run sizes `pool_cap` so this stays
     zero (a silent drop would corrupt set-full checker results)."""
     pool, M = net.pool, out.valid.shape[0]
-    k_lat, k_loss = jax.random.split(key)
+    if cfg.enable_duplication:
+        k_lat, k_loss, k_dup, k_dlat = jax.random.split(key, 4)
+    else:
+        k_lat, k_loss = jax.random.split(key)
 
     new = out.valid
     rank = jnp.cumsum(new.astype(I32)) - 1
@@ -197,30 +254,29 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
     lost = new & (jax.random.uniform(k_loss, (M,)) < net.p_loss)
     keep = new & ~lost
 
-    # Free-slot allocation without a sort: rank free slots by prefix sum,
-    # build rank -> slot via a unique-index scatter, then each kept message
-    # takes the slot matching its own rank. O(P) instead of O(P log^2 P).
-    free = ~pool.valid
-    n_free = jnp.sum(free.astype(I32))
-    free_rank = jnp.cumsum(free.astype(I32)) - 1     # rank of each free slot
-    P = cfg.pool_cap
-    slot_by_rank = jnp.zeros(P, I32).at[
-        jnp.where(free, free_rank, P)].set(
-            jnp.arange(P, dtype=I32), mode="drop", unique_indices=True)
-    k_rank = jnp.cumsum(keep.astype(I32)) - 1
-    ok = keep & (k_rank < n_free)
-    slot = slot_by_rank[jnp.clip(k_rank, 0, P - 1)]
-    # out-of-bounds index => dropped by scatter mode='drop'
-    tgt = jnp.where(ok, slot, P)
-
-    incoming = out.replace(valid=ok, mid=mid, due=due)
-    pool = jax.tree.map(
-        lambda pf, nf: pf.at[tgt].set(nf, mode="drop", unique_indices=True),
-        pool, incoming)
+    incoming = out.replace(valid=keep, mid=mid, due=due)
+    pool, ok = _scatter_new(cfg, pool, incoming)
     # journal view: every attempted send with its assigned id, including
     # messages the loss roll ate (the reference journals before the loss
     # check, net.clj:207,213)
     sent_view = out.replace(valid=new, mid=mid, due=due)
+
+    n_dup = jnp.zeros((), I32)
+    if cfg.enable_duplication:
+        # at-least-once amplification: each kept inter-server message is
+        # re-enqueued with probability p_dup, SAME id (it is the same
+        # message delivered twice) but an independent latency draw.
+        # Client RPCs are exempt, like partitions (`net.clj:233`): the
+        # fault models the server-to-server network. A copy that finds
+        # no free slot is silently skipped (amplification is
+        # best-effort; it must never flag dropped_overflow).
+        dup = (keep & ~client
+               & (jax.random.uniform(k_dup, (M,)) < net.p_dup))
+        lat2 = draw_latency_rounds(cfg, k_dlat, net.latency_scale, (M,))
+        due2 = net.round + jnp.maximum(1, lat2)
+        pool, dup_ok = _scatter_new(
+            cfg, pool, out.replace(valid=dup, mid=mid, due=due2))
+        n_dup = jnp.sum(dup_ok.astype(I32))
 
     st = net.stats
     st = st.replace(
@@ -229,6 +285,7 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
         lost=st.lost + jnp.sum(lost.astype(I32)),
         dropped_overflow=st.dropped_overflow
         + jnp.sum((keep & ~ok).astype(I32)),
+        duplicated=st.duplicated + n_dup,
         sent_by_type=count_by_type(st.sent_by_type, out.type, new))
     net = net.replace(pool=pool, stats=st,
                       next_mid=net.next_mid + jnp.sum(new.astype(I32)))
@@ -266,11 +323,27 @@ def _deliver_due(cfg: NetConfig, net: NetState):
 
     due = pool.valid & (pool.due <= net.round)
     client_msg = involves_client(cfg, pool.src, pool.dest)
-    blocked = (net.component[jnp.clip(pool.src, 0, cfg.n_total - 1)]
-               != net.component[jnp.clip(pool.dest, 0, cfg.n_total - 1)])
+    src_i = jnp.clip(pool.src, 0, cfg.n_total - 1)
+    dest_i = jnp.clip(pool.dest, 0, cfg.n_total - 1)
+    blocked = net.component[src_i] != net.component[dest_i]
+    if cfg.partition_groups > 1:
+        # directional grudges (one-way, bridge, majorities-ring): the
+        # block matrix says whether src's group may reach dest's group
+        blocked = blocked | net.block_matrix[net.block_groups[src_i],
+                                             net.block_groups[dest_i]]
     blocked = blocked & ~client_msg
+    if cfg.enable_stall:
+        dest_node = pool.dest < N
+        nd = jnp.clip(pool.dest, 0, N - 1)
+        # paused dest: the message WAITS in the pool (the OS buffers for
+        # a stalled process); down dest: consumed and dropped
+        # (connection refused — the process is gone)
+        due = due & ~(dest_node & net.paused[nd])
+        to_down = due & ~blocked & dest_node & net.down[nd]
+    else:
+        to_down = jnp.zeros_like(due)
     to_client = due & ~blocked & (pool.dest >= N)
-    to_node = due & ~blocked & (pool.dest < N)
+    to_node = due & ~blocked & (pool.dest < N) & ~to_down
     dropped = due & blocked
 
     # --- node delivery: one sort on a composite (dest, due-age) key ---
@@ -309,7 +382,7 @@ def _deliver_due(cfg: NetConfig, net: NetState):
         client_msgs = Msgs.empty(0)
         c_taken = to_client
 
-    consumed = taken | dropped | c_taken
+    consumed = taken | dropped | c_taken | to_down
     pool = pool.replace(valid=pool.valid & ~consumed)
 
     n_node_recv = jnp.sum(taken.astype(I32))
@@ -320,7 +393,8 @@ def _deliver_due(cfg: NetConfig, net: NetState):
         recv_all=st.recv_all + n_node_recv + n_client_recv,
         recv_servers=st.recv_servers + server_recv,
         dropped_partition=st.dropped_partition
-        + jnp.sum(dropped.astype(I32)))
+        + jnp.sum(dropped.astype(I32)),
+        dropped_down=st.dropped_down + jnp.sum(to_down.astype(I32)))
     return net.replace(pool=pool, stats=st), inbox, client_msgs
 
 
@@ -346,8 +420,47 @@ def partition_components(net: NetState, labels) -> NetState:
     return net.replace(component=comp)
 
 
+def partition_grudge(net: NetState, groups, matrix) -> NetState:
+    """Install a directional grudge: `groups` is an i32 group label per
+    node (clients keep group 0; they are exempt at delivery anyway) and
+    `matrix[g_src, g_dest]` = True blocks src->dest traffic. Expresses
+    every grudge shape — one-way links, bridge, majorities-ring — that
+    component labels cannot. Requires cfg.partition_groups >= the label
+    count (the matrix shape is static)."""
+    groups = jnp.asarray(groups, I32)
+    matrix = jnp.asarray(matrix, bool)
+    if matrix.shape != net.block_matrix.shape:
+        raise ValueError(
+            f"grudge matrix shape {matrix.shape} != configured "
+            f"{net.block_matrix.shape}; set NetConfig.partition_groups")
+    g2 = net.block_groups.at[: groups.shape[0]].set(groups)
+    return net.replace(block_groups=g2, block_matrix=matrix)
+
+
+def set_down(net: NetState, mask) -> NetState:
+    """Mark nodes crash-killed: they stop stepping, their in-flight mail
+    is consumed and dropped at delivery. Requires cfg.enable_stall."""
+    return net.replace(down=jnp.asarray(mask, bool))
+
+
+def set_paused(net: NetState, mask) -> NetState:
+    """Mark nodes paused: they stop stepping but keep state; pool mail
+    waits for them. Requires cfg.enable_stall."""
+    return net.replace(paused=jnp.asarray(mask, bool))
+
+
+def set_duplication(net: NetState, p: float) -> NetState:
+    """At-least-once amplification probability (server-to-server only).
+    Requires cfg.enable_duplication for the draw to be compiled in."""
+    return net.replace(p_dup=jnp.full_like(net.p_dup, p))
+
+
 def heal(net: NetState) -> NetState:
-    return net.replace(component=jnp.zeros_like(net.component))
+    """Clears partitions — both component labels and directional grudge
+    state. Kill/pause/duplicate heal through their own stop ops."""
+    return net.replace(component=jnp.zeros_like(net.component),
+                       block_groups=jnp.zeros_like(net.block_groups),
+                       block_matrix=jnp.zeros_like(net.block_matrix))
 
 
 def slow(net: NetState, factor: float = 10.0) -> NetState:

@@ -113,6 +113,18 @@ class NodeProgram:
     # completions read mutable state.
     reply_payload_words = 0
 
+    # Durability contract for the kill/restart fault package
+    # (`maelstrom_tpu.nemesis`): what survives a crash.
+    #   None (default): the node persists ALL of its state — modeled as
+    #     a server that fsyncs every update before acking (the honest
+    #     reading of a CRDT node whose acked payload must survive).
+    #     Kill+restart is then pure downtime plus in-flight loss.
+    #   a tuple of state-dict keys: ONLY those entries survive; the rest
+    #     is rebuilt from init_state() at restart. Raft persists
+    #     log+term+vote and rebuilds kv/commit/applied by replay, so the
+    #     kill fault actually exercises its recovery path.
+    durable_keys: tuple | None = None
+
     def __init__(self, opts: dict, nodes: list[str]):
         self.opts = opts
         self.nodes = nodes
@@ -180,6 +192,32 @@ class NodeProgram:
         """Completes a HOST-routed op from device state."""
         raise NotImplementedError
 
+    # --- durable store (kill/restart fault package) ---
+
+    def durable_view(self, state):
+        """The persisted subset of `state` (same arrays, no copy),
+        carried in `SimState.durable` and synced at each round boundary.
+        None when everything is durable (see `durable_keys`)."""
+        if self.durable_keys is None:
+            return None
+        return {k: state[k] for k in self.durable_keys}
+
+    def restore(self, fresh, durable, state, mask):
+        """Crash-restart: nodes where `mask` is True come back with
+        volatile state rebuilt from `fresh` (an init_state() pytree)
+        overlaid with their `durable` entries; other nodes keep `state`.
+        Pure and jit-friendly (the nemesis applies it between rounds)."""
+        import jax
+        import jax.numpy as jnp
+        if self.durable_keys is None:
+            return state            # fully persistent: restart keeps all
+        recovered = {**fresh, **durable}
+
+        def pick(o, r):
+            m = mask.reshape(mask.shape + (1,) * (r.ndim - 1))
+            return jnp.where(m, r, o)
+        return jax.tree.map(pick, state, recovered)
+
     def invalid_counters(self, state) -> dict:
         """Program-state counters that invalidate the run when nonzero,
         surfaced by the net-stats checker next to `dropped_overflow`: a
@@ -205,6 +243,14 @@ def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
     scale_headroom = int(opts.get("max_latency_scale",
                                   10 if n_nodes <= 4096 else 1))
     ring = max(2, lat_rounds * slack * scale_headroom + 2)
+    # the duplicate fault re-delivers one round past the original's
+    # (floored) arrival; a minimal ring (zero-latency constant: depth 2,
+    # offsets {1}) has no cell for that second arrival, and the draw
+    # would be clipped — counted and gated as a latency-model
+    # distortion. Two extra cells make the duplicate representable.
+    nm = opts.get("nemesis")
+    if isinstance(nm, (set, frozenset, list, tuple)) and "duplicate" in nm:
+        ring += 2
     retry_rounds = max(2 * (lat_rounds + 1) + 4, 10)
     return ring, retry_rounds, lat_rounds
 

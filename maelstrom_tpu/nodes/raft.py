@@ -77,6 +77,14 @@ class RaftProgram(NodeProgram):
     # trace-time phase ablation for in-context profiling ONLY
     # (maelstrom_tpu.profile_raft); production paths never set it
     ablate: frozenset = frozenset()
+    # crash durability (paper section 5.1 "persistent state"): the log,
+    # current term, and vote survive a kill; kv/commit/applied/role and
+    # all replication bookkeeping are volatile and rebuilt by replay as
+    # the restarted follower re-learns commit from the leader.
+    # log_overflow rides along so a capacity invalidation can't be
+    # erased by a crash.
+    durable_keys = ("log_a", "log_b", "log_c", "log_len", "term",
+                    "voted_for", "log_overflow")
 
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)

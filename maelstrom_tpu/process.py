@@ -49,6 +49,7 @@ class NodeProcess:
         self.net = net
         self.log_file = log_file
         self.running = True
+        self.paused = False
         self.stdout_buffer = deque(maxlen=DEBUG_BUFFER_SIZE)
         self.stderr_buffer = deque(maxlen=DEBUG_BUFFER_SIZE)
 
@@ -138,9 +139,46 @@ class NodeProcess:
             except ValueError:
                 break   # log closed during teardown
 
+    # --- nemesis process control (jepsen db/Process + nemesis SIGSTOP) ---
+
+    def pause(self):
+        """SIGSTOP: the node stops being scheduled but keeps all state —
+        the GC/VM-stall fault. Messages queue in the stdin pipe."""
+        import signal
+        self.paused = True
+        os.kill(self.process.pid, signal.SIGSTOP)
+
+    def resume(self):
+        """SIGCONT: the node picks up exactly where it stopped."""
+        import signal
+        self.paused = False
+        os.kill(self.process.pid, signal.SIGCONT)
+
+    def kill(self) -> dict:
+        """Nemesis crash-kill: SIGKILL with no warning, torn down
+        WITHOUT the crash report (the death is intentional). The node
+        loses everything it didn't persist itself; a later respawn
+        models restart-from-durable-state."""
+        import signal
+        if getattr(self, "paused", False):
+            # a stopped process can't die until it's continued
+            os.kill(self.process.pid, signal.SIGCONT)
+            self.paused = False
+        self.running = False
+        if self.process.poll() is None:
+            self.process.kill()
+            self.process.wait(timeout=5)
+        for t in self.threads:
+            t.join(timeout=2)
+        self.net.remove_node(self.node_id)
+        self.log_writer.close()
+        return {"exit": self.process.returncode, "killed": True}
+
     # --- teardown (reference process.clj:217-256) ---
 
     def stop(self) -> dict:
+        if getattr(self, "paused", False):
+            self.resume()       # SIGKILL queues on a stopped process
         crashed = self.process.poll() is not None
         if not crashed:
             self.process.kill()

@@ -94,7 +94,16 @@ def run_test(test: dict) -> History:
         return g.rotate_free(free, dispatches[0])
 
     def nemesis_invoke(op):
-        completed = nemesis.invoke(op)
+        # a nemesis op that raises (a node binary died on its own before
+        # a pause/kill reached it, a respawn missed its init window)
+        # must still complete, or the NEMESIS process never returns to
+        # the free set and the run spins until hard_deadline_s
+        try:
+            completed = nemesis.invoke(op)
+        except Exception as e:
+            log.exception("nemesis op %r crashed", op.get("f"))
+            completed = {**op, "type": "info",
+                         "error": ["nemesis-exception", repr(e)]}
         results.put((g.NEMESIS, completed))
 
     try:

@@ -30,7 +30,7 @@ from .. import store
 from ..checkers import Checker
 from ..errors import ERROR_REGISTRY
 from ..history import History, Op
-from ..nemesis import GRUDGES
+from ..nemesis import NemesisDecisions
 from ..net import tpu as T
 from ..nodes import HOST, EncodeCapacityError, Intern, get_program
 from ..sim import SimState, make_sim
@@ -38,67 +38,98 @@ from ..sim import SimState, make_sim
 log = logging.getLogger("maelstrom.tpu")
 
 
-def _labels_from_grudge(nodes, grudge) -> list[int]:
-    """Converts a dest->blocked-srcs grudge map into partition component
-    labels (the TPU fault representation). Components = connected groups of
-    the *allowed* graph."""
+def _grudge_matrix(nodes, grudge):
+    """Converts a dest->blocked-srcs grudge map into the directional
+    block representation (`net/tpu.py partition_grudge`): every node is
+    its own group, matrix[src, dest] blocks that direction. Expresses
+    one-way, bridge, and majorities-ring grudges exactly."""
     idx = {n: i for i, n in enumerate(nodes)}
     n = len(nodes)
-    allowed = np.ones((n, n), bool)
+    groups = np.arange(n, dtype=np.int32)
+    matrix = np.zeros((n, n), bool)
     for dest, srcs in grudge.items():
         for src in srcs:
-            allowed[idx[dest], idx[src]] = False
-            allowed[idx[src], idx[dest]] = False
-    labels = [-1] * n
-    c = 0
-    for i in range(n):
-        if labels[i] >= 0:
-            continue
-        stack = [i]
-        labels[i] = c
-        while stack:
-            u = stack.pop()
-            for v2 in range(n):
-                if labels[v2] < 0 and allowed[u, v2]:
-                    labels[v2] = c
-                    stack.append(v2)
-        c += 1
-    # The component representation can only express grudges that separate
-    # nodes into disconnected groups; a grudge that cuts a<->b while both
-    # reach c would be silently coarsened away. Refuse rather than run a
-    # vacuous nemesis.
-    for dest, srcs in grudge.items():
-        for src in srcs:
-            if labels[idx[dest]] == labels[idx[src]]:
-                raise ValueError(
-                    f"grudge cuts {src}<->{dest} but both remain connected "
-                    f"via third parties; not expressible as components")
-    return labels
+            matrix[idx[src], idx[dest]] = True
+    return groups, matrix
 
 
-class TpuPartitionNemesis:
-    """Applies partition ops to the TPU network's component labels
-    (the mask analogue of `net.clj:108-112`)."""
+class TpuCombinedNemesis(NemesisDecisions):
+    """Applies the combined fault packages to the TPU network's mask
+    vectors (the device analogue of `net.clj:108-121` plus process
+    control): partitions install directional block matrices, kill/pause
+    set per-node down/paused masks, duplicate sets the amplification
+    probability, and restart rebuilds killed nodes from the durable
+    store via `NodeProgram.restore`. Fault decisions come from the
+    per-package seeded streams shared with the host path
+    (`NemesisDecisions`), so both paths draw identical schedules."""
 
     def __init__(self, runner, nodes, seed=0):
-        import random
+        super().__init__(nodes, seed)
         self.runner = runner
-        self.nodes = list(nodes)
-        self.rng = random.Random(seed)
+        self.killed: list = []
+        self.paused_nodes: list = []
+        self._idx = {n: i for i, n in enumerate(self.nodes)}
+
+    def _mask(self, targets):
+        m = np.zeros(len(self.nodes), bool)
+        for t in targets:
+            m[self._idx[t]] = True
+        return m
 
     def invoke(self, op):
         f = op["f"]
+        r = self.runner
         if f == "start-partition":
-            name, grudge = self.rng.choice(GRUDGES)(self.nodes, self.rng)
-            labels = _labels_from_grudge(self.nodes, grudge)
-            self.runner.sim = self.runner.sim.replace(
-                net=T.partition_components(self.runner.sim.net, labels))
+            name, grudge = self.next_grudge()
+            groups, matrix = _grudge_matrix(self.nodes, grudge)
+            r.sim = r.sim.replace(
+                net=T.partition_grudge(r.sim.net, groups, matrix))
             return {**op, "type": "info", "value": name}
         if f == "stop-partition":
-            self.runner.sim = self.runner.sim.replace(
-                net=T.heal(self.runner.sim.net))
+            r.sim = r.sim.replace(net=T.heal(r.sim.net))
             return {**op, "type": "info", "value": "healed"}
+        if f == "start-kill":
+            # targets come straight from the kill decision stream — no
+            # cross-package filtering (see CombinedNemesis): the op's
+            # value depends only on this package's RNG. A node both
+            # paused and killed is simply down until both faults lift.
+            targets = self.next_kill_targets()
+            self.killed = sorted(set(self.killed) | set(targets))
+            r.sim = r.sim.replace(
+                net=T.set_down(r.sim.net, self._mask(self.killed)))
+            r._state_cache = None
+            return {**op, "type": "info", "value": f"killed {targets}"}
+        if f == "stop-kill":
+            restarted, self.killed = self.killed, []
+            r.restart_nodes(self._mask(restarted))
+            return {**op, "type": "info",
+                    "value": f"restarted {restarted}"}
+        if f == "start-pause":
+            targets = self.next_pause_targets()
+            self.paused_nodes = sorted(set(self.paused_nodes)
+                                       | set(targets))
+            r.sim = r.sim.replace(
+                net=T.set_paused(r.sim.net,
+                                 self._mask(self.paused_nodes)))
+            return {**op, "type": "info", "value": f"paused {targets}"}
+        if f == "stop-pause":
+            resumed, self.paused_nodes = self.paused_nodes, []
+            r.sim = r.sim.replace(
+                net=T.set_paused(r.sim.net, self._mask([])))
+            return {**op, "type": "info", "value": f"resumed {resumed}"}
+        if f == "start-duplicate":
+            p = self.next_dup_prob()
+            r.sim = r.sim.replace(net=T.set_duplication(r.sim.net, p))
+            return {**op, "type": "info", "value": f"duplicate p={p}"}
+        if f == "stop-duplicate":
+            r.sim = r.sim.replace(net=T.set_duplication(r.sim.net, 0.0))
+            return {**op, "type": "info", "value": "duplicate off"}
         raise ValueError(f"unknown nemesis op {f!r}")
+
+
+# Backwards-compatible name (the partition-only executor grew into the
+# combined one; partition ops behave identically)
+TpuPartitionNemesis = TpuCombinedNemesis
 
 
 class TpuNetStats(Checker):
@@ -135,6 +166,8 @@ class TpuNetStats(Checker):
         out["lost"] = c["lost"]
         out["dropped-partition"] = c["dropped_partition"]
         out["dropped-overflow"] = c["dropped_overflow"]
+        out["dropped-down"] = c["dropped_down"]
+        out["duplicated"] = c["duplicated"]
         # per-RPC-type send breakdown (the reference derives this from
         # journal folds; the device counter survives bench scale where
         # journal rows don't). Wire codes name themselves through the
@@ -220,13 +253,20 @@ class TpuRunner:
         else:
             default_pool = max(4096, 4 * n * self.program.outbox_cap)
         pool_cap = int(test.get("pool_cap") or default_pool)
+        # fault capabilities are static config: runs without a given
+        # fault package pay nothing for its round-path machinery
+        faults = self._fault_set(test)
+        self.faults = faults
         self.cfg = T.NetConfig(
             n_nodes=n, n_clients=self.concurrency, pool_cap=pool_cap,
             inbox_cap=self.program.inbox_cap,
             client_cap=max(2 * self.concurrency, 8),
             latency_mean_rounds=mean_rounds,
             latency_dist=lat.get("dist", "constant"),
-            ms_per_round=self.ms_per_round)
+            ms_per_round=self.ms_per_round,
+            partition_groups=n if "partition" in faults else 1,
+            enable_stall=bool({"kill", "pause"} & faults),
+            enable_duplication="duplicate" in faults)
         # per-message journal rows: on by default for small clusters, where
         # Lamport diagrams are readable and the per-round device pull is
         # cheap; large runs keep only the on-device counters. Tracking is
@@ -281,8 +321,41 @@ class TpuRunner:
         self._bump = jax.jit(
             lambda sim, k: sim.replace(net=sim.net.replace(
                 round=sim.net.round + k)))
+        self._restart_fn = None
 
     # --- helpers ---
+
+    @staticmethod
+    def _fault_set(test: dict) -> set:
+        """The nemesis fault packages this run can see (static compile
+        capability, so it must be known before any round compiles)."""
+        pkg = test.get("nemesis_pkg") or {}
+        faults = set(pkg.get("faults") or ())
+        if not faults:
+            nm = test.get("nemesis")
+            if isinstance(nm, (set, frozenset, list, tuple)):
+                faults = set(nm)
+            elif nm:                    # bare truthy: legacy partition
+                faults = {"partition"}
+        return faults
+
+    def restart_nodes(self, mask):
+        """Crash-restart (stop-kill): masked nodes come back with
+        volatile state rebuilt from the durable store
+        (`NodeProgram.restore`), and their down flag clears."""
+        if self._restart_fn is None:
+            prog = self.program
+
+            @jax.jit
+            def fn(sim, m):
+                nodes = prog.restore(prog.init_state(), sim.durable,
+                                     sim.nodes, m)
+                net = sim.net.replace(down=sim.net.down & ~m)
+                return sim.replace(nodes=nodes, net=net,
+                                   durable=prog.durable_view(nodes))
+            self._restart_fn = fn
+        self.sim = self._restart_fn(self.sim, jnp.asarray(mask))
+        self._state_cache = None
 
     def _time_ns(self, r: int) -> int:
         return int(r * self.ms_per_round * 1e6)
@@ -380,7 +453,7 @@ class TpuRunner:
             "pending": dict(pending),
             "free": set(free),
             "intern": self.intern,
-            "nemesis_rng": (self.nemesis.rng.getstate()
+            "nemesis_rng": (self.nemesis.rng_state()
                             if self.nemesis else None),
         }
         path = cp.save(self.test["store_dir"], state)
@@ -392,7 +465,7 @@ class TpuRunner:
         test, cfg, program = self.test, self.cfg, self.program
         N, C = cfg.n_nodes, self.concurrency
         gen = g.to_gen(test["generator"])
-        nemesis = (TpuPartitionNemesis(self, self.nodes, test.get("seed", 0))
+        nemesis = (TpuCombinedNemesis(self, self.nodes, test.get("seed", 0))
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
         self.nemesis = nemesis
@@ -414,7 +487,7 @@ class TpuRunner:
             free = set(resume["free"])
             self.intern = resume["intern"]
             if nemesis and resume.get("nemesis_rng") is not None:
-                nemesis.rng.setstate(resume["nemesis_rng"])
+                nemesis.set_rng_state(resume["nemesis_rng"])
             log.info("resumed at virtual round %d (%d history ops, "
                      "%d in flight)", r, len(history), len(pending))
             if self.journal is not None:

@@ -31,6 +31,13 @@ class SimState:
     nodes: object        # program state pytree, leading axis N
     key: jnp.ndarray
     channels: object = None   # EdgeChannels for edge programs, else None
+    # Durable store for the kill/restart fault package: the subset of
+    # node state the program persists (`NodeProgram.durable_view`),
+    # synced at every round boundary (each write is "fsynced" before
+    # the round's replies leave). A crash-killed node restarts from
+    # exactly this (`NodeProgram.restore`); None for fully-persistent
+    # programs, whose restart keeps the whole state.
+    durable: object = None
 
 
 def make_sim(program, cfg: NetConfig, seed: int = 0,
@@ -38,8 +45,20 @@ def make_sim(program, cfg: NetConfig, seed: int = 0,
     channels = (static.make_channels(program.edge_cfg,
                                      track_send_round=track_edge_send_round)
                 if getattr(program, "is_edge", False) else None)
-    return SimState(net=T.make_net(cfg), nodes=program.init_state(),
-                    key=jax.random.PRNGKey(seed), channels=channels)
+    nodes = program.init_state()
+    return SimState(net=T.make_net(cfg), nodes=nodes,
+                    key=jax.random.PRNGKey(seed), channels=channels,
+                    durable=program.durable_view(nodes))
+
+
+def _freeze(stall, old, new):
+    """Per-leaf select: stalled (killed/paused) nodes keep their old
+    state row; live nodes take the stepped one. Leaves lead with the
+    node axis."""
+    def pick(o, n):
+        m = stall.reshape(stall.shape + (1,) * (n.ndim - 1))
+        return jnp.where(m, o, n)
+    return jax.tree.map(pick, old, new)
 
 
 def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
@@ -59,18 +78,28 @@ def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     net, inbox, client_msgs = T._deliver(cfg, net)
     nodes, outbox = program.step(sim.nodes, inbox,
                                  {"round": net.round, "key": k2})
+    if cfg.enable_stall:
+        # killed/paused nodes don't act: state frozen, sends suppressed
+        # (their inbox rows are already empty — _deliver defers/drops)
+        stall = sim.net.down | sim.net.paused
+        nodes = _freeze(stall, sim.nodes, nodes)
+        outbox = outbox.replace(valid=outbox.valid & ~stall[:, None])
     flat = jax.tree.map(lambda f: f.reshape((N * O,) + f.shape[2:]), outbox)
     flat = flat.replace(src=jnp.repeat(jnp.arange(N, dtype=I32), O))
     net, outbox_sent = T._send(cfg, net, flat, k3)
     net = T.advance(net)
-    return (SimState(net=net, nodes=nodes, key=key), client_msgs,
+    return (SimState(net=net, nodes=nodes, key=key,
+                     durable=program.durable_view(nodes)), client_msgs,
             (inject_sent, outbox_sent, inbox))
 
 
 def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     N, K = cfg.n_nodes, program.inbox_cap
     ecfg = program.edge_cfg
-    key, k1, k2, k4, k5 = jax.random.split(sim.key, 5)
+    if cfg.enable_duplication:
+        key, k1, k2, k4, k5, k6, k7 = jax.random.split(sim.key, 7)
+    else:
+        key, k1, k2, k4, k5 = jax.random.split(sim.key, 5)
 
     net, inject_sent = T._send(cfg, sim.net, inject, k1)
     net, client_inbox, pool_client_msgs = T._deliver(cfg, net)
@@ -78,6 +107,18 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
                                    program.rev, net.round)
     nodes, edge_out, client_out = program.edge_step(
         sim.nodes, edge_in, client_inbox, {"round": net.round, "key": k2})
+    if cfg.enable_stall:
+        # killed/paused nodes don't act: state frozen, nothing sent.
+        # Their incoming edge mail is blocked at write time below; mail
+        # already in their ring cells is read-and-ignored (edge traffic
+        # toward a stalled node is lost, not deferred — every edge
+        # protocol retransmits, and raft explicitly tolerates it)
+        stall = sim.net.down | sim.net.paused
+        nodes = _freeze(stall, sim.nodes, nodes)
+        edge_out = edge_out.replace(
+            valid=edge_out.valid & ~stall[:, None, None])
+        client_out = client_out.replace(
+            valid=client_out.valid & ~stall[:, None])
 
     # Client replies bypass the pool: clients have zero latency
     # (net.clj:177-186), so valid reply rows are compacted straight into
@@ -110,8 +151,23 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     nb = program.neighbors
     safe_nb = jnp.clip(nb, 0, cfg.n_nodes - 1)
     comp = net.component
-    blocked = ((comp[jnp.arange(N)][:, None] != comp[safe_nb])
-               & (nb >= 0))                                   # [N, D]
+    blocked = (comp[jnp.arange(N)][:, None] != comp[safe_nb])  # [N, D]
+    if cfg.partition_groups > 1:
+        # directional grudges: src group n may be blocked toward dest
+        # group nb[n, d] (one-way, bridge, majorities-ring)
+        bg = net.block_groups
+        blocked = blocked | net.block_matrix[bg[jnp.arange(N)][:, None],
+                                             bg[safe_nb]]
+    blocked = blocked & (nb >= 0)
+    if cfg.enable_stall:
+        # a killed/paused destination receives nothing (its sends were
+        # already suppressed above); booked separately from partition
+        # drops so the stats explain WHY traffic vanished
+        stalled_dst = ((net.down | net.paused)[safe_nb] & (nb >= 0)
+                       & ~blocked)
+    else:
+        stalled_dst = jnp.zeros_like(blocked)
+    blocked = blocked | stalled_dst
     shape = edge_out.valid.shape
     # atomic-RPC programs (raft: AE header on lane 0, its entry window
     # on lanes 3+) emit ONE logical message per (edge, round): the fault
@@ -148,6 +204,30 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
             "opts and NetConfig disagree about the latency distribution)")
     ch = static.edge_write(ecfg, ch, edge_out, net.round, lat, deliver_mask)
 
+    n_dup = jnp.zeros((), T.I32)
+    if cfg.enable_duplication:
+        # at-least-once amplification on the edge channels: a delivered
+        # message is re-written with probability p_dup under an
+        # independent latency draw (atomic-RPC programs share the draw
+        # across lanes, like loss — a duplicated AE travels whole)
+        dup_roll = jnp.broadcast_to(
+            jax.random.uniform(k6, draw_shape) < net.p_dup, shape)
+        dup_mask = deliver_mask & dup_roll
+        lat_dup = jnp.broadcast_to(
+            T.draw_latency_rounds(cfg, k7, net.latency_scale, draw_shape),
+            shape)
+        if cfg.latency_dist == "constant":
+            # constant draws are identical, and a same-cell rewrite
+            # would merge the copy into the original; one extra round
+            # BEYOND the original's floored arrival (edge_write floors
+            # 0-draws to 1) keeps the duplicate an actual second
+            # delivery (and keeps the uniform_arrival contract: still
+            # one shared cell)
+            lat_dup = jnp.maximum(lat_dup, 1) + 1
+        ch = static.edge_write(ecfg, ch, edge_out, net.round, lat_dup,
+                               dup_mask)
+        n_dup = jnp.sum((edge_out.valid & dup_mask).astype(T.I32))
+
     n_sent = jnp.sum(edge_out.valid.astype(I32))
     st = net.stats
     st = st.replace(
@@ -158,12 +238,17 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         lost=st.lost + jnp.sum(
             (edge_out.valid & ~blocked[:, :, None] & lost).astype(I32)),
         dropped_partition=st.dropped_partition + jnp.sum(
-            (edge_out.valid & blocked[:, :, None]).astype(I32)),
+            (edge_out.valid & (blocked & ~stalled_dst)[:, :, None])
+            .astype(I32)),
+        dropped_down=st.dropped_down + jnp.sum(
+            (edge_out.valid & stalled_dst[:, :, None]).astype(I32)),
+        duplicated=st.duplicated + n_dup,
         sent_by_type=T.count_by_type(st.sent_by_type, edge_out.type,
                                      edge_out.valid))
     net = net.replace(stats=st)
     net = T.advance(net)
-    return (SimState(net=net, nodes=nodes, key=key, channels=ch),
+    return (SimState(net=net, nodes=nodes, key=key, channels=ch,
+                     durable=program.durable_view(nodes)),
             client_msgs,
             (inject_sent, outbox_sent, client_inbox, edge_out, edge_in))
 

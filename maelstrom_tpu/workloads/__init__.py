@@ -33,10 +33,21 @@ class BaseClient:
         self.net = net
         self.conn = conn
         self.node = node
+        self.retry = None       # RetryPolicy from test opts (open())
 
     def open(self, test, node):
-        from ..client import SyncClient
-        return type(self)(self.net, SyncClient(self.net), node)
+        from ..client import RetryPolicy, SyncClient
+        c = type(self)(self.net, SyncClient(self.net), node)
+        c.retry = RetryPolicy.from_test(test, salt=c.conn.node_id)
+        return c
+
+    def with_errors(self, op, idempotent, thunk):
+        """`client.with_errors` with this client's retry policy wired
+        in: when --client-retries is set, unavailability failures back
+        off exponentially (with jitter and a cap) and re-issue instead
+        of surrendering to the RPC timeout."""
+        from ..client import with_errors
+        return with_errors(op, idempotent, thunk, retry=self.retry)
 
     def setup(self, test):
         pass

@@ -11,7 +11,7 @@ import math
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..checkers.set_full import BroadcastChecker
 from . import BaseClient
 
@@ -153,7 +153,7 @@ class BroadcastClient(BaseClient):
                 return {**op, "type": "ok"}
             res = read_rpc(self.conn, self.node, {})
             return {**op, "type": "ok", "value": res["messages"]}
-        return with_errors(op, {"read"}, go)
+        return self.with_errors(op, {"read"}, go)
 
 
 def workload(opts: dict) -> dict:

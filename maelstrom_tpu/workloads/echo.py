@@ -7,7 +7,7 @@ import random
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..checkers.echo import EchoChecker
 from . import BaseClient
 
@@ -26,7 +26,7 @@ class EchoClient(BaseClient):
         def go():
             res = echo_rpc(self.conn, self.node, {"echo": op["value"]})
             return {**op, "type": "ok", "value": res}
-        return with_errors(op, set(), go)
+        return self.with_errors(op, set(), go)
 
 
 class EchoOpGen:

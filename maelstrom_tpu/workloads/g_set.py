@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..checkers.set_full import SetFullChecker
 from . import BaseClient
 
@@ -34,7 +34,7 @@ class GSetClient(BaseClient):
                 return {**op, "type": "ok"}
             res = read_rpc(self.conn, self.node, {})
             return {**op, "type": "ok", "value": res["value"]}
-        return with_errors(op, {"read"}, go)
+        return self.with_errors(op, {"read"}, go)
 
 
 def workload(opts: dict) -> dict:

@@ -16,7 +16,7 @@ import random
 from .. import generators as g
 from .. import schema as S
 from ..checkers.kafka import KafkaChecker
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from . import BaseClient
 
 send_rpc = defrpc(
@@ -68,9 +68,11 @@ class KafkaClient(BaseClient):
         self.last_polled: dict = {}
 
     def open(self, test, node):
-        from ..client import SyncClient
-        return type(self)(self.net, SyncClient(self.net), node,
-                          keys=self.keys)
+        from ..client import RetryPolicy, SyncClient
+        c = type(self)(self.net, SyncClient(self.net), node,
+                       keys=self.keys)
+        c.retry = RetryPolicy.from_test(test, salt=c.conn.node_id)
+        return c
 
     def invoke(self, test, op):
         key_names = [str(k) for k in range(self.keys)]
@@ -101,7 +103,7 @@ class KafkaClient(BaseClient):
                 return {**op, "type": "ok", "value": offs}
             res = list_rpc(self.conn, self.node, {"keys": key_names})
             return {**op, "type": "ok", "value": res["offsets"]}
-        return with_errors(op, {"poll", "list"}, go)
+        return self.with_errors(op, {"poll", "list"}, go)
 
 
 class KafkaOpGen:

@@ -7,7 +7,7 @@ import random
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..errors import deferror
 from ..checkers.linearizable import LinearizableRegisterChecker
 from . import BaseClient
@@ -75,7 +75,7 @@ class LinKVClient(BaseClient):
             cas_rpc(self.conn, self.node,
                     {"key": k, "from": frm, "to": to}, timeout)
             return {**op, "type": "ok"}
-        return with_errors(op, {"read"}, go)
+        return self.with_errors(op, {"read"}, go)
 
 
 class KVOpGen:

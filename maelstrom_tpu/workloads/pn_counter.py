@@ -7,7 +7,7 @@ import random
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..checkers.pn_counter import PNCounterChecker
 from . import BaseClient
 
@@ -37,7 +37,7 @@ class PNCounterClient(BaseClient):
                 return {**op, "type": "ok"}
             res = read_rpc(self.conn, self.node, {})
             return {**op, "type": "ok", "value": int(res["value"])}
-        return with_errors(op, {"read"}, go)
+        return self.with_errors(op, {"read"}, go)
 
 
 class AddOpGen:

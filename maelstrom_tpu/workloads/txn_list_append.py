@@ -12,7 +12,7 @@ import random
 
 from .. import generators as g
 from .. import schema as S
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from ..errors import deferror
 from ..checkers.elle import ElleListAppendChecker
 from . import BaseClient
@@ -45,7 +45,7 @@ class TxnClient(BaseClient):
                           {"txn": [list(m) for m in op["value"]]})
             return {**op, "type": "ok",
                     "value": [list(m) for m in res["txn"]]}
-        return with_errors(op, set(), go)
+        return self.with_errors(op, set(), go)
 
 
 class TxnOpGen:

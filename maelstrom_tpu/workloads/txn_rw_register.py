@@ -17,7 +17,7 @@ import random
 from .. import generators as g
 from .. import schema as S
 from ..checkers.txn_rw_register import RWRegisterChecker
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from . import BaseClient
 # error 30 (txn-conflict, DEFINITE) registration: the checker's G1a
 # rule depends on aborted txns grading `fail`, not `info` — never rely
@@ -46,7 +46,7 @@ class RWClient(BaseClient):
                           {"txn": [list(m) for m in op["value"]]})
             return {**op, "type": "ok",
                     "value": [list(m) for m in res["txn"]]}
-        return with_errors(op, set(), go)
+        return self.with_errors(op, set(), go)
 
 
 class RWOpGen:

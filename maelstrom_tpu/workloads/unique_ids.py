@@ -13,7 +13,7 @@ from __future__ import annotations
 from .. import generators as g
 from .. import schema as S
 from ..checkers.unique_ids import UniqueIdsChecker
-from ..client import defrpc, with_errors
+from ..client import defrpc
 from . import BaseClient
 
 generate_rpc = defrpc(
@@ -32,7 +32,7 @@ class UniqueIdsClient(BaseClient):
         def go():
             res = generate_rpc(self.conn, self.node, {})
             return {**op, "type": "ok", "value": res["id"]}
-        return with_errors(op, set(), go)
+        return self.with_errors(op, set(), go)
 
 
 def workload(opts: dict) -> dict:

@@ -51,3 +51,66 @@ def test_fault_soup(workload, node, extra, storm, seed, latency, p_loss):
     assert res["valid"] is True, {
         k: v for k, v in res.items()
         if isinstance(v, dict) and v.get("valid") not in (True, None)}
+
+
+# The combined nemesis: every fault package at once — crash-kills with
+# durable-store restarts, GC pauses, directional partitions, AND
+# at-least-once duplication — on the consensus/ordering workloads. Raft
+# (lin-kv) must stay linearizable through restarts-from-log; kafka's
+# offsets must stay ordered through duplicate replication traffic.
+COMBINED_CONFIGS = [
+    ("lin-kv", "tpu:lin-kv", {}),
+    ("lin-mutex", "tpu:lin-kv", {}),
+    ("kafka", "tpu:kafka", {}),
+]
+
+
+@pytest.mark.parametrize("workload,node,extra", COMBINED_CONFIGS,
+                         ids=[c[0] for c in COMBINED_CONFIGS])
+def test_combined_fault_soup(workload, node, extra):
+    # seed chosen so the op mix actually lands >= 1 ok CAS between
+    # outage windows (the Stats checker's per-f rule; a CAS only
+    # succeeds when its random from-guess matches, so dense storms plus
+    # an unlucky seed can zero it out legitimately)
+    res = core.run(dict(
+        store_root="/tmp/maelstrom-tpu-test-store", seed=39,
+        workload=workload, node=node, node_count=5,
+        rate=15.0, time_limit=8.0, journal_rows=False, recovery_s=2.5,
+        latency={"mean": 2, "dist": "constant"}, p_loss=0.02,
+        nemesis={"kill", "pause", "partition", "duplicate"},
+        nemesis_interval=1.5, **extra))
+    assert res["valid"] is True, {
+        k: v for k, v in res.items()
+        if isinstance(v, dict) and v.get("valid") not in (True, None)}
+    # availability recovers post-heal: oks follow the first kill-restart
+    import json
+    with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
+        hist = [json.loads(line) for line in f]
+    restarts = [o["time"] for o in hist if o.get("f") == "stop-kill"
+                and o["type"] == "info"]
+    assert restarts
+    assert any(o["type"] == "ok" and o.get("process") != "nemesis"
+               and o["time"] > restarts[0] for o in hist)
+
+
+# Eventually-consistent workloads graded POST-HEAL after a soup that
+# includes kill and pause: the final generator heals everything, the
+# runner drains to quiescence, and the checkers see a converged system.
+EC_CONFIGS = [
+    ("broadcast", "tpu:broadcast", {"topology": "grid"}),
+    ("g-set", "tpu:g-set", {}),
+    ("pn-counter", "tpu:pn-counter", {}),
+]
+
+
+@pytest.mark.parametrize("workload,node,extra", EC_CONFIGS,
+                         ids=[c[0] for c in EC_CONFIGS])
+def test_kill_pause_soup_converges_post_heal(workload, node, extra):
+    res = core.run(dict(
+        store_root="/tmp/maelstrom-tpu-test-store", seed=41,
+        workload=workload, node=node, node_count=5,
+        rate=15.0, time_limit=4.0, journal_rows=False, recovery_s=3,
+        nemesis={"kill", "pause"}, nemesis_interval=1.0, **extra))
+    assert res["valid"] is True, {
+        k: v for k, v in res.items()
+        if isinstance(v, dict) and v.get("valid") not in (True, None)}

@@ -1,0 +1,335 @@
+"""The composable combined nemesis: fault-package registry, grudge
+shapes, decision-stream determinism, raft crash durability, client
+retry backoff, and fast per-package smoke runs on the echo/broadcast
+programs (full storms live in test_fault_soup.py, marked slow)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu import generators as g
+from maelstrom_tpu import nemesis as nem
+from tests.test_generators import interpret
+
+
+# --- registry / schedule composition ---------------------------------------
+
+
+def test_package_rejects_unknown_faults():
+    with pytest.raises(ValueError, match="unknown nemesis fault"):
+        nem.package({"partition", "clock-skew"})
+
+
+def test_package_empty_is_inert():
+    pkg = nem.package(set())
+    assert pkg["generator"] is None
+    assert pkg["final_generator"] is None
+    assert pkg["faults"] == ()
+
+
+def test_package_composes_all_fault_schedules():
+    pkg = nem.package({"kill", "pause", "partition", "duplicate"},
+                      interval_s=1.0)
+    assert pkg["faults"] == ("partition", "kill", "pause", "duplicate")
+    ops = interpret(g.time_limit(4.2, pkg["generator"]),
+                    processes=("w0",), max_time_s=8)
+    fs = [o["f"] for o in ops]
+    # every package starts AND stops within the window, interleaved
+    for f in ("partition", "kill", "pause", "duplicate"):
+        assert f"start-{f}" in fs and f"stop-{f}" in fs, fs
+    # final generator heals every package
+    finals = interpret(pkg["final_generator"], processes=("w0",))
+    assert [o["f"] for o in finals] == [
+        "stop-partition", "stop-kill", "stop-pause", "stop-duplicate"]
+
+
+# --- grudge shapes ----------------------------------------------------------
+
+
+NODES = [f"n{i}" for i in range(5)]
+
+
+def test_majorities_ring_grudge_directional_majorities():
+    import random
+    name, grudge = nem.majorities_ring(NODES, random.Random(3))
+    assert "majorities-ring" in name
+    m = len(NODES) // 2 + 1
+    # every node hears from exactly a majority (itself + m-1 others)
+    for d in NODES:
+        heard = set(NODES) - grudge[d]
+        assert d in heard
+        assert len(heard) == m, (d, heard)
+    # and the grudge is genuinely one-way somewhere: some src->dest is
+    # blocked while dest->src flows
+    asym = [(s, d) for d in NODES for s in grudge[d]
+            if d not in grudge.get(s, set())]
+    assert asym, grudge
+
+
+def test_bridge_grudge_shape():
+    import random
+    name, grudge = nem.bridge(NODES, random.Random(1))
+    # exactly one node (the bridge) is absent from every block set
+    blocked_nodes = set(grudge)
+    bridges = set(NODES) - blocked_nodes
+    assert len(bridges) == 1, grudge
+    b = bridges.pop()
+    assert all(b not in srcs for srcs in grudge.values())
+
+
+def test_one_way_halves_is_asymmetric():
+    import random
+    name, grudge = nem.one_way_halves(NODES, random.Random(2))
+    assert "one-way" in name
+    # only one side blocks: every (src, dest) cut must flow dest -> src
+    for d, srcs in grudge.items():
+        for s in srcs:
+            assert d not in grudge.get(s, set()), (s, d)
+
+
+def test_grudge_matrix_expresses_one_way():
+    from maelstrom_tpu.runner.tpu_runner import _grudge_matrix
+    grudge = {"n0": {"n1"}}             # n1 -> n0 blocked; n0 -> n1 flows
+    groups, matrix = _grudge_matrix(NODES, grudge)
+    assert matrix[1, 0] and not matrix[0, 1]
+
+
+# --- decision-stream determinism -------------------------------------------
+
+
+def test_decision_streams_deterministic_and_per_fault():
+    a = nem.NemesisDecisions(NODES, seed=42)
+    b = nem.NemesisDecisions(NODES, seed=42)
+    # same seed: identical sequences, even when the streams interleave
+    # differently (a draws kills between grudges, b draws grudges first)
+    ga = [a.next_grudge()[0] for _ in range(4)]
+    ka = [a.next_kill_targets() for _ in range(4)]
+    kb = [b.next_kill_targets() for _ in range(4)]
+    gb = [b.next_grudge()[0] for _ in range(4)]
+    assert ga == gb and ka == kb
+    # different seed: different schedule
+    c = nem.NemesisDecisions(NODES, seed=43)
+    assert [c.next_grudge()[0] for _ in range(4)] != ga
+
+
+def _tpu_test(seed, faults, workload="echo", node="tpu:echo", **kw):
+    opts = dict(store_root="/tmp/maelstrom-tpu-test-store", seed=seed,
+                workload=workload, node=node, node_count=5, rate=10.0,
+                time_limit=3.0, journal_rows=False, recovery_s=1.5,
+                nemesis=set(faults), nemesis_interval=0.7)
+    opts.update(kw)
+    return core.run(opts)
+
+
+def test_nemesis_determinism_tpu_path(tmp_path):
+    """Same seed => byte-identical histories (every op, every nemesis
+    fault choice, every virtual timestamp) across two full TPU runs of a
+    kill+pause+partition+duplicate soup."""
+    import json
+
+    def run_once():
+        res = _tpu_test(29, {"kill", "pause", "partition", "duplicate"})
+        assert res["valid"] is True
+        with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
+            return [json.loads(line) for line in f]
+
+    h1, h2 = run_once(), run_once()
+    assert h1 == h2
+    nem_ops = [(o["f"], o["value"], o["time"]) for o in h1
+               if o.get("process") == "nemesis" and o["type"] == "info"]
+    assert any(f == "start-kill" for f, _, _ in nem_ops), nem_ops
+    assert any(f == "start-partition" for f, _, _ in nem_ops), nem_ops
+
+
+def test_nemesis_determinism_host_path():
+    """Same seed => identical per-package fault schedules on the host
+    path: each package's op sequence and every fault choice it made
+    (grudge shape, kill/pause targets, dup probability) must match
+    between runs. Wall-clock jitter may interleave ops from DIFFERENT
+    packages differently — a real-time path cannot pin that — but the
+    per-fault decision streams (`NemesisDecisions`) must not move."""
+    import json
+
+    def run_once():
+        res = core.run(dict(
+            store_root="/tmp/maelstrom-tpu-test-store", seed=31,
+            workload="echo", bin="demo/python/echo.py", node_count=5,
+            rate=10.0, time_limit=3.5,
+            nemesis={"kill", "pause", "partition", "duplicate"},
+            nemesis_interval=0.8))
+        assert res["valid"] is True
+        with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
+            hist = [json.loads(line) for line in f]
+        # every fault DECISION is in a start op's value (stop values are
+        # derivative: "healed", or the accumulated start targets); final
+        # heal ops interleave at window-dependent positions, so starts
+        # are the comparable stream
+        seq = [(o["f"], o["value"]) for o in hist
+               if o.get("process") == "nemesis" and o["type"] == "info"
+               and o["f"].startswith("start-")]
+        return {f: [x for x in seq if x[0] == f"start-{f}"]
+                for f in nem.FAULTS}
+
+    s1, s2 = run_once(), run_once()
+    for f in nem.FAULTS:
+        # wall-clock may cut the window a cycle earlier in one run, so
+        # compare the common prefix; every decision in it must match
+        k = min(len(s1[f]), len(s2[f]))
+        assert k >= 1, (f, s1[f], s2[f])
+        assert s1[f][:k] == s2[f][:k], (f, s1[f], s2[f])
+
+
+# --- raft crash durability --------------------------------------------------
+
+
+def _raft_program(n=5):
+    from maelstrom_tpu.nodes import get_program
+    nodes = [f"n{i}" for i in range(n)]
+    return get_program("lin-kv", {"rate": 5, "time_limit": 5}, nodes), nodes
+
+
+def test_raft_restore_wipes_volatile_keeps_log():
+    prog, _ = _raft_program()
+    s = prog.init_state()
+    # node 1 has a replicated log, applied state, and leadership
+    s = dict(s)
+    s["log_a"] = s["log_a"].at[1, 0].set(77)
+    s["log_len"] = s["log_len"].at[1].set(1)
+    s["term"] = s["term"].at[1].set(9)
+    s["voted_for"] = s["voted_for"].at[1].set(1)
+    s["kv"] = s["kv"].at[1, 3].set(5)
+    s["commit"] = s["commit"].at[1].set(0)
+    s["applied"] = s["applied"].at[1].set(0)
+    s["role"] = s["role"].at[1].set(2)          # LEADER
+    durable = prog.durable_view(s)
+    mask = jnp.asarray(np.array([False, True, False, False, False]))
+    r = prog.restore(prog.init_state(), durable, s, mask)
+    # durable survives: the log, term, and vote (paper section 5.1)
+    assert int(r["log_a"][1, 0]) == 77
+    assert int(r["log_len"][1]) == 1
+    assert int(r["term"][1]) == 9
+    assert int(r["voted_for"][1]) == 1
+    # volatile is wiped: kv/commit/applied/role rebuilt from scratch
+    assert int(r["kv"][1, 3]) == 0
+    assert int(r["commit"][1]) == -1
+    assert int(r["applied"][1]) == -1
+    assert int(r["role"][1]) == 0               # FOLLOWER
+    # unmasked nodes are untouched
+    assert int(r["term"][0]) == int(s["term"][0])
+
+
+def test_default_program_is_fully_persistent():
+    from maelstrom_tpu.nodes import get_program
+    prog = get_program("echo", {}, ["n0", "n1"])
+    s = prog.init_state()
+    s = {"rounds": s["rounds"] + 7}
+    assert prog.durable_view(s) is None
+    r = prog.restore(prog.init_state(), None, s,
+                     jnp.asarray(np.array([True, False])))
+    assert int(r["rounds"][0]) == 7     # restart keeps persisted state
+
+
+# --- client retry backoff ---------------------------------------------------
+
+
+def test_with_errors_retries_unavailability_then_succeeds():
+    from maelstrom_tpu.client import RetryPolicy, with_errors
+    from maelstrom_tpu.errors import RPCError
+    policy = RetryPolicy(retries=3, base_ms=0.01, cap_ms=0.02, seed=0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RPCError(11, {"text": "no leader"})
+        return {"f": "write", "type": "ok"}
+
+    out = with_errors({"f": "write"}, set(), flaky, retry=policy)
+    assert out["type"] == "ok" and len(calls) == 3
+
+
+def test_with_errors_never_retries_indefinite_nonidempotent():
+    from maelstrom_tpu.client import RetryPolicy, with_errors
+    from maelstrom_tpu.errors import Timeout
+    policy = RetryPolicy(retries=5, base_ms=0.01, seed=0)
+    calls = []
+
+    def never():
+        calls.append(1)
+        raise Timeout()
+
+    # a timed-out write MAY have happened: re-issuing would double-apply
+    out = with_errors({"f": "write"}, set(), never, retry=policy)
+    assert out["type"] == "info" and len(calls) == 1
+    # a timed-out read is safe to retry (and exhausts the budget)
+    calls.clear()
+    out = with_errors({"f": "read"}, {"read"}, never, retry=policy)
+    assert out["type"] == "fail" and len(calls) == 6
+
+
+def test_sync_client_usable_after_failed_send():
+    """Regression (exposed by the kill package): a send that raises —
+    e.g. node-not-found while the destination is crash-killed — must not
+    leave the client stuck 'waiting', or every later op on that worker
+    dies with 'Can't send more than one message at a time!'."""
+    from maelstrom_tpu.client import SyncClient
+    from maelstrom_tpu.errors import RPCError
+    from maelstrom_tpu.net.host import HostNet
+    net = HostNet()
+    net.add_node("n0")
+    c = SyncClient(net)
+    with pytest.raises(RPCError):
+        c.send("ghost", {"type": "echo"})
+    assert c.send("n0", {"type": "echo"}) > 0       # still usable
+    c.close()
+
+
+def test_retry_policy_from_test_opts():
+    from maelstrom_tpu.client import RetryPolicy
+    assert RetryPolicy.from_test({}) is None
+    p = RetryPolicy.from_test({"client_retries": 4,
+                               "client_backoff_ms": 10,
+                               "client_backoff_cap_ms": 100, "seed": 1})
+    assert p.retries == 4 and p.base_ms == 10 and p.cap_ms == 100
+
+
+# --- per-package smoke runs (echo = flight pool, broadcast = edges) ---------
+
+
+@pytest.mark.parametrize("fault", ["partition", "kill", "pause",
+                                   "duplicate"])
+def test_fault_package_smoke_echo(fault):
+    res = _tpu_test(7, {fault})
+    assert res["valid"] is True, res["net"]
+
+
+@pytest.mark.parametrize("fault", ["partition", "kill", "pause",
+                                   "duplicate"])
+def test_fault_package_smoke_broadcast(fault):
+    res = _tpu_test(7, {fault}, workload="broadcast",
+                    node="tpu:broadcast", topology="grid")
+    assert res["valid"] is True, (res["net"], res["workload"])
+    assert res["workload"]["lost-count"] == 0
+
+
+def test_kill_soup_history_shows_downtime_and_recovery():
+    """lin-kv under kill: ops against downed nodes fail/time out while
+    the cluster stays linearizable, and post-heal ops succeed again."""
+    res = _tpu_test(17, {"kill"}, workload="lin-kv", node="tpu:lin-kv",
+                    time_limit=4.0)
+    assert res["valid"] is True
+    import json
+    with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
+        hist = [json.loads(line) for line in f]
+    kills = [o for o in hist if o.get("f") == "start-kill"
+             and o["type"] == "info"]
+    restarts = [o for o in hist if o.get("f") == "stop-kill"
+                and o["type"] == "info"]
+    assert kills and restarts
+    # availability recovers: client oks exist after a restart (the very
+    # last restart is the final-heal phase, after which no client ops
+    # are generated — so gauge recovery from the first one)
+    t_heal = min(o["time"] for o in restarts)
+    assert any(o["type"] == "ok" and o.get("process") != "nemesis"
+               and o["time"] > t_heal for o in hist)
