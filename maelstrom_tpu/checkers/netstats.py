@@ -5,9 +5,37 @@ msgs-per-op, and the Lamport diagram side effect."""
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
 
 from . import Checker
 from ..history import coerce_history
+
+
+@dataclass
+class TransferStats:
+    """Host-transfer accounting: how many times the run drained device
+    state to the host (`drains`) and how many bytes crossed (`host_bytes`).
+
+    The production runner's whole performance story is keeping these
+    O(host-relevant rounds) — one batched drain per compiled dispatch —
+    instead of O(simulated rounds); the TPU-path net-stats checker
+    (`runner.tpu_runner.TpuNetStats`) surfaces the counters in every
+    result so a regression (an accidental per-round device_get) is
+    visible in plain test output and bench records."""
+
+    drains: int = 0
+    host_bytes: int = 0
+
+    def record(self, tree) -> None:
+        """Count one drain of `tree` (any pytree of device/numpy arrays),
+        BEFORE the device_get that materializes it."""
+        import jax
+        self.drains += 1
+        self.host_bytes += sum(int(getattr(x, "nbytes", 0) or 0)
+                               for x in jax.tree.leaves(tree))
+
+    def as_dict(self) -> dict:
+        return {"drains": self.drains, "host-bytes": self.host_bytes}
 
 
 class NetStatsChecker(Checker):
@@ -34,5 +62,9 @@ class NetStatsChecker(Checker):
                              os.path.join(store_dir, "messages.svg"))
             except Exception as e:      # viz must never fail the test
                 stats["viz-error"] = repr(e)
+        # journal ingest volume (counts() includes host-bytes): the host
+        # path's analogue of the TPU path's device-drain accounting
+        # (TransferStats above, surfaced by TpuNetStats)
+        stats["journal"] = journal.counts()
         stats["valid"] = True
         return stats

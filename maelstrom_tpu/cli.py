@@ -81,6 +81,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Log packets as they're received")
     t.add_argument("--seed", type=int, default=0, help="PRNG seed")
     t.add_argument("--store", default="store", help="Store directory root")
+    t.add_argument("--mesh",
+                   help="Shard the TPU-path simulation over a dp,sp "
+                        "device mesh (e.g. --mesh 1,4): dp = cluster/"
+                        "data-parallel axis (must be 1 for the "
+                        "single-cluster interactive runner), sp = "
+                        "node/pool axis. Same-seed runs stay "
+                        "bit-identical to single-chip. Requires "
+                        "--node tpu:<program> and dp*sp visible "
+                        "devices (see doc/perf.md)")
+    t.add_argument("--max-scan", type=int,
+                   help="Upper bound on rounds per compiled scan "
+                        "dispatch (default 65536)")
+    t.add_argument("--journal-scan-cap", type=int,
+                   help="Device journal ring: io rows buffered on "
+                        "device per dispatch on journaled runs "
+                        "(default 256)")
+    t.add_argument("--reply-log-cap", type=int,
+                   help="Device reply ring: client replies buffered on "
+                        "device per dispatch (default 256)")
     t.add_argument("--ms-per-round", type=float, default=1.0,
                    help="Virtual milliseconds per simulation round "
                         "(TPU path; coarser = faster, less latency "
@@ -178,11 +197,21 @@ def opts_from_args(args) -> dict:
         "checkpoint_every": args.checkpoint_every,
         "resume": args.resume,
     }
+    # TPU-path performance knobs: only forwarded when given, so the
+    # runner's own defaults stay in one place
+    for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap"):
+        v = getattr(args, k, None)
+        if v is not None:
+            opts[k] = v
     if (args.checkpoint_every or args.resume) and not (
             args.node and str(args.node).startswith("tpu:")):
         raise SystemExit("--checkpoint-every/--resume need the TPU path "
                          "(--node tpu:<program>): external --bin processes "
                          "hold opaque state that cannot be snapshotted")
+    if args.mesh and not (args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--mesh needs the TPU path (--node tpu:<program>):"
+                         " external --bin processes don't run on a device "
+                         "mesh")
     return opts
 
 

@@ -40,6 +40,9 @@ DEFAULTS = dict(
     consistency_models=["strict-serializable"], log_stderr=False,
     log_net_send=False, log_net_recv=False, seed=0, store_root="store",
     client_retries=0, client_backoff_ms=50.0, client_backoff_cap_ms=2000.0,
+    # TPU-path scale-out: "dp,sp" device-mesh spec (None = single chip);
+    # recorded in the stored test map so a mesh run is reproducible
+    mesh=None,
 )
 
 

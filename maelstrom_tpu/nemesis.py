@@ -129,6 +129,25 @@ GRUDGES = [split_half, isolate_node, bridge, majorities_ring,
            one_way_halves]
 
 
+def grudge_matrix(nodes, grudge):
+    """Converts a dest -> blocked-srcs grudge map into the directional
+    block representation the TPU network installs
+    (`net/tpu.py partition_grudge`): every node is its own group,
+    matrix[src, dest] blocks that direction. Expresses one-way, bridge,
+    and majorities-ring grudges exactly. Lives here (not in the runner)
+    because it is a pure representation transform on the decision
+    stream's output, independent of which executor applies it."""
+    import numpy as np
+    idx = {n: i for i, n in enumerate(nodes)}
+    n = len(nodes)
+    groups = np.arange(n, dtype=np.int32)
+    matrix = np.zeros((n, n), bool)
+    for dest, srcs in grudge.items():
+        for src in srcs:
+            matrix[idx[src], idx[dest]] = True
+    return groups, matrix
+
+
 # --- shared fault decisions ------------------------------------------------
 
 

@@ -49,6 +49,7 @@ class Journal:
         self.retain_bodies = retain_bodies
         self.events: list[Event] = []
         self.chunks: list[dict] = []    # batched numpy event chunks
+        self.host_bytes = 0             # bytes ingested via log_batch
         self.lock = threading.Lock()
         self.closed = False
 
@@ -84,6 +85,9 @@ class Journal:
                  "node_names": node_names}
         with self.lock:
             self.chunks.append(chunk)
+            self.host_bytes += sum(
+                int(chunk[k].nbytes)
+                for k in ("ids", "times", "srcs", "dests"))
 
     # --- folds (reference journal.clj:305-347, net/checker.clj:28-41) ---
 
@@ -158,8 +162,9 @@ class Journal:
         with self.lock:
             n_host = len(self.events)
             n_batch = sum(len(c["ids"]) for c in self.chunks)
+            host_bytes = self.host_bytes
         return {"host-events": n_host, "batched-events": n_batch,
-                "total": n_host + n_batch}
+                "total": n_host + n_batch, "host-bytes": host_bytes}
 
     # --- persistence (reference journal.clj:183-223 writes stripes) ---
 

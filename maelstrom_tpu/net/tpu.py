@@ -354,7 +354,13 @@ def _deliver_due(cfg: NetConfig, net: NetState):
     age = jnp.clip(pool.due - net.round + (1 << (age_bits - 1))
                    if age_bits else 0, 0, (1 << age_bits) - 1)
     key = jnp.where(to_node, (pool.dest << age_bits) | age, INT32_MAX)
-    order = jnp.argsort(key)
+    # explicit pool-index tiebreak operand: equal (dest, age) keys would
+    # otherwise rely on argsort STABILITY for their relative order, and
+    # GSPMD's partitioned sort does not preserve stability across shard
+    # merges — same-seed `--mesh` runs would diverge from single-chip
+    # exactly when two messages to one node tie. A unique total order
+    # makes every correct sort implementation produce one permutation.
+    order = jnp.lexsort((jnp.arange(P, dtype=I32), key))
     sdest = jnp.where(to_node, pool.dest, N)[order]
     first = jnp.searchsorted(sdest, sdest, side="left")
     slot = jnp.arange(P, dtype=I32) - first.astype(I32)
@@ -373,8 +379,11 @@ def _deliver_due(cfg: NetConfig, net: NetState):
     # --- client delivery: due-ordered, first client_cap extracted ---
     CC = min(cfg.client_cap, P)
     if CC > 0:
-        corder = jnp.argsort(jnp.where(to_client, pool.due, INT32_MAX),
-                             stable=True)[:CC]
+        # same total-order discipline as the node sort above: stability
+        # is not portable across sharded sorts, the index operand is
+        corder = jnp.lexsort(
+            (jnp.arange(P, dtype=I32),
+             jnp.where(to_client, pool.due, INT32_MAX)))[:CC]
         client_msgs = pool.at_rows(corder).replace(valid=to_client[corder])
         c_taken = jnp.zeros(P, bool).at[corder].set(client_msgs.valid)
     else:
@@ -475,15 +484,21 @@ def flaky(net: NetState, p: float = 0.5) -> NetState:
     return net.replace(p_loss=jnp.full_like(net.p_loss, p))
 
 
-def stats_dict(net: NetState) -> dict:
+def stats_dict(net: NetState, transfer=None) -> dict:
     """Pull the on-device counters to host, in the shape the net-stats
     checker reports (`net/checker.clj:43-70`). On a cluster-batched net
     (leading cluster axis from `parallel.make_cluster_sims`) each
     counter is summed over the fleet. `sent_by_type` becomes a
-    {type-code: count} map of the nonzero buckets."""
+    {type-code: count} map of the nonzero buckets.
+
+    This is itself a host drain of the device-resident stats ring;
+    passing a `TransferStats` as `transfer` books it like every other
+    drain, so the counters it reports include their own extraction."""
     import dataclasses
 
     import numpy as np
+    if transfer is not None:
+        transfer.record(net.stats)
     st = jax.device_get(net.stats)
     out = {}
     for f in dataclasses.fields(st):

@@ -87,6 +87,47 @@ def mesh_for(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs).reshape(dp, sp), ("dp", "sp"))
 
 
+def mesh_from_spec(spec) -> Mesh:
+    """The production runner's `--mesh dp,sp` parser: "2,4" (or a
+    (2, 4) tuple) -> a ("dp", "sp") Mesh over the first dp*sp devices.
+    The dp axis carries the cluster/data-parallel dimension (a single
+    interactive cluster simply replicates over it); sp shards the big
+    per-cluster axes (nodes, pool, channels, durable store)."""
+    if isinstance(spec, Mesh):
+        return spec
+    if isinstance(spec, str):
+        parts = [p for p in spec.replace("x", ",").split(",") if p.strip()]
+        try:
+            dims = tuple(int(p) for p in parts)
+        except ValueError:
+            raise ValueError(f"--mesh expects 'dp,sp' integers, got "
+                             f"{spec!r}") from None
+    else:
+        dims = tuple(int(p) for p in spec)
+    if len(dims) != 2 or min(dims) < 1:
+        raise ValueError(f"--mesh expects two positive axes 'dp,sp', "
+                         f"got {spec!r}")
+    dp, sp = dims
+    n_avail = len(jax.devices())
+    if dp * sp > n_avail:
+        raise ValueError(
+            f"--mesh {dp},{sp} needs {dp * sp} devices but only "
+            f"{n_avail} are visible (on CPU, set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={dp * sp})")
+    return mesh_for(dp * sp, dp=dp)
+
+
+def scan_shardings(mesh: Mesh, sim: SimState, inject) -> tuple:
+    """The `(sim, inject, scalar)` sharding triple `sim.make_scan_fn` /
+    `make_round_fn` take as `shardings=`: the (unbatched, single-cluster)
+    SimState tree sharded over sp, the inject batch and scalars
+    replicated. Used by the production runner's `--mesh` mode."""
+    scalar = NamedSharding(mesh, P())
+    return (sim_shardings(mesh, sim, batched=False),
+            sim_shardings(mesh, inject, batched=False),
+            scalar)
+
+
 def _spec_for(arr, mesh: Mesh, batched: bool) -> P:
     """Shard the cluster axis over dp and the first big per-cluster axis
     over sp (when divisible); everything else replicated. Axes that

@@ -15,11 +15,21 @@ wall-clock nanoseconds. Quiescent stretches — empty flight pool, quiescent
 node program, no outstanding RPCs — are fast-forwarded without dispatching
 rounds, so a 10-virtual-second test with rate 5 costs ~hundreds of
 dispatches, not 10,000.
+
+Production scale-out (`--mesh dp,sp`): the whole hot-loop state tree is
+sharded over a ("dp", "sp") device mesh (`parallel.sim_shardings`) and
+the compiled scan runs with those shardings pinned and its carry donated,
+so node/pool/channel/durable arrays live distributed across chips and are
+reused in place across dispatches. Extraction stays off the hot path:
+client replies and journal io accumulate in the scan's device-resident
+rings and reach the host as one batched drain per dispatch
+(`TransferStats` books every drain; see doc/perf.md).
 """
 
 from __future__ import annotations
 
 import logging
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -31,26 +41,14 @@ from ..checkers import Checker
 from ..errors import ERROR_REGISTRY
 from ..history import History, Op
 from ..nemesis import NemesisDecisions
+from ..nemesis import grudge_matrix as _grudge_matrix
 from ..net import tpu as T
 from ..nodes import HOST, EncodeCapacityError, Intern, get_program
-from ..sim import SimState, make_sim
+from ..sim import SimState, dealias, donation_enabled, make_sim
 
 log = logging.getLogger("maelstrom.tpu")
 
 
-def _grudge_matrix(nodes, grudge):
-    """Converts a dest->blocked-srcs grudge map into the directional
-    block representation (`net/tpu.py partition_grudge`): every node is
-    its own group, matrix[src, dest] blocks that direction. Expresses
-    one-way, bridge, and majorities-ring grudges exactly."""
-    idx = {n: i for i, n in enumerate(nodes)}
-    n = len(nodes)
-    groups = np.arange(n, dtype=np.int32)
-    matrix = np.zeros((n, n), bool)
-    for dest, srcs in grudge.items():
-        for src in srcs:
-            matrix[idx[src], idx[dest]] = True
-    return groups, matrix
 
 
 class TpuCombinedNemesis(NemesisDecisions):
@@ -143,7 +141,8 @@ class TpuNetStats(Checker):
         self.runner = runner
 
     def check(self, test, history, opts=None):
-        c = T.stats_dict(self.runner.sim.net)
+        c = T.stats_dict(self.runner.sim.net,
+                         transfer=getattr(self.runner, "transfer", None))
         op_count = sum(1 for o in history
                        if o.type == "invoke" and o.process != "nemesis")
         groups = {
@@ -228,6 +227,15 @@ class TpuNetStats(Checker):
             n_bad = int(np.sum(jax.device_get(arr)))
             out[name] = n_bad
             ok = ok and n_bad == 0
+        # host-transfer accounting: drains must stay O(host-relevant
+        # rounds) — one batched fetch per dispatch — not O(simulated
+        # rounds); a regression here is a performance bug even when the
+        # run is semantically valid
+        tr = getattr(self.runner, "transfer", None)
+        if tr is not None:
+            out.update(tr.as_dict())
+        if journal is not None:
+            out["journal"] = journal.counts()
         out["valid"] = bool(ok)
         return out
 
@@ -277,11 +285,60 @@ class TpuRunner:
         self.journal_rows = bool(test.get("journal_rows", n <= 64))
         self.journal = (getattr(test.get("net"), "journal", None)
                         if self.journal_rows else None)
-        self.sim = make_sim(self.program, self.cfg, seed=test.get("seed", 0),
+        # dealias: the runner's compiled dispatches donate their sim
+        # carry, and a donated tree may not contain one buffer twice
+        # (skipped when donation is off — it's a one-time full-tree copy)
+        self.sim = make_sim(self.program, self.cfg,
+                            seed=test.get("seed", 0),
                             track_edge_send_round=self.journal_rows)
+        if donation_enabled():
+            self.sim = dealias(self.sim)
         if test.get("p_loss"):
             self.sim = self.sim.replace(
                 net=T.flaky(self.sim.net, float(test["p_loss"])))
+        # host-transfer accounting: every device->host drain is booked
+        # here, so tests and benches can assert extraction stays off the
+        # hot path (drains ~ dispatches, not ~ simulated rounds)
+        from ..checkers.netstats import TransferStats
+        self.transfer = TransferStats()
+        # --mesh dp,sp: shard the whole hot-loop state tree — node
+        # state, flight pool, edge channels, inject buffers, reply/io
+        # rings, nemesis masks (down/paused/block matrices), freeze
+        # masks, and the durable store — across a ("dp", "sp") device
+        # mesh. The scan/round fns are jitted with these shardings
+        # pinned, so GSPMD partitions the round body (collectives over
+        # ICI/DCN) while host-built arrays (nemesis surgery, fresh
+        # inject batches) are re-placed automatically at each dispatch.
+        # Sharding changes placement, never semantics: same-seed mesh
+        # runs are bit-identical to single-chip runs (pinned by
+        # tests/test_sharded_runner.py and the MULTICHIP dryruns).
+        self.mesh = None
+        self._shardings = None
+        mesh_spec = test.get("mesh")
+        if mesh_spec:
+            from .. import parallel
+            self.mesh = parallel.mesh_from_spec(mesh_spec)
+            if self.mesh.shape["dp"] != 1:
+                # dp shards a CLUSTER axis; the interactive runner
+                # simulates exactly one cluster, so dp > 1 would merely
+                # replicate state over dp — and GSPMD's scatter
+                # partitioning is not value-safe for replicated
+                # scatter-set operands (observed: per-replica
+                # contributions combined additively, doubling inbox
+                # rows). The cluster-batched entry points
+                # (parallel.make_cluster_*) own the dp axis.
+                raise ValueError(
+                    f"--mesh {mesh_spec}: the interactive runner "
+                    f"simulates one cluster, so the cluster axis must "
+                    f"be 1 (use --mesh 1,{self.mesh.size}; dp > 1 "
+                    f"belongs to the cluster-batched bench paths)")
+            inject_ex = T.Msgs.empty(max(self.concurrency, 1))
+            self._shardings = parallel.scan_shardings(
+                self.mesh, self.sim, inject_ex)
+            self.sim = jax.device_put(self.sim, self._shardings[0])
+            log.info("mesh mode: dp=%d sp=%d over %d devices",
+                     self.mesh.shape["dp"], self.mesh.shape["sp"],
+                     self.mesh.size)
         self._scan_fn = None         # built lazily
         self._scan_journal_fn = None  # journaled variant (io-collecting)
         self._pack_buf = None         # single-array packers (remote
@@ -312,16 +369,44 @@ class TpuRunner:
                                          for i in range(self.concurrency)]
         self._dispatches = 0
         self._state_cache = None
+        self.final_round = 0
         # checkpoint/resume (no reference equivalent; SURVEY.md section 5.4)
         ckpt_s = test.get("checkpoint_every")
         self.checkpoint_every_rounds = (
             int(float(ckpt_s) * 1000.0 / self.ms_per_round)
             if ckpt_s else None)
         self.nemesis = None
+        # donated carry: the bump is pure round-counter surgery on the
+        # full state tree, so buffer reuse saves a whole-tree copy per
+        # quiescent fast-forward. In mesh mode its shardings are pinned
+        # like the scan's: a donated argument cannot be resharded at the
+        # call boundary, so every producer of self.sim must hand back
+        # the canonical placement.
         self._bump = jax.jit(
             lambda sim, k: sim.replace(net=sim.net.replace(
-                round=sim.net.round + k)))
+                round=sim.net.round + k)),
+            donate_argnums=(0,) if donation_enabled() else (),
+            **self._sim_jit_shardings(n_args=2))
         self._restart_fn = None
+
+    def _sim_jit_shardings(self, n_args: int) -> dict:
+        """in/out sharding pins for jitted sim->sim helpers (bump,
+        restart): argument 0 and the output are the canonical sim tree,
+        trailing args replicated. Empty in single-chip mode."""
+        if self._shardings is None:
+            return {}
+        sim_sh, _inject_sh, scalar_sh = self._shardings
+        return {"in_shardings": (sim_sh,) + (scalar_sh,) * (n_args - 1),
+                "out_shardings": sim_sh}
+
+    def _reshard(self):
+        """Re-places self.sim onto the canonical mesh shardings after
+        host-side state surgery (nemesis fault installs, resume):
+        eager ops on sharded arrays may commit their outputs with a
+        different layout, and the donating dispatches refuse to reshard
+        donated args implicitly."""
+        if self._shardings is not None:
+            self.sim = jax.device_put(self.sim, self._shardings[0])
 
     # --- helpers ---
 
@@ -346,7 +431,9 @@ class TpuRunner:
         if self._restart_fn is None:
             prog = self.program
 
-            @jax.jit
+            @partial(jax.jit,
+                     donate_argnums=(0,) if donation_enabled() else (),
+                     **self._sim_jit_shardings(n_args=2))
             def fn(sim, m):
                 nodes = prog.restore(prog.init_state(), sim.durable,
                                      sim.nodes, m)
@@ -364,8 +451,14 @@ class TpuRunner:
         """Pulls one node's state row at the current round (cached per
         round)."""
         if self._state_cache is None:
+            self.transfer.record(self.sim.nodes)
             self._state_cache = jax.device_get(self.sim.nodes)
-        return jax.tree.map(lambda a: a[node_idx], self._state_cache)
+        # copy the row out: on CPU, device_get returns zero-copy views
+        # into device buffers, and a donated dispatch may recycle those
+        # buffers while a completion (or the history it built) still
+        # holds the row
+        return jax.tree.map(lambda a: np.array(a[node_idx]),
+                            self._state_cache)
 
     def _complete(self, history, gen, ctx, process, completed, free):
         o = Op(type=completed.get("type", "info"), f=completed.get("f"),
@@ -479,7 +572,9 @@ class TpuRunner:
         if resume is not None:
             r = resume["r"]
             self._dispatches = resume["dispatches"]
-            self.sim = resume["sim"]
+            self.sim = (dealias(resume["sim"]) if donation_enabled()
+                        else resume["sim"])
+            self._reshard()
             self._state_cache = None
             gen = resume["gen"]
             history = History(resume["history"])
@@ -499,6 +594,7 @@ class TpuRunner:
                      if self.checkpoint_every_rounds else None)
         # host mirror of the device message-id counter (refreshed by every
         # dispatch's combined fetch)
+        self.transfer.record(self.sim.net.next_mid)
         self._next_mid = int(jax.device_get(self.sim.net.next_mid))
         exhausted = False
         while r < max_rounds:
@@ -523,6 +619,10 @@ class TpuRunner:
                                   final=op.get("final", False)))
                 if process == g.NEMESIS:
                     completed = nemesis.invoke(op)
+                    # fault installs are eager host-side surgery on the
+                    # sharded state; restore canonical placement before
+                    # the next donating dispatch
+                    self._reshard()
                     gen = self._complete(history, gen, ctx, process,
                                          completed, free)
                 else:
@@ -630,7 +730,8 @@ class TpuRunner:
                     from ..sim import make_scan_fn
                     self._scan_journal_fn = make_scan_fn(
                         program, cfg, journal_cap=self.journal_scan_cap,
-                        reply_cap=self.reply_log_cap)
+                        reply_cap=self.reply_log_cap, donate=True,
+                        shardings=self._shardings)
                 self.sim, _cm, k, rl, buf = self._scan_journal_fn(
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
@@ -641,8 +742,9 @@ class TpuRunner:
                 # ONE fetched array per dispatch: k and next_mid ride the
                 # packed buffer (every separately fetched array is its own
                 # round trip on remote backends)
-                flat = jax.device_get(
-                    pack((buf, rl, k, self.sim.net.next_mid)))
+                packed = pack((buf, rl, k, self.sim.net.next_mid))
+                self.transfer.record(packed)
+                flat = jax.device_get(packed)
                 buf, (rlog, rounds, plog, rn), k, self._next_mid = \
                     unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
@@ -665,7 +767,8 @@ class TpuRunner:
                 if self._scan_fn is None:
                     from ..sim import make_scan_fn
                     self._scan_fn = make_scan_fn(
-                        program, cfg, reply_cap=self.reply_log_cap)
+                        program, cfg, reply_cap=self.reply_log_cap,
+                        donate=True, shardings=self._shardings)
                 self.sim, _cm, k, rl = self._scan_fn(
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
@@ -674,8 +777,9 @@ class TpuRunner:
                         (rl, k, self.sim.net.next_mid))
                 pack, unpack = self._pack_replies
                 # ONE fetched array per dispatch (see journal branch)
-                flat = jax.device_get(
-                    pack((rl, k, self.sim.net.next_mid)))
+                packed = pack((rl, k, self.sim.net.next_mid))
+                self.transfer.record(packed)
+                flat = jax.device_get(packed)
                 (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
                 rn = int(rn)
@@ -733,9 +837,11 @@ class TpuRunner:
 
         if r >= max_rounds:
             log.warning("TPU runner hit max_rounds=%d", max_rounds)
+        self.final_round = r
         log.info("TPU run finished at virtual round %d (%.1f virtual s), "
-                 "%d history ops", r, r * self.ms_per_round / 1e3,
-                 len(history))
+                 "%d history ops, %d host drains (%d bytes)",
+                 r, r * self.ms_per_round / 1e3, len(history),
+                 self.transfer.drains, self.transfer.host_bytes)
         return history
 
     def _journal_round(self, io, client_msgs, r: int):
@@ -823,7 +929,9 @@ class TpuRunner:
                     q = q & prog_q(sim.nodes)
                 return q
             self._quiet_fn = jax.jit(quiet)
-        return bool(self._quiet_fn(self.sim))
+        q = self._quiet_fn(self.sim)
+        self.transfer.record(q)
+        return bool(q)
 
 
 def run_tpu_test(test: dict, test_dir: str) -> dict:

@@ -40,6 +40,19 @@ class SimState:
     durable: object = None
 
 
+def dealias(tree):
+    """Copy every leaf so no two leaves share a device buffer.
+
+    Freshly-built state trees alias heavily — `Msgs.empty` fans one
+    zeros array across eight fields, `durable_view` returns views of the
+    node state — which is fine under jit, but a DONATED argument may not
+    contain the same buffer twice (XLA rejects `f(donate(a), donate(a))`).
+    Callers that hand a just-constructed sim to a donating entry point
+    (`make_scan_fn`/`make_run_fn`/`make_round_fn` with `donate=True`)
+    dealias it once up front; every jit output is already alias-free."""
+    return jax.tree.map(lambda a: jnp.array(a, copy=True), tree)
+
+
 def make_sim(program, cfg: NetConfig, seed: int = 0,
              track_edge_send_round: bool = False) -> SimState:
     channels = (static.make_channels(program.edge_cfg,
@@ -253,13 +266,63 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
             (inject_sent, outbox_sent, client_inbox, edge_out, edge_in))
 
 
-def make_round_fn(program, cfg: NetConfig):
+def donation_enabled() -> bool:
+    """Whether carry donation is active. Default: on for accelerator
+    backends, OFF on the CPU backend — CPU `device_get` hands the host
+    zero-copy views into device buffers, and donation then recycles
+    those buffers under live host references; observed as rare
+    nondeterministic history divergence in CPU soak runs (the TPU path
+    always copies device->host, so the hazard class does not exist
+    there). MAELSTROM_DONATE=1/0 overrides either way."""
+    import os
+    v = os.environ.get("MAELSTROM_DONATE")
+    if v is not None:
+        return v != "0"
+    return jax.default_backend() != "cpu"
+
+
+def _jit_kwargs(donate: bool, shardings, n_args: int,
+                n_outs: int) -> dict:
+    """Shared jit options for the compiled entry points.
+
+    `donate` marks the SimState carry (argument 0) donated: XLA reuses
+    its buffers for the output state instead of allocating a fresh tree
+    every dispatch — the caller must treat the passed-in sim as consumed
+    and keep only the returned one (every in-tree caller already does).
+
+    `shardings`, when given, is `(sim_sharding_tree, inject_sharding_tree,
+    scalar_sharding)` (see `parallel.scan_shardings`); it pins the input
+    placement so host-built arrays (nemesis mask surgery, fresh inject
+    batches) are automatically re-placed onto the mesh at every call
+    instead of silently pulling the whole computation to one device.
+    Output shardings are pinned too: the returned sim keeps the same
+    canonical shardings as the input carry (a donated arg may not be
+    resharded at the next call, and GSPMD would otherwise be free to
+    pick a different layout per compiled variant), while the drained
+    outputs (reply/io rings, counters) come back replicated — they are
+    about to leave for the host anyway. Entry points return the sim
+    first, then n_outs - 1 drained outputs."""
+    kw: dict = {}
+    if donate and donation_enabled():
+        kw["donate_argnums"] = (0,)
+    if shardings is not None:
+        sim_sh, inject_sh, scalar_sh = shardings
+        kw["in_shardings"] = (sim_sh, inject_sh) \
+            + (scalar_sh,) * (n_args - 2)
+        kw["out_shardings"] = (sim_sh,) + (scalar_sh,) * (n_outs - 1)
+    return kw
+
+
+def make_round_fn(program, cfg: NetConfig, donate: bool = False,
+                  shardings=None):
     """Jitted interactive round: one XLA dispatch per simulated round."""
-    return jax.jit(partial(_round, program, cfg))
+    return jax.jit(partial(_round, program, cfg),
+                   **_jit_kwargs(donate, shardings, 2, 3))
 
 
 def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
-                 reply_cap: int | None = None):
+                 reply_cap: int | None = None, donate: bool = False,
+                 shardings=None):
     """Jitted scan-ahead: runs up to k_max injection-free rounds in ONE
     dispatch (lax.while_loop). The interactive runner uses this to cross
     the idle stretches between generator events — e.g. at rate 5/s and
@@ -287,7 +350,17 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
     exits when the log could overflow on the next round. With
     `journal_cap` set, every scanned round's journal io is additionally
     collected into [cap, ...] buffers (rows beyond k_executed are
-    zeros); that cap bounds k_max."""
+    zeros); that cap bounds k_max.
+
+    The reply log and journal buffers are the device-resident rings the
+    production runner drains: replies/io accumulate on device across the
+    whole scanned stretch and reach the host as ONE batched fetch per
+    dispatch, so host transfers scale with host-relevant rounds (ops,
+    timeouts, nemesis boundaries), not simulated rounds. `donate=True`
+    additionally donates the SimState carry so those rings and the state
+    tree are reused in place instead of reallocated every dispatch;
+    `shardings` pins the input placement for mesh (`--mesh`) execution
+    (see `_jit_kwargs`)."""
 
     CC = max(cfg.n_clients, 1)
     empty = Msgs.empty(CC)
@@ -344,7 +417,6 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
         return (sim2, cm2, k + jnp.int32(1), k_max, stop, buf, rlog,
                 rounds, plog, rn)
 
-    @jax.jit
     def scan_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply=True):
         nonlocal rcap, cw
         sim1, cm1, io1 = _round(program, cfg, sim, inject)
@@ -380,24 +452,31 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
             out = out + (buf,)
         return out
 
-    return scan_fn
+    n_outs = 3 + (rcap_req is not None) + (cap is not None)
+    return jax.jit(scan_fn, **_jit_kwargs(donate, shardings, 4, n_outs))
 
 
-def make_run_fn(program, cfg: NetConfig, collect_client_msgs: bool = False):
+def make_run_fn(program, cfg: NetConfig, collect_client_msgs: bool = False,
+                donate: bool = False, shardings=None):
     """Jitted multi-round run under lax.scan.
 
     run_fn(sim, plan) -> (sim', per_round_client_counts [R] or Msgs [R, CC])
     where `plan` is a Msgs batch [R, M] of pre-scheduled client injections
     (the compiled-mode analogue of the generator: the whole workload is
-    scheduled up front, so R rounds execute without touching the host)."""
+    scheduled up front, so R rounds execute without touching the host).
+
+    `donate=True` donates the sim carry (argument 0): chunked callers
+    (`sim, _ = run_fn(sim, chunk)` in a loop, the bench path) then reuse
+    one state allocation across all chunks instead of paying an
+    alloc+copy of the full tree per dispatch. The passed-in sim is
+    consumed — keep only the returned one."""
 
     def body(sim, inject):
         sim, client_msgs, _ = _round(program, cfg, sim, inject)
         out = client_msgs if collect_client_msgs else client_msgs.count()
         return sim, out
 
-    @jax.jit
     def run_fn(sim: SimState, plan: Msgs):
         return jax.lax.scan(body, sim, plan)
 
-    return run_fn
+    return jax.jit(run_fn, **_jit_kwargs(donate, shardings, 2, 2))

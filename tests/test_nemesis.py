@@ -89,9 +89,8 @@ def test_one_way_halves_is_asymmetric():
 
 
 def test_grudge_matrix_expresses_one_way():
-    from maelstrom_tpu.runner.tpu_runner import _grudge_matrix
     grudge = {"n0": {"n1"}}             # n1 -> n0 blocked; n0 -> n1 flows
-    groups, matrix = _grudge_matrix(NODES, grudge)
+    groups, matrix = nem.grudge_matrix(NODES, grudge)
     assert matrix[1, 0] and not matrix[0, 1]
 
 

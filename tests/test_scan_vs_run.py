@@ -1,0 +1,185 @@
+"""Scan/loop equivalence at the sim layer: one `make_scan_fn` dispatch
+(with and without the `journal_cap`/`reply_cap` device rings) and one
+`make_run_fn` lax.scan must be bit-identical to stepping `make_round_fn`
+round by round — same PRNG stream, same state evolution, same journal io
+rows, same client replies at the same rounds. This is the contract that
+lets the production runner drain extraction in large batches: the rings
+must be a pure reorganization of the per-round outputs, never a
+different simulation."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu.net import tpu as T
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.sim import (dealias, make_round_fn, make_run_fn,
+                               make_scan_fn, make_sim)
+
+R = 12          # rounds per equivalence window
+
+
+def _build(name):
+    n = 4
+    nodes = [f"n{i}" for i in range(n)]
+    opts = {"latency": {"mean": 0}}
+    if name == "broadcast":
+        opts.update({"topology": "grid", "max_values": 8})
+    program = get_program(name, opts, nodes)
+    cfg = T.NetConfig(n_nodes=n, n_clients=2, pool_cap=64,
+                      inbox_cap=program.inbox_cap, client_cap=4)
+    return program, cfg
+
+
+def _inject(name, cfg):
+    """One client request in round 0 (the scan applies `inject` in its
+    first round; the reference loop passes the same batch)."""
+    if name == "broadcast":
+        from maelstrom_tpu.nodes.broadcast import T_BCAST
+        typ, a = T_BCAST, 3
+    else:
+        from maelstrom_tpu.nodes.echo import T_ECHO
+        typ, a = T_ECHO, 7
+    CC = max(cfg.n_clients, 1)
+    inj = T.Msgs.empty(CC)
+    return inj.replace(valid=inj.valid.at[0].set(True),
+                       src=inj.src.at[0].set(cfg.n_nodes),
+                       dest=inj.dest.at[0].set(1),
+                       type=inj.type.at[0].set(typ),
+                       a=inj.a.at[0].set(a))
+
+
+def _tree_eq(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _reference(program, cfg, inject, seed=3):
+    """Per-round dispatch: the ground truth the compiled paths must
+    reproduce bit for bit."""
+    round_fn = make_round_fn(program, cfg)
+    empty = T.Msgs.empty(max(cfg.n_clients, 1))
+    sim = make_sim(program, cfg, seed=seed)
+    ios, cms = [], []
+    for i in range(R):
+        sim, cm, io = round_fn(sim, inject if i == 0 else empty)
+        ios.append(jax.device_get(io))
+        cms.append(jax.device_get(cm))
+    return jax.device_get(sim), ios, cms
+
+
+@pytest.mark.parametrize("name", ["echo", "broadcast"])
+def test_scan_matches_per_round(name):
+    """No rings: one scan dispatch == R per-round dispatches."""
+    program, cfg = _build(name)
+    inject = _inject(name, cfg)
+    ref_sim, _ios, _cms = _reference(program, cfg, inject)
+
+    scan = make_scan_fn(program, cfg)
+    sim = make_sim(program, cfg, seed=3)
+    sim, _cm, k = scan(sim, inject, jnp.int32(R), False)
+    assert int(k) == R
+    _tree_eq(ref_sim, jax.device_get(sim))
+
+
+@pytest.mark.parametrize("name", ["echo", "broadcast"])
+def test_scan_rings_match_per_round(name):
+    """With the device rings on: the collected journal io rows and the
+    reply log must equal the per-round outputs exactly — same rows, same
+    producing rounds — and the state must still be bit-identical."""
+    program, cfg = _build(name)
+    inject = _inject(name, cfg)
+    ref_sim, ios, cms = _reference(program, cfg, inject)
+
+    scan = make_scan_fn(program, cfg, journal_cap=R, reply_cap=32)
+    sim = make_sim(program, cfg, seed=3)
+    sim, _cm, k, rl, buf = scan(sim, inject, jnp.int32(R), False)
+    assert int(k) == R
+    _tree_eq(ref_sim, jax.device_get(sim))
+
+    # journal ring rows i == round i's io tree
+    buf = jax.device_get(buf)
+    for i in range(R):
+        _tree_eq(jax.tree.map(lambda b, i=i: b[i], buf), ios[i])
+
+    # reply ring == the valid client msgs of each round, in order, each
+    # stamped with its producing round's post-round counter
+    rlog, rounds, _plog, rn = jax.device_get(rl)
+    expect = []
+    for i, cm in enumerate(cms):
+        valid = np.asarray(cm.valid)
+        for j in np.nonzero(valid)[0]:
+            expect.append((i + 1, int(np.asarray(cm.mid)[j]),
+                           int(np.asarray(cm.type)[j]),
+                           int(np.asarray(cm.a)[j])))
+    got = [(int(rounds[j]), int(rlog.mid[j]), int(rlog.type[j]),
+            int(rlog.a[j])) for j in range(int(rn))]
+    assert got == expect and len(got) > 0
+
+
+@pytest.mark.parametrize("name", ["echo", "broadcast"])
+def test_run_fn_matches_per_round(name):
+    """`make_run_fn` (the bench path, donated carry) over a plan with
+    the same injection == the per-round reference."""
+    program, cfg = _build(name)
+    inject = _inject(name, cfg)
+    ref_sim, _ios, cms = _reference(program, cfg, inject)
+
+    CC = max(cfg.n_clients, 1)
+    plan = jax.tree.map(
+        lambda z, f: z.at[0].set(f),
+        T.Msgs.empty((R, CC)), inject)
+    run_fn = make_run_fn(program, cfg, donate=True)
+    sim = dealias(make_sim(program, cfg, seed=3))
+    sim, counts = run_fn(sim, plan)
+    _tree_eq(ref_sim, jax.device_get(sim))
+    np.testing.assert_array_equal(
+        np.asarray(counts),
+        np.asarray([int(np.asarray(cm.valid).sum()) for cm in cms]))
+
+
+def test_donated_scan_matches_and_requires_dealias(monkeypatch):
+    """Donation actually engaged (it defaults off on CPU): a donated
+    scan over a dealiased sim is bit-identical to the undonated one
+    across chained dispatches, and a freshly-built (aliased) sim is
+    rejected by XLA — the contract `dealias` exists to satisfy. Without
+    forcing MAELSTROM_DONATE=1 the donation machinery would compile
+    away in CI and only ever run on hardware."""
+    monkeypatch.setenv("MAELSTROM_DONATE", "1")
+    program, cfg = _build("echo")
+    inject = _inject("echo", cfg)
+    ref_sim, _ios, _cms = _reference(program, cfg, inject)
+
+    scan = make_scan_fn(program, cfg, reply_cap=16, donate=True)
+    sim = dealias(make_sim(program, cfg, seed=3))
+    for _ in range(3):      # chained donated dispatches reuse buffers
+        sim, _cm, k, _rl = scan(sim, inject if _ == 0 else
+                                T.Msgs.empty(max(cfg.n_clients, 1)),
+                                jnp.int32(R // 3), False)
+    _tree_eq(ref_sim, jax.device_get(sim))
+
+    # an aliased tree (Msgs.empty fans one buffer across fields) must
+    # be refused at the donating boundary, not silently miscomputed
+    with pytest.raises(Exception, match="[Dd]onate"):
+        scan(make_sim(program, cfg, seed=3), inject, jnp.int32(2), False)
+
+
+def test_scan_stop_on_reply_prefix():
+    """stop_on_reply exits at the first reply-bearing round; the rounds
+    it did execute must be the bit-identical prefix of the full run."""
+    program, cfg = _build("echo")
+    inject = _inject("echo", cfg)
+    _ref_sim, _ios, cms = _reference(program, cfg, inject)
+    first_reply = next(i for i, cm in enumerate(cms)
+                       if np.asarray(cm.valid).any())
+
+    scan = make_scan_fn(program, cfg)
+    sim = make_sim(program, cfg, seed=3)
+    sim, cm, k = scan(sim, inject, jnp.int32(R), True)
+    assert int(k) == first_reply + 1
+    _tree_eq(cms[first_reply], jax.device_get(cm))

@@ -1,0 +1,120 @@
+"""The production runner's `--mesh` mode: sharding the interactive
+simulation over a ("dp", "sp") device mesh must change *placement only*.
+Same-seed mesh runs are bit-identical to single-chip runs — histories,
+completion times, journals — and extraction stays off the hot path
+(host drains ~ dispatches, not ~ simulated rounds).
+
+Runs on the 8 virtual CPU devices from conftest; the `multichip` marker
+auto-skips on single-device environments (conftest hook)."""
+
+from __future__ import annotations
+
+import pytest
+
+from conftest import ops_projection as _ops
+from maelstrom_tpu import core
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+pytestmark = pytest.mark.multichip
+
+
+def _run(tmp_path, journal=False, **over):
+    opts = {"node_count": 8, "rate": 15.0, "time_limit": 1.5,
+            "recovery_s": 0.5, "seed": 5, "store_root": str(tmp_path)}
+    opts.update(over)
+    test = core.build_test(opts)
+    test["store_dir"] = str(tmp_path)
+    runner = TpuRunner(test)
+    if journal:
+        from maelstrom_tpu.net.journal import Journal
+        runner.journal = Journal()
+    history = runner.run()
+    return runner, history, test
+
+
+def test_mesh_smoke_bit_identical_and_drains_bounded(tmp_path):
+    """Tier-1 CPU 2-device smoke: a sharded broadcast run equals the
+    single-chip run op for op, and its host-drain count is
+    O(host-relevant rounds) — far below the simulated round count."""
+    over = {"workload": "broadcast", "node": "tpu:broadcast",
+            "topology": "grid"}
+    r1, h1, _ = _run(tmp_path / "a", **over)
+    r2, h2, t2 = _run(tmp_path / "b", mesh="1,2", **over)
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
+    assert r2.mesh is not None and r2.mesh.shape["sp"] == 2
+
+    # extraction off the hot path: each compiled dispatch drains once
+    # (plus a few scalar probes); simulated rounds dwarf that
+    assert r2.final_round > 1000
+    assert 0 < r2.transfer.drains < r2.final_round // 4
+    assert r2.transfer.host_bytes > 0
+
+    # the counters surface in the net-stats checker result
+    from maelstrom_tpu.runner.tpu_runner import TpuNetStats
+    res = TpuNetStats(r2).check(t2, h2, {})
+    assert res["drains"] == r2.transfer.drains
+    assert res["host-bytes"] == r2.transfer.host_bytes
+    assert res["valid"] is True
+
+
+def test_mesh_rejects_cluster_axis(tmp_path):
+    """The interactive runner simulates one cluster: dp > 1 has nothing
+    to data-parallelize and must be rejected loudly (replicating over dp
+    is not value-safe under GSPMD scatter partitioning)."""
+    with pytest.raises(ValueError, match="cluster axis must be 1"):
+        _run(tmp_path, workload="broadcast", node="tpu:broadcast",
+             topology="grid", mesh="2,2")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,node,mesh", [
+    ("broadcast", "tpu:broadcast", "1,4"),
+    ("lin-kv", "tpu:lin-kv", "1,2"),        # raft consensus
+    ("kafka", "tpu:kafka", "1,4"),
+])
+def test_mesh_bit_identical_all_workloads(tmp_path, workload, node, mesh):
+    """Acceptance: sharded runs are bit-identical to single-chip for the
+    same seed on broadcast, raft, and kafka."""
+    over = {"workload": workload, "node": node}
+    if workload == "broadcast":
+        over["topology"] = "grid"
+    r1, h1, _ = _run(tmp_path / "a", **over)
+    r2, h2, t2 = _run(tmp_path / "b", mesh=mesh, **over)
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
+    res = t2["workload_map"]["checker"].check(t2, h2, {})
+    assert res["valid"], res
+
+
+@pytest.mark.slow
+def test_mesh_bit_identical_under_faults_with_journal(tmp_path):
+    """Nemesis mask surgery (directional partitions installed host-side
+    mid-run) and the io-collecting journal scan, both under the mesh:
+    history AND per-message journal must match single-chip exactly."""
+    from collections import Counter
+
+    over = {"workload": "broadcast", "node": "tpu:broadcast",
+            "topology": "grid", "nemesis": {"partition"},
+            "nemesis_interval": 0.4, "journal": True}
+    r1, h1, _ = _run(tmp_path / "a", **over)
+    r2, h2, _ = _run(tmp_path / "b", mesh="1,2", **over)
+    assert _ops(h1) == _ops(h2)
+    ev1 = Counter((e.type, e.id, e.time, e.src, e.dest)
+                  for e in r1.journal.all_events())
+    ev2 = Counter((e.type, e.id, e.time, e.src, e.dest)
+                  for e in r2.journal.all_events())
+    assert ev1 == ev2 and sum(ev1.values()) > 0
+
+
+@pytest.mark.slow
+def test_mesh_bit_identical_kill_pause(tmp_path):
+    """Crash-kill + pause under the mesh: the durable store, down/paused
+    masks, and the donated restart all live sharded; decisions and
+    histories must match single-chip."""
+    over = {"workload": "lin-kv", "node": "tpu:lin-kv",
+            "nemesis": {"kill", "pause"}, "nemesis_interval": 0.4}
+    r1, h1, _ = _run(tmp_path / "a", **over)
+    r2, h2, _ = _run(tmp_path / "b", mesh="1,2", **over)
+    assert len(h1) > 20
+    assert _ops(h1) == _ops(h2)
