@@ -178,6 +178,127 @@ def run_with_env_retry(fn, attempts=None, backoff_s=None,
     sys.exit(3)
 
 
+def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
+    """Checker-throughput section: the analysis pipeline's two hot
+    paths on synthetic histories, each against its pure-Python
+    baseline, so checker perf rides the BENCH_*.json trajectory next to
+    simulation msgs/s.
+
+      - register: a 1M-row lin-kv history through
+        LinearizableRegisterChecker — columnar partition + vectorized
+        screen vs. the sequential pairs()+WGL path (opts no_fast)
+      - elle: ww/wr/rw dependency-edge construction on a ~1M-micro-op
+        list-append transaction set — sorted-index-array build vs. the
+        nested-loop build
+
+    Pure host/numpy work (no JAX backend), so it runs identically on
+    the CPU fallback. Both halves assert verdict/edge equality; a
+    mismatch marks the record invalid."""
+    from maelstrom_tpu.checkers.elle import (_edges_python,
+                                             _edges_vectorized)
+    from maelstrom_tpu.checkers.linearizable import \
+        LinearizableRegisterChecker
+    from maelstrom_tpu.history import History
+
+    n_rows = n_rows or int(os.environ.get("BENCH_CHECKER_OPS", 1_000_000))
+    n_rows -= n_rows % 2
+    n_ops = n_rows // 2
+    keys = int(os.environ.get("BENCH_CHECKER_KEYS", 128))
+
+    # synthetic sequential lin-kv history: one worker, every 4th op a
+    # write, reads observe the running per-key state (valid by
+    # construction; the screen decides every key without WGL)
+    h = History()
+    state = [None] * keys
+    types, fs, vals, procs, times = [], [], [], [], []
+    t = 0
+    for i in range(n_ops):
+        k = i % keys
+        if i % 4 == 0:
+            f, v = "write", i % 7
+            state[k] = v
+        else:
+            f, v = "read", state[k]
+        types += ["invoke", "ok"]
+        fs += [f, f]
+        vals += [[k, v], [k, v]]
+        procs += [0, 0]
+        times += [t, t + 1]
+        t += 2
+    h.extend_columns(types, fs, vals, procs, times)
+
+    c = LinearizableRegisterChecker()
+    t0 = time.perf_counter()
+    fast = c.check({}, h)
+    fast_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    base = c.check({}, h, {"no_fast": True})
+    base_s = time.perf_counter() - t0
+    register = {
+        "history_rows": n_rows, "ops": n_ops, "keys": keys,
+        "valid": fast["valid"], "verdicts_match": fast == base,
+        "fast_s": round(fast_s, 4),
+        "fast_ops_per_s": round(n_ops / fast_s, 1),
+        "baseline_s": round(base_s, 4),
+        "baseline_ops_per_s": round(n_ops / base_s, 1),
+        "speedup": round(base_s / fast_s, 2),
+    }
+
+    # elle: synthetic append/read transaction set -> edge build only
+    elle_ops = elle_ops or int(
+        os.environ.get("BENCH_CHECKER_ELLE_OPS", 1_000_000))
+    ekeys = 64
+    versions_per_key = max(2, elle_ops // (5 * ekeys))
+    rng = np.random.RandomState(7)
+    txns, longest, appender = [], {}, {}
+    micro_ops = 0
+    for ki in range(ekeys):
+        kk = repr(ki)
+        order = []
+        for vi in range(versions_per_key):
+            vv = repr(ki * versions_per_key + vi)
+            tid = len(txns)
+            txns.append({"id": tid, "ok": True, "inv": micro_ops,
+                         "ret": micro_ops + 1,
+                         "micro": [["append", ki,
+                                    ki * versions_per_key + vi]]})
+            appender[(kk, vv)] = tid
+            order.append(vv)
+            micro_ops += 1
+        longest[kk] = order
+    # version construction has a floor of 2*ekeys appends; a tiny
+    # elle_ops must clamp instead of asking for negative reads
+    n_reads = max(0, elle_ops - micro_ops)
+    read_keys = rng.randint(0, ekeys, n_reads)
+    read_lens = rng.randint(0, versions_per_key + 1, n_reads)
+    for ki, ln in zip(read_keys.tolist(), read_lens.tolist()):
+        tid = len(txns)
+        txns.append({"id": tid, "ok": True, "inv": micro_ops,
+                     "ret": micro_ops + 1,
+                     "micro": [["r", ki,
+                                list(range(ki * versions_per_key,
+                                           ki * versions_per_key + ln))]]})
+        micro_ops += 1
+    t0 = time.perf_counter()
+    ev = _edges_vectorized(txns, longest, appender)
+    vec_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ep = _edges_python(txns, longest, appender)
+    py_s = time.perf_counter() - t0
+    elle = {
+        "micro_ops": micro_ops, "keys": ekeys,
+        "edges": len(ev), "match": ev == ep,
+        "vectorized_s": round(vec_s, 4),
+        "vectorized_ops_per_s": round(micro_ops / vec_s, 1),
+        "python_s": round(py_s, 4),
+        "python_ops_per_s": round(micro_ops / py_s, 1),
+        "speedup": round(py_s / vec_s, 2),
+    }
+    return {"register": register, "elle": elle,
+            "valid": bool(register["verdicts_match"] and elle["match"]
+                          and register["valid"] is True)}
+
+
 def bench_raft_clusters():
     """Secondary benchmark: 10k independent 5-node raft clusters advance
     under one vmap (BASELINE config 4). Metric: cluster-rounds/sec —
@@ -492,6 +613,14 @@ def _main_broadcast():
         record["graded"]["stable_latencies_ms"] = \
             graded["checker"]["stable-latencies"]
 
+    # analysis-pipeline throughput (host/numpy only; BENCH_CHECKER=0
+    # to skip): register fast path + elle edge build vs their
+    # pure-Python baselines on synthetic 1M-op histories
+    checker = None
+    if os.environ.get("BENCH_CHECKER", "1") == "1":
+        checker = bench_checkers_record()
+        record["checker"] = checker
+
     print(json.dumps(record))
     # a non-converged, lossy, or checker-failed run is not a valid
     # benchmark: fail loudly (after emitting the JSON record)
@@ -501,6 +630,10 @@ def _main_broadcast():
             or record.get("eager_dropped_overflow")):
         sys.exit(1)
     if graded is not None and graded["checker_valid"] is not True:
+        sys.exit(1)
+    # a checker fast path that disagrees with its baseline is a
+    # correctness bug, not a perf datum
+    if checker is not None and not checker["valid"]:
         sys.exit(1)
 
 

@@ -37,6 +37,8 @@ Consistency models map to which anomalies are violations:
 
 from __future__ import annotations
 
+import numpy as np
+
 from . import Checker
 from ..history import coerce_history
 
@@ -110,7 +112,103 @@ def _hv(v):
     return repr(v)
 
 
-def analyze(history) -> dict:
+def _edges_python(txns, longest, appender):
+    """Reference (pre-vectorization) dependency-edge construction:
+    nested Python loops over every read. Kept as the equivalence oracle
+    and the checker-throughput bench baseline."""
+    edges: set = set()
+
+    def version_writer(kk, idx):
+        if idx <= 0 or idx > len(longest.get(kk, [])):
+            return None
+        return appender.get((kk, longest[kk][idx - 1]))
+
+    for kk, order in longest.items():
+        for i in range(1, len(order)):
+            a, b = appender.get((kk, order[i - 1])), \
+                appender.get((kk, order[i]))
+            if a is not None and b is not None and a != b:
+                # same-txn multi-appends don't create edges
+                edges.add((a, b, "ww"))
+
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f != "r" or not isinstance(v, list):
+                continue
+            kk = _hk(k)
+            n = len(v)
+            if n > 0:
+                w = version_writer(kk, n)
+                if w is not None and w != t["id"]:
+                    edges.add((w, t["id"], "wr"))
+            nxt = version_writer(kk, n + 1)
+            if nxt is not None and nxt != t["id"]:
+                edges.add((t["id"], nxt, "rw"))
+    return edges
+
+
+def _edges_vectorized(txns, longest, appender):
+    """ww/wr/rw dependency edges from sorted index arrays: per-key
+    version orders concatenate into one writer table (offsets +
+    gathers), ww edges are the consecutive-writer pairs inside each
+    key's span, and each read's wr/rw edges are two table gathers at
+    positions offset+n-1 / offset+n. One Python pass flattens reads to
+    arrays; everything after is numpy. Produces the identical edge set
+    to `_edges_python` (pinned by tests)."""
+    edges: set = set()
+    keys = list(longest)
+    key_idx = {kk: i for i, kk in enumerate(keys)}
+    nk = len(keys)
+    lens = np.fromiter((len(longest[kk]) for kk in keys), np.int64, nk)
+    offsets = np.zeros(nk + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    writers = np.fromiter(
+        (appender.get((kk, v), -1)
+         for kk in keys for v in longest[kk]),
+        np.int64, int(offsets[-1]))
+
+    if len(writers) > 1:
+        a, b = writers[:-1], writers[1:]
+        same_key = np.ones(len(writers) - 1, bool)
+        same_key[offsets[1:-1] - 1] = False     # pairs spanning two keys
+        m = same_key & (a >= 0) & (b >= 0) & (a != b)
+        edges.update(zip(a[m].tolist(), b[m].tolist(),
+                         ("ww",) * int(m.sum())))
+
+    r_tid, r_key, r_n = [], [], []
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f == "r" and isinstance(v, list):
+                r_tid.append(t["id"])
+                r_key.append(key_idx.get(_hk(k), -1))
+                r_n.append(len(v))
+    if r_tid and nk:        # no keyed versions -> no read edges exist
+        tid = np.asarray(r_tid, np.int64)
+        ki = np.asarray(r_key, np.int64)
+        n_ = np.asarray(r_n, np.int64)
+        ks = np.maximum(ki, 0)
+        # wr: the writer of the version this read observed (its length)
+        has = (ki >= 0) & (n_ > 0)
+        w = np.full(len(tid), -1, np.int64)
+        w[has] = writers[offsets[ks[has]] + n_[has] - 1]
+        m = (w >= 0) & (w != tid)
+        edges.update(zip(w[m].tolist(), tid[m].tolist(),
+                         ("wr",) * int(m.sum())))
+        # rw anti-dependency: the writer of the NEXT version
+        can = (ki >= 0) & (n_ < lens[ks])
+        nxt = np.full(len(tid), -1, np.int64)
+        nxt[can] = writers[offsets[ks[can]] + n_[can]]
+        m = (nxt >= 0) & (nxt != tid)
+        edges.update(zip(tid[m].tolist(), nxt[m].tolist(),
+                         ("rw",) * int(m.sum())))
+    return edges
+
+
+def analyze(history, *, edges_impl=None) -> dict:
     history = coerce_history(history)
     txns = _txn_ops(history)
     failed_appends = _fail_appends(history)
@@ -275,56 +373,31 @@ def analyze(history) -> dict:
                      {"key": k, "loaded": raw, "txns": ids})
 
     # --- dependency graph ---
-    # edges: (src, dst, kind) with kind in ww/wr/rw/rt
-    edges: set = set()
-
-    def version_writer(kk, idx):
-        """Writer txn of version idx (1-based position in longest[kk])."""
-        if idx <= 0 or idx > len(longest.get(kk, [])):
-            return None
-        return appender.get((kk, longest[kk][idx - 1]))
-
-    for kk, order in longest.items():
-        for i in range(1, len(order)):
-            a, b = appender.get((kk, order[i - 1])), \
-                appender.get((kk, order[i]))
-            if a is not None and b is not None and a != b:
-                # same-txn multi-appends don't create edges
-                edges.add((a, b, "ww"))
-
-    for t in txns:
-        if not t["ok"]:
-            continue
-        for f, k, v in t["micro"]:
-            if f != "r" or not isinstance(v, list):
-                continue
-            kk = _hk(k)
-            n = len(v)
-            if n > 0:
-                w = version_writer(kk, n)
-                if w is not None and w != t["id"]:
-                    edges.add((w, t["id"], "wr"))
-            nxt = version_writer(kk, n + 1)
-            if nxt is not None and nxt != t["id"]:
-                edges.add((t["id"], nxt, "rw"))
+    # edges: (src, dst, kind) with kind in ww/wr/rw, built from sorted
+    # index arrays (`_edges_vectorized`); tests/benches inject
+    # `_edges_python` to pin equivalence / measure the speedup
+    edges = (edges_impl or _edges_vectorized)(txns, longest, appender)
 
     # Real-time edges via a barrier chain rather than the O(n^2) transitive
     # closure: each txn points at the barrier for its completion time;
     # barriers chain forward; each txn is pointed at by the latest barrier
     # before its invocation. t1 reaches t2 through barriers iff
-    # ret(t1) < inv(t2), preserving exactly the realtime cycles.
+    # ret(t1) < inv(t2), preserving exactly the realtime cycles. The
+    # latest-barrier-before-invocation search is one batched
+    # searchsorted over the ret-sorted completion times.
     rt_edges = set()
     ok_txns = sorted((t for t in txns if t["ok"]), key=lambda t: t["ret"])
-    barrier_times = [t["ret"] for t in ok_txns]
     for i in range(len(ok_txns) - 1):
         rt_edges.add((("b", i), ("b", i + 1), "rt"))
     for i, t in enumerate(ok_txns):
         rt_edges.add((t["id"], ("b", i), "rt"))
-    import bisect
-    for t in ok_txns:
-        j = bisect.bisect_left(barrier_times, t["inv"]) - 1
-        if j >= 0:
-            rt_edges.add((("b", j), t["id"], "rt"))
+    if ok_txns:
+        m = len(ok_txns)
+        rets = np.fromiter((t["ret"] for t in ok_txns), np.float64, m)
+        invs = np.fromiter((t["inv"] for t in ok_txns), np.float64, m)
+        before = np.searchsorted(rets, invs, side="left") - 1
+        for i in np.flatnonzero(before >= 0):
+            rt_edges.add((("b", int(before[i])), ok_txns[i]["id"], "rt"))
 
     def cycles_with(edge_set):
         """Tarjan SCC; returns list of cycles (as lists of txn ids)."""

@@ -18,12 +18,23 @@ The register model carries the production lin-kv path (histories are
 partitioned by key — values are [k, v] tuples, mirroring
 jepsen.independent — which keeps each search small); the other models
 prove the engine's generality, pinned by the adversarial corpus.
+
+At production scale the per-key search is P-compositional: the history
+partitions by key with numpy group-bys over the columnar history
+(`partition_register`), each partition runs a vectorized *screen*
+(`screen_register_arrays`) — sound, never claims validity wrongly —
+and only partitions the screen cannot decide fall back to the full WGL
+search. Verdicts are bit-identical to the sequential path by
+construction: the screen only ever emits the same `{"valid": True}`
+the search would, and fallback partitions carry identical op lists.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 from . import Checker
-from ..history import coerce_history
+from ..history import FAIL, OK, TYPE_CODES, coerce_history
 
 INF = float("inf")
 
@@ -192,7 +203,16 @@ def check_history(ops, model: Model | None = None,
             if i + len(extra) > best_n:
                 best_n, best = i + len(extra), key
             if len(seen) > max_states:
+                # structured "undecided": the search ran out of state
+                # budget, it did NOT find a violation. Overlapped
+                # screens and composed checkers defer on this shape
+                # instead of special-casing an error string.
                 return {"valid": "unknown",
+                        "undecided": True,
+                        "reason": "max-states",
+                        "max-states": max_states,
+                        "explored-configurations": len(seen),
+                        "op-count": n,
                         "error": "WGL configuration cap exceeded"}
             it = iter([(j, s2) for j in candidates(i, extra)
                        for s2 in model.apply(state, ops[j]["f"],
@@ -219,20 +239,223 @@ def check_history(ops, model: Model | None = None,
              "ret": None if stuck["ret"] == INF else stuck["ret"]}}
 
 
-def check_register_history(ops, max_states: int = 5_000_000):
+# --- the vectorized register fast path ---
+
+# f codes inside a register partition's arrays
+F_READ, F_WRITE, F_CAS = 0, 1, 2
+_F_NAMES = ("read", "write", "cas")
+
+
+def screen_register_arrays(f, value, inv, ret, ok):
+    """The P-composition fast screen for one key's partition, fully
+    vectorized. Returns True when the partition is DEFINITELY
+    linearizable, None when undecided (the caller falls back to WGL).
+
+    The decidable class: every op ok, only reads and writes, and the
+    ops totally ordered in real time (sorted by invocation, no op
+    overlaps the next). Real time then admits exactly one linearization
+    order — the sorted order — so the partition is linearizable iff a
+    sequential replay succeeds: each read observes the latest earlier
+    write (or the initial None). The replay is a forward-fill of write
+    indices plus one elementwise compare. Sound by construction (a pass
+    exhibits a witness order); ties or replay mismatches return None,
+    never False, so WGL keeps sole authority over invalid verdicts."""
+    n = len(inv)
+    if n == 0:
+        return True
+    f = np.asarray(f)
+    ok = np.asarray(ok)
+    if not ok.all() or (f == F_CAS).any():
+        return None
+    order = np.argsort(inv, kind="stable")
+    invs = np.asarray(inv, np.float64)[order]
+    rets = np.asarray(ret, np.float64)[order]
+    if n > 1 and (rets[:-1] > invs[1:]).any():
+        return None                      # concurrency: needs the search
+    fo = f[order]
+    vo = np.asarray(value, object)[order]
+    w = fo == F_WRITE
+    last_w = np.maximum.accumulate(np.where(w, np.arange(n), -1))
+    rpos = np.flatnonzero(~w)
+    if rpos.size == 0:
+        return True
+    prev = last_w[rpos]
+    expected = np.empty(rpos.size, object)
+    has_w = prev >= 0
+    expected[has_w] = vo[prev[has_w]]
+    expected[~has_w] = None
+    mismatch = vo[rpos] != expected      # object elementwise ==
+    if np.any(mismatch):
+        return None
+    return True
+
+
+def _screen_ops(ops):
+    """Screen adapter for the stable dict-shaped entry point."""
+    n = len(ops)
+    fmap = {"read": F_READ, "write": F_WRITE, "cas": F_CAS}
+    try:
+        f = np.fromiter((fmap[o["f"]] for o in ops), np.int8, n)
+    except KeyError:
+        return None                      # unknown f: let WGL raise
+    value = np.empty(n, object)
+    value[:] = [o["value"] for o in ops]
+    inv = np.fromiter((o["inv"] for o in ops), np.float64, n)
+    ret = np.fromiter((o["ret"] for o in ops), np.float64, n)
+    ok = np.fromiter((o["ok"] for o in ops), bool, n)
+    return screen_register_arrays(f, value, inv, ret, ok)
+
+
+def check_register_history(ops, max_states: int = 5_000_000,
+                           screen: bool = True):
     """The register instance of `check_history` (production lin-kv
-    path; kept as the stable entry point)."""
+    path; kept as the stable entry point). Tries the vectorized screen
+    first; only undecided histories pay for the search."""
+    if screen and _screen_ops(ops) is True:
+        return {"valid": True}
     return check_history(ops, RegisterModel(), max_states)
+
+
+_is_kv_pair = np.frompyfunc(
+    lambda v: isinstance(v, (list, tuple)) and len(v) == 2, 1, 1)
+_kv_key = np.frompyfunc(lambda v: v[0], 1, 1)
+_completed_value = np.frompyfunc(
+    lambda iv, cv, ok: cv[1] if ok and cv is not None else iv[1], 3, 1)
+
+
+def partition_register(history):
+    """Columnar P-composition: partitions a history's register ops by
+    key with numpy group-bys. Returns [(key, arrays)] sorted by
+    repr(key), where arrays is {"f", "value", "inv", "ret", "ok"} numpy
+    columns in invoke order — exactly the per-key op list the
+    sequential path builds (fail completions dropped, indeterminate
+    rets at +inf, observed read values substituted), without
+    materializing one dict per op."""
+    history = coerce_history(history)
+    soa = history.soa()
+    pi = history.pairs_index()
+    if len(pi) == 0:
+        return []
+    inv_rows, comp_rows = pi[:, 0], pi[:, 1]
+
+    # register invokes with well-formed [k, v] values
+    fmap = np.full(len(soa.f_table), -1, np.int8)
+    for code, name in enumerate(soa.f_table):
+        if name in _F_NAMES:
+            fmap[code] = _F_NAMES.index(name)
+    f = fmap[soa.f[inv_rows]]
+    ivals = soa.value[inv_rows]
+    keep = (f >= 0) & _is_kv_pair(ivals).astype(bool)
+    if not keep.any():
+        return []
+    inv_rows, comp_rows, f = inv_rows[keep], comp_rows[keep], f[keep]
+    ivals = ivals[keep]
+
+    # completion columns (sentinel row -1 reads row 0 then gets masked)
+    has_comp = comp_rows >= 0
+    safe = np.where(has_comp, comp_rows, 0)
+    ctype = np.where(has_comp, soa.type[safe], -1)
+    ok = ctype == TYPE_CODES[OK]
+    cvals = np.where(has_comp, soa.value[safe], None)
+    ret = np.where(ok, soa.time[safe].astype(np.float64), INF)
+    inv = soa.time[inv_rows]
+    value = _completed_value(ivals, cvals, ok)
+    not_fail = ctype != TYPE_CODES[FAIL]
+
+    # group by key (first-appearance interning keeps repr-ties in the
+    # sequential path's insertion order)
+    codes = {}
+    kc = np.fromiter((codes.setdefault(k, len(codes))
+                      for k in _kv_key(ivals)), np.int64, len(ivals))
+    keys = list(codes)
+    order = np.argsort(kc, kind="stable")     # stable: invoke order kept
+    bounds = np.searchsorted(kc[order], np.arange(len(keys) + 1))
+    parts = []
+    for ki in range(len(keys)):
+        rows = order[bounds[ki]:bounds[ki + 1]]
+        rows = rows[not_fail[rows]]           # fail ops definitely absent
+        parts.append((keys[ki], {
+            "f": f[rows], "value": value[rows],
+            "inv": inv[rows], "ret": ret[rows], "ok": ok[rows]}))
+    parts.sort(key=lambda kv: repr(kv[0]))
+    return parts
+
+
+def ops_from_arrays(arrs) -> list[dict]:
+    """Materializes one partition's dict-shaped op list for the WGL
+    fallback — identical to what the sequential path would have built
+    (ints for definite rets, so witnesses render identically)."""
+    return [{"f": _F_NAMES[arrs["f"][i]], "value": arrs["value"][i],
+             "inv": int(arrs["inv"][i]),
+             "ret": int(arrs["ret"][i]) if arrs["ok"][i] else INF,
+             "ok": bool(arrs["ok"][i])}
+            for i in range(len(arrs["inv"]))]
 
 
 class LinearizableRegisterChecker(Checker):
     """Per-key independent linearizable register checking
-    (the jepsen.tests.linearizable-register equivalent)."""
+    (the jepsen.tests.linearizable-register equivalent). The default
+    path partitions columnarly and screens each partition; pass
+    opts={"no_fast": True} for the sequential pure-Python baseline
+    (bench/verification use)."""
 
     name = "linear"
+    # the runner only spins up the overlapped analysis pipeline when
+    # the test's checker tree contains a consumer of its partitions
+    consumes_analysis = True
 
     def check(self, test, history, opts=None):
+        opts = opts or {}
         history = coerce_history(history)
+        if opts.get("no_fast"):
+            return self._check_sequential(test, history, opts)
+        parts = None
+        pipeline = (test or {}).get("analysis") if isinstance(test, dict) \
+            else None
+        if pipeline is not None:
+            # overlapped run: partitions (and screen verdicts) were
+            # built incrementally while the simulation was still on the
+            # device; None means the pipeline didn't cover this history
+            parts = pipeline.register_partitions(len(history))
+        if parts is None:
+            parts = [(k, arrs, None) for k, arrs in
+                     partition_register(history)]
+
+        results = {}
+        failures = []
+        for k, arrs, screened in parts:
+            if screened is None:
+                screened = screen_register_arrays(
+                    arrs["f"], arrs["value"], arrs["inv"], arrs["ret"],
+                    arrs["ok"])
+            r = ({"valid": True} if screened is True else
+                 check_history(ops_from_arrays(arrs), RegisterModel()))
+            results[str(k)] = r
+            if r["valid"] is False:
+                failures.append(k)
+        return self._render(results, failures, len(parts))
+
+    def _render(self, results, failures, key_count):
+        valid = (False if failures else
+                 ("unknown" if any(r["valid"] == "unknown"
+                                   for r in results.values()) else True))
+        out = {"valid": valid,
+               "key-count": key_count,
+               "failures": failures or None}
+        if failures:
+            # surface each failed key's witness (deepest linearizable
+            # prefix + the op that cannot linearize) in the results file
+            out["witnesses"] = {
+                str(k): {kk: results[str(k)][kk]
+                         for kk in ("linearized-prefix", "op-count",
+                                    "final-state", "stuck-op")
+                         if kk in results[str(k)]}
+                for k in failures}
+        return out
+
+    def _check_sequential(self, test, history, opts=None):
+        """The pre-columnar path: per-op Python partitioning + WGL on
+        every key. Kept as the equivalence/bench baseline."""
         by_key: dict = {}
         for invoke, complete in history.pairs():
             if invoke.f not in ("read", "write", "cas"):
@@ -257,23 +480,8 @@ class LinearizableRegisterChecker(Checker):
                             "inv": invoke.time,
                             "ret": complete.time if ok else INF,
                             "ok": ok})
-            r = check_register_history(ops)
+            r = check_history(ops, RegisterModel())
             results[str(k)] = r
             if r["valid"] is False:
                 failures.append(k)
-        valid = (False if failures else
-                 ("unknown" if any(r["valid"] == "unknown"
-                                   for r in results.values()) else True))
-        out = {"valid": valid,
-               "key-count": len(by_key),
-               "failures": failures or None}
-        if failures:
-            # surface each failed key's witness (deepest linearizable
-            # prefix + the op that cannot linearize) in the results file
-            out["witnesses"] = {
-                str(k): {kk: results[str(k)][kk]
-                         for kk in ("linearized-prefix", "op-count",
-                                    "final-state", "stuck-op")
-                         if kk in results[str(k)]}
-                for k in failures}
-        return out
+        return self._render(results, failures, len(by_key))

@@ -21,10 +21,20 @@ class TransferStats:
     instead of O(simulated rounds); the TPU-path net-stats checker
     (`runner.tpu_runner.TpuNetStats`) surfaces the counters in every
     result so a regression (an accidental per-round device_get) is
-    visible in plain test output and bench records."""
+    visible in plain test output and bench records.
+
+    Overlap accounting (the analysis-pipeline counters): `blocked_s` is
+    wall time the host spent inside `device_get`, waiting on the device
+    — the irreducible synchronization cost; `overlapped_s` is analysis
+    worker time that ran concurrently with device compute (history
+    pairing, partitioning, incremental screens; see
+    `checkers.pipeline.AnalysisPipeline`). A healthy overlapped run
+    keeps overlapped_s growing while blocked_s stays flat."""
 
     drains: int = 0
     host_bytes: int = 0
+    blocked_s: float = 0.0
+    overlapped_s: float = 0.0
 
     def record(self, tree) -> None:
         """Count one drain of `tree` (any pytree of device/numpy arrays),
@@ -34,8 +44,22 @@ class TransferStats:
         self.host_bytes += sum(int(getattr(x, "nbytes", 0) or 0)
                                for x in jax.tree.leaves(tree))
 
+    def fetch(self, tree):
+        """Books one drain AND the host-blocked wall time of the
+        device_get that materializes it. Returns the host tree."""
+        import time
+
+        import jax
+        self.record(tree)
+        t0 = time.perf_counter()
+        out = jax.device_get(tree)
+        self.blocked_s += time.perf_counter() - t0
+        return out
+
     def as_dict(self) -> dict:
-        return {"drains": self.drains, "host-bytes": self.host_bytes}
+        return {"drains": self.drains, "host-bytes": self.host_bytes,
+                "host-blocked-s": round(self.blocked_s, 6),
+                "host-overlapped-s": round(self.overlapped_s, 6)}
 
 
 class NetStatsChecker(Checker):

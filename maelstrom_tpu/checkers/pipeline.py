@@ -1,0 +1,270 @@
+"""The overlapped analysis pipeline: incremental history analysis that
+runs concurrently with the compiled simulation.
+
+The runner's drains hand each newly-completed history segment to a
+background worker (`feed`), which does the host-Python analysis work the
+sequential checker path would otherwise serialize behind the run:
+
+  - invoke/completion pairing (the open-slot scan),
+  - per-key partitioning of register ops (P-compositionality),
+  - an incremental per-key linearizability screen (the running replay
+    of `screen_register_arrays`' decidable class),
+  - completion stats by :f.
+
+While the TPU executes stretch N+1, the worker chews stretch N. At
+check time `LinearizableRegisterChecker` consumes the already-built
+partitions (and short-circuits keys whose incremental screen stayed
+clean), falling back to the full WGL search only on undecided keys —
+verdicts are bit-identical to the sequential path because the screen is
+sound and fallback partitions carry identical op lists (pinned by
+tests/test_overlap_equivalence.py).
+
+The pipeline is strictly an accelerator: any internal error marks it
+unusable and the checker silently recomputes from the history."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..history import FAIL, INVOKE, OK, TYPE_CODES
+from .linearizable import F_CAS, F_READ, F_WRITE
+
+INF = float("inf")
+_F01 = {"read": F_READ, "write": F_WRITE, "cas": F_CAS}
+
+
+class _KeyPart:
+    """One key's growing register partition + incremental screen state."""
+
+    __slots__ = ("f", "value", "inv", "ret", "ok", "inv_row",
+                 "clean", "cur", "last_inv", "last_ret")
+
+    def __init__(self):
+        self.f: list = []
+        self.value: list = []
+        self.inv: list = []
+        self.ret: list = []
+        self.ok: list = []
+        self.inv_row: list = []
+        # incremental screen: stays clean while every op is an ok
+        # read/write arriving in invocation order with no overlap and a
+        # successful running replay — then the partition is decidedly
+        # linearizable with no further work at check time
+        self.clean = True
+        self.cur = None
+        self.last_inv = -INF
+        self.last_ret = -INF
+
+    def add(self, f01, val, inv, ret, ok, inv_row):
+        self.f.append(f01)
+        self.value.append(val)
+        self.inv.append(inv)
+        self.ret.append(ret)
+        self.ok.append(ok)
+        self.inv_row.append(inv_row)
+        if not self.clean:
+            return
+        if (not ok) or f01 == F_CAS or inv < self.last_inv \
+                or inv < self.last_ret:
+            self.clean = False
+            return
+        if f01 == F_WRITE:
+            self.cur = val
+        elif val != self.cur:
+            self.clean = False
+            return
+        self.last_inv, self.last_ret = inv, ret
+
+    def arrays(self):
+        n = len(self.inv)
+        value = np.empty(n, object)
+        value[:] = self.value
+        arrs = {"f": np.asarray(self.f, np.int8),
+                "value": value,
+                "inv": np.asarray(self.inv, np.int64),
+                "ret": np.asarray(self.ret, np.float64),
+                "ok": np.asarray(self.ok, bool)}
+        order = np.argsort(np.asarray(self.inv_row, np.int64),
+                           kind="stable")
+        return {k: v[order] for k, v in arrs.items()}
+
+
+_NONREG = object()          # open slot held by a non-register invoke
+
+
+class AnalysisPipeline:
+    """Background, in-order history analysis. `feed(history, lo, hi)`
+    enqueues a segment (cheap; called from the runner's dispatch loop);
+    a single worker thread preserves segment order. `finish()` drains
+    the queue; afterwards `register_partitions(n)` serves the columnar
+    partitions to the checker and `report()` summarizes overlap."""
+
+    def __init__(self, workers: int = 1):
+        self.workers = max(1, int(workers))
+        self.busy_s = 0.0           # worker seconds (compute-overlapped)
+        self.segments = 0
+        self.rows = 0
+        self.error: Optional[str] = None
+        self._open: dict = {}       # process code -> invoke record
+        self._parts: dict = {}      # key -> _KeyPart
+        self._stats = {"ok": 0, "fail": 0, "info": 0}
+        self._finished = False
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="maelstrom-analysis", daemon=True)
+        self._thread.start()
+
+    # --- main-thread API ---
+
+    def feed(self, history, lo: int, hi: int):
+        if hi > lo and not self._finished:
+            self._q.put((history, lo, hi))
+
+    def close(self):
+        """Error-path shutdown: stops the worker without finalizing
+        partitions (a closed pipeline declines service). Idempotent."""
+        if not self._finished:
+            self._finished = True
+            self.error = self.error or "closed before finish"
+            self._q.put(None)
+            self._thread.join(timeout=5)
+
+    def finish(self):
+        """Blocks until every fed segment is analyzed, then flushes
+        still-open invokes as unpaired (completion None) ops."""
+        if self._finished:
+            return self
+        self._q.put(None)
+        self._thread.join()
+        self._finished = True
+        try:
+            for rec in self._open.values():
+                if rec is not _NONREG:
+                    self._add_pair(rec, None, None, None)
+        except Exception as e:          # pragma: no cover - defensive
+            self.error = repr(e)
+        return self
+
+    def register_partitions(self, n_rows: int):
+        """[(key, arrays, screened)] sorted by repr(key), or None when
+        this pipeline cannot vouch for the given history (analysis
+        error, not finished, or a row-count mismatch — e.g. a history
+        the pipeline never saw)."""
+        if self.error or not self._finished or self.rows != n_rows:
+            return None
+        parts = [(k, p.arrays(), True if p.clean else None)
+                 for k, p in self._parts.items()]
+        parts.sort(key=lambda kv: repr(kv[0]))
+        undecided = [i for i, (_k, _a, s) in enumerate(parts)
+                     if s is None]
+        if undecided and self.workers > 1:
+            # fan the per-key vectorized screens over the worker pool
+            # (numpy releases the GIL in the hot kernels); keys the
+            # screen can't decide stay None and fall to WGL in the
+            # checker
+            from concurrent.futures import ThreadPoolExecutor
+            from .linearizable import screen_register_arrays
+
+            def screen(i):
+                a = parts[i][1]
+                return i, screen_register_arrays(
+                    a["f"], a["value"], a["inv"], a["ret"], a["ok"])
+            with ThreadPoolExecutor(self.workers) as pool:
+                for i, verdict in pool.map(screen, undecided):
+                    parts[i] = (parts[i][0], parts[i][1], verdict)
+        return parts
+
+    def report(self) -> dict:
+        screened = sum(1 for p in self._parts.values() if p.clean)
+        out = {"workers": self.workers,
+               "segments": self.segments,
+               "rows": self.rows,
+               "busy-s": round(self.busy_s, 6),
+               "register-keys": len(self._parts),
+               "screened-clean-keys": screened,
+               "completions": dict(self._stats)}
+        if self.error:
+            out["error"] = self.error
+        return out
+
+    # --- worker ---
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                if self.error is None:
+                    self._analyze(*item)
+            except Exception as e:
+                self.error = repr(e)
+            finally:
+                self.busy_s += time.perf_counter() - t0
+                self._q.task_done()
+
+    def _analyze(self, history, lo: int, hi: int):
+        """One segment: the open-slot pairing scan over rows [lo, hi).
+        History rows below `hi` are immutable once fed (append-only
+        columns), so reading them off-thread is safe."""
+        soa = history.soa()
+        inv_code = TYPE_CODES[INVOKE]
+        ok_code, fail_code = TYPE_CODES[OK], TYPE_CODES[FAIL]
+        # per-f-code register classification for this history's interner
+        freg = [_F01.get(name) for name in soa.f_table]
+        types, fs, procs = soa.type, soa.f, soa.process
+        times, values = soa.time, soa.value
+        opens = self._open
+        for i in range(lo, hi):
+            p = procs[i]
+            t = types[i]
+            if t == inv_code:
+                old = opens.pop(p, None)
+                if old is not None and old is not _NONREG:
+                    self._add_pair(old, None, None, None)
+                f01 = freg[fs[i]] if fs[i] < len(freg) else None
+                v = values[i]
+                if f01 is not None and isinstance(v, (list, tuple)) \
+                        and len(v) == 2:
+                    opens[p] = (i, f01, v[0], v[1], int(times[i]))
+                else:
+                    opens[p] = _NONREG
+            else:
+                if t == ok_code:
+                    self._stats["ok"] += 1
+                elif t == fail_code:
+                    self._stats["fail"] += 1
+                else:
+                    self._stats["info"] += 1
+                rec = opens.pop(p, None)
+                if rec is None or rec is _NONREG:
+                    continue
+                if t == fail_code:
+                    # definitely didn't happen — excluded from the
+                    # partition, but the KEY still counts (the
+                    # sequential path's by_key holds it with zero ops)
+                    if rec[2] not in self._parts:
+                        self._parts[rec[2]] = _KeyPart()
+                    continue
+                self._add_pair(rec, t == ok_code, values[i],
+                               int(times[i]))
+        self.segments += 1
+        self.rows = hi
+
+    def _add_pair(self, rec, ok, cval, ctime):
+        """Appends one (invoke, completion-or-None) register pair to its
+        key partition, with the sequential path's value/ret rules."""
+        inv_row, f01, key, iv, itime = rec
+        ok = bool(ok)
+        val = cval[1] if ok and cval is not None else iv
+        part = self._parts.get(key)
+        if part is None:
+            part = self._parts[key] = _KeyPart()
+        part.add(f01, val, itime, float(ctime) if ok else INF, ok,
+                 inv_row)

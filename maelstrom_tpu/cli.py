@@ -100,6 +100,18 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--reply-log-cap", type=int,
                    help="Device reply ring: client replies buffered on "
                         "device per dispatch (default 256)")
+    t.add_argument("--check-workers", type=int,
+                   help="Overlapped analysis (TPU path only): one "
+                        "ordered background worker pairs, partitions, "
+                        "and screens drained history segments while "
+                        "the device runs the next stretch; values > 1 "
+                        "additionally fan the per-key linearizability "
+                        "screens over a thread pool at check time "
+                        "(default 1; 0 disables, same as --no-overlap)")
+    t.add_argument("--no-overlap", action="store_true",
+                   help="Disable the overlapped analysis pipeline and "
+                        "run all checking sequentially after the run "
+                        "(verdicts are bit-identical either way)")
     t.add_argument("--ms-per-round", type=float, default=1.0,
                    help="Virtual milliseconds per simulation round "
                         "(TPU path; coarser = faster, less latency "
@@ -196,10 +208,12 @@ def opts_from_args(args) -> dict:
         "ms_per_round": args.ms_per_round,
         "checkpoint_every": args.checkpoint_every,
         "resume": args.resume,
+        "no_overlap": args.no_overlap,
     }
     # TPU-path performance knobs: only forwarded when given, so the
     # runner's own defaults stay in one place
-    for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap"):
+    for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
+              "check_workers"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

@@ -43,6 +43,11 @@ DEFAULTS = dict(
     # TPU-path scale-out: "dp,sp" device-mesh spec (None = single chip);
     # recorded in the stored test map so a mesh run is reproducible
     mesh=None,
+    # overlapped analysis pipeline: background workers that pair,
+    # partition, and screen drained history segments while the device
+    # runs the next stretch (None = runner default of 1; --no-overlap
+    # or check_workers=0 force the sequential analysis path)
+    check_workers=None, no_overlap=False,
 )
 
 

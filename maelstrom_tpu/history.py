@@ -15,18 +15,32 @@ completion (`ok`, `fail`, or `info`):
 Checkers are pure functions of histories (reference test strategy,
 `test/maelstrom/workload/pn_counter_test.clj`), so Op is a plain dataclass
 that round-trips to JSON.
+
+Storage is columnar (struct-of-arrays): scalar fields live in numpy
+columns (type/f/process as small interned codes, time/index as int64,
+final as bool) with one object column each for values and errors. At
+production scale the analysis pipeline — partitioning, pairing,
+screening — runs as numpy group-bys over these columns instead of
+per-op Python interpretation; `Op` remains the lazy row view for
+existing callers, materialized on access and never stored.
 """
 
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterable, Optional
+
+import numpy as np
 
 INVOKE = "invoke"
 OK = "ok"
 FAIL = "fail"
 INFO = "info"
+
+# type codes are fixed (the four Jepsen op types); anything else interns
+# past them, so a malformed fixture degrades to a slow code, not a crash
+TYPE_CODES = {INVOKE: 0, OK: 1, FAIL: 2, INFO: 3}
 
 
 @dataclass
@@ -73,60 +87,259 @@ def op(type: str, f=None, value=None, **kw) -> Op:
     return Op(type=type, f=f, value=value, **kw)
 
 
+class _Interner:
+    """Bidirectional value<->small-int-code table for a column whose
+    domain is tiny (op types, :f names, process ids)."""
+
+    __slots__ = ("values", "codes")
+
+    def __init__(self, seed=()):
+        self.values: list = list(seed)
+        self.codes: dict = {v: i for i, v in enumerate(self.values)}
+
+    def code(self, v) -> int:
+        c = self.codes.get(v)
+        if c is None:
+            c = len(self.values)
+            self.codes[v] = c
+            self.values.append(v)
+        return c
+
+
+class Columns:
+    """The struct-of-arrays view of a history: trimmed (length-n) column
+    arrays plus the intern tables decoding the coded columns. Arrays are
+    live views into the history's buffers — append-only, so rows < n are
+    immutable and safe to read from analysis worker threads."""
+
+    __slots__ = ("n", "type", "f", "process", "time", "index", "final",
+                 "value", "error", "f_table", "process_table")
+
+    def __init__(self, n, type_, f, process, time, index, final, value,
+                 error, f_table, process_table):
+        self.n = n
+        self.type = type_
+        self.f = f
+        self.process = process
+        self.time = time
+        self.index = index
+        self.final = final
+        self.value = value
+        self.error = error
+        self.f_table = f_table
+        self.process_table = process_table
+
+
+def _obj_array(seq, m: int) -> np.ndarray:
+    out = np.empty(m, object)
+    out[:] = list(seq)
+    return out
+
+
 class History:
     """An indexed operation history with invoke/completion pairing
     (the analogue of knossos.history/pair-index used by the echo checker,
-    reference `workload/echo.clj:49-63`)."""
+    reference `workload/echo.clj:49-63`).
+
+    Backed by growable numpy columns; `history[i]` / iteration
+    materialize `Op` rows lazily. `append_row` is the no-Op-object hot
+    path used by the runners; `soa()` exposes the columns to the
+    vectorized checkers."""
+
+    _INIT_CAP = 1024
 
     def __init__(self, ops: Iterable[Op] = ()):
-        self.ops: list[Op] = []
+        self._n = 0
+        cap = self._INIT_CAP
+        self._type = np.zeros(cap, np.int8)
+        self._f = np.zeros(cap, np.int32)
+        self._process = np.zeros(cap, np.int32)
+        self._time = np.zeros(cap, np.int64)
+        self._index = np.zeros(cap, np.int64)
+        self._final = np.zeros(cap, bool)
+        self._value = np.empty(cap, object)
+        self._error = np.empty(cap, object)
+        self._types = _Interner((INVOKE, OK, FAIL, INFO))
+        self._fs = _Interner()
+        self._procs = _Interner()
         for o in ops:
             self.append(o)
 
+    # --- growth ---
+
+    def _grow(self):
+        cap = max(2 * len(self._type), self._INIT_CAP)
+        for name in ("_type", "_f", "_process", "_time", "_index",
+                     "_final", "_value", "_error"):
+            old = getattr(self, name)
+            new = (np.empty(cap, object) if old.dtype == object
+                   else np.zeros(cap, old.dtype))
+            new[:self._n] = old[:self._n]
+            setattr(self, name, new)
+
+    # --- append paths ---
+
+    def append_row(self, type: str, f=None, value=None, process=None,
+                   time: int = 0, error=None, final: bool = False,
+                   index: int = -1) -> int:
+        """Appends one operation without constructing an Op. Returns the
+        row index."""
+        i = self._n
+        if i >= len(self._type):
+            self._grow()
+        self._type[i] = self._types.code(type)
+        self._f[i] = self._fs.code(f)
+        self._process[i] = self._procs.code(process)
+        self._time[i] = time
+        self._index[i] = i if index < 0 else index
+        self._final[i] = final
+        self._value[i] = value
+        self._error[i] = error
+        self._n = i + 1
+        return i
+
     def append(self, o: Op) -> Op:
         if o.index < 0:
-            o.index = len(self.ops)
-        self.ops.append(o)
+            o.index = self._n
+        self.append_row(o.type, o.f, o.value, o.process, o.time,
+                        o.error, o.final, index=o.index)
         return o
 
+    def extend_columns(self, type, f, value, process, time,
+                       error=None, final=None):
+        """Segment-append: bulk-appends parallel sequences (one drained
+        ring's worth of decoded rows) without materializing per-op
+        objects. `type`/`f`/`process` are sequences of raw values
+        (interned here); `time` int64-coercible; `value`/`error` object
+        sequences; `final` bool array or None."""
+        m = len(time)
+        while self._n + m > len(self._type):
+            self._grow()
+        i = self._n
+        sl = slice(i, i + m)
+        self._type[sl] = np.fromiter((self._types.code(t) for t in type),
+                                     np.int8, m)
+        self._f[sl] = np.fromiter((self._fs.code(x) for x in f),
+                                  np.int32, m)
+        self._process[sl] = np.fromiter(
+            (self._procs.code(p) for p in process), np.int32, m)
+        self._time[sl] = np.asarray(time, np.int64)
+        self._index[sl] = np.arange(i, i + m, dtype=np.int64)
+        self._final[sl] = (False if final is None
+                           else np.asarray(final, bool))
+        # elementwise object assignment: np.asarray would collapse
+        # equal-length list values into a 2-D array
+        self._value[sl] = _obj_array(value, m)
+        self._error[sl] = (np.full(m, None, object) if error is None
+                           else _obj_array(error, m))
+        self._n = i + m
+
+    # --- columnar access ---
+
+    def soa(self) -> Columns:
+        n = self._n
+        return Columns(n, self._type[:n], self._f[:n], self._process[:n],
+                       self._time[:n], self._index[:n], self._final[:n],
+                       self._value[:n], self._error[:n],
+                       self._fs.values, self._procs.values)
+
+    # --- Op facade ---
+
+    def _materialize(self, i: int) -> Op:
+        return Op(type=self._types.values[self._type[i]],
+                  f=self._fs.values[self._f[i]],
+                  value=self._value[i],
+                  process=self._procs.values[self._process[i]],
+                  time=int(self._time[i]), index=int(self._index[i]),
+                  error=self._error[i], final=bool(self._final[i]))
+
+    @property
+    def ops(self) -> list:
+        return [self._materialize(i) for i in range(self._n)]
+
     def __iter__(self):
-        return iter(self.ops)
+        for i in range(self._n):
+            yield self._materialize(i)
 
     def __len__(self):
-        return len(self.ops)
+        return self._n
 
     def __getitem__(self, i):
-        return self.ops[i]
+        if isinstance(i, slice):
+            return [self._materialize(j)
+                    for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return self._materialize(i)
+
+    # --- pairing ---
+
+    def pairs_index(self) -> np.ndarray:
+        """Vectorized invoke/completion pairing: [n_invokes, 2] int64
+        rows of (invoke row, completion row or -1), in invoke order.
+
+        Within one process, ops alternate invoke/completion (a worker is
+        blocked until its op completes), so pairing reduces to adjacency
+        in per-process order: a stable sort by process groups each
+        process's rows in history order, and an invoke pairs with its
+        immediate successor iff that successor is a same-process
+        completion — exactly the open-slot scan the list form ran,
+        as numpy index arithmetic."""
+        n = self._n
+        if n == 0:
+            return np.empty((0, 2), np.int64)
+        t = self._type[:n]
+        order = np.argsort(self._process[:n], kind="stable")
+        ts = t[order]
+        is_inv = ts == TYPE_CODES[INVOKE]
+        procs = self._process[:n][order]
+        paired = np.zeros(n, bool)
+        paired[:-1] = (is_inv[:-1] & (ts[1:] != TYPE_CODES[INVOKE])
+                       & (procs[1:] == procs[:-1]))
+        comp = np.full(n, -1, np.int64)
+        good = np.flatnonzero(paired)
+        comp[good] = order[good + 1]
+        inv_rows = order[is_inv]
+        inv_comp = comp[is_inv]
+        by_invoke = np.argsort(inv_rows, kind="stable")
+        return np.stack([inv_rows[by_invoke], inv_comp[by_invoke]],
+                        axis=1)
 
     def pairs(self) -> list[tuple[Op, Optional[Op]]]:
         """Pairs each invoke with its completion (same process, next
         occurrence). Returns [(invoke, completion-or-None), ...]."""
-        out = []
-        open_by_process: dict[Any, int] = {}
-        for o in self.ops:
-            if o.type == INVOKE:
-                open_by_process[o.process] = len(out)
-                out.append((o, None))
-            elif o.process in open_by_process:
-                i = open_by_process.pop(o.process)
-                out[i] = (out[i][0], o)
-        return out
+        return [(self._materialize(i),
+                 None if j < 0 else self._materialize(j))
+                for i, j in self.pairs_index()]
+
+    # --- filtered views (materialize on demand) ---
+
+    def _where(self, mask) -> list[Op]:
+        return [self._materialize(i) for i in np.flatnonzero(mask)]
 
     def completions(self) -> list[Op]:
-        return [o for o in self.ops if o.type in (OK, FAIL, INFO)]
+        t = self._type[:self._n]
+        return self._where(t != TYPE_CODES[INVOKE])
 
     def oks(self) -> list[Op]:
-        return [o for o in self.ops if o.type == OK]
+        return self._where(self._type[:self._n] == TYPE_CODES[OK])
 
     def invokes(self) -> list[Op]:
-        return [o for o in self.ops if o.type == INVOKE]
+        return self._where(self._type[:self._n] == TYPE_CODES[INVOKE])
 
     def client_ops(self) -> list[Op]:
-        return [o for o in self.ops if o.process != "nemesis"]
+        nem = self._procs.codes.get("nemesis")
+        if nem is None:
+            return self.ops
+        return self._where(self._process[:self._n] != nem)
+
+    # --- (de)serialization ---
 
     def to_jsonl(self) -> str:
         return "\n".join(json.dumps(o.to_dict(), default=str)
-                         for o in self.ops)
+                         for o in self)
 
     @classmethod
     def from_jsonl(cls, text: str) -> "History":
@@ -134,7 +347,11 @@ class History:
         for line in text.splitlines():
             line = line.strip()
             if line:
-                h.append(Op.from_dict(json.loads(line)))
+                d = json.loads(line)
+                h.append_row(d["type"], d.get("f"), d.get("value"),
+                             d.get("process"), d.get("time", 0),
+                             d.get("error"), d.get("final", False),
+                             index=d.get("index", -1))
         return h
 
 

@@ -39,7 +39,7 @@ from .. import generators as g
 from .. import store
 from ..checkers import Checker
 from ..errors import ERROR_REGISTRY
-from ..history import History, Op
+from ..history import History
 from ..nemesis import NemesisDecisions
 from ..nemesis import grudge_matrix as _grudge_matrix
 from ..net import tpu as T
@@ -47,6 +47,20 @@ from ..nodes import HOST, EncodeCapacityError, Intern, get_program
 from ..sim import SimState, dealias, donation_enabled, make_sim
 
 log = logging.getLogger("maelstrom.tpu")
+
+
+def _wants_analysis(checker) -> bool:
+    """True when the test's checker tree contains a consumer of the
+    overlapped pipeline's partitions (`consumes_analysis`); other
+    workloads skip the background pairing/partitioning entirely."""
+    if checker is None:
+        return False
+    if getattr(checker, "consumes_analysis", False):
+        return True
+    subs = getattr(checker, "checkers", None)
+    if isinstance(subs, dict):
+        return any(_wants_analysis(c) for c in subs.values())
+    return False
 
 
 
@@ -301,6 +315,16 @@ class TpuRunner:
         # hot path (drains ~ dispatches, not ~ simulated rounds)
         from ..checkers.netstats import TransferStats
         self.transfer = TransferStats()
+        # overlapped analysis (--check-workers / --no-overlap): drained
+        # history segments stream to a background worker that pairs,
+        # partitions, and screens while the device runs the next
+        # stretch; the checkers then consume the prebuilt partitions.
+        # Purely an accelerator — never changes histories or verdicts.
+        self.no_overlap = bool(test.get("no_overlap"))
+        cw = test.get("check_workers")
+        self.check_workers = 1 if cw is None else int(cw)
+        self.pipeline = None
+        self._fed_upto = 0
         # --mesh dp,sp: shard the whole hot-loop state tree — node
         # state, flight pool, edge channels, inject buffers, reply/io
         # rings, nemesis masks (down/paused/block matrices), freeze
@@ -451,8 +475,7 @@ class TpuRunner:
         """Pulls one node's state row at the current round (cached per
         round)."""
         if self._state_cache is None:
-            self.transfer.record(self.sim.nodes)
-            self._state_cache = jax.device_get(self.sim.nodes)
+            self._state_cache = self.transfer.fetch(self.sim.nodes)
         # copy the row out: on CPU, device_get returns zero-copy views
         # into device buffers, and a donated dispatch may recycle those
         # buffers while a completion (or the history it built) still
@@ -461,17 +484,30 @@ class TpuRunner:
                             self._state_cache)
 
     def _complete(self, history, gen, ctx, process, completed, free):
-        o = Op(type=completed.get("type", "info"), f=completed.get("f"),
-               value=completed.get("value"), process=process,
-               time=ctx["time"], error=completed.get("error"),
-               final=completed.get("final", False))
-        history.append(o)
+        # columnar segment-append: completion rows go straight into the
+        # history's columns, no per-op Op materialization on the hot path
+        history.append_row(completed.get("type", "info"),
+                           completed.get("f"), completed.get("value"),
+                           process, ctx["time"], completed.get("error"),
+                           completed.get("final", False))
         free.add(process)
         return gen.update(ctx, completed)
 
 
     def _free_rotated(self, free, history):
         return g.rotate_free(free, self._dispatches)
+
+    def _overlap_feed(self, history):
+        """Hands newly-appended history rows to the background analysis
+        pipeline. Called right after a compiled dispatch is submitted
+        (XLA dispatch is async), so the analysis worker chews segment N
+        on the host while the device runs stretch N+1."""
+        if self.pipeline is None:
+            return
+        hi = len(history)
+        if hi > self._fed_upto:
+            self.pipeline.feed(history, self._fed_upto, hi)
+            self._fed_upto = hi
 
     @staticmethod
     def _make_packer(example):
@@ -592,10 +628,47 @@ class TpuRunner:
                     "history/results cover the whole run", r)
         next_ckpt = (r + self.checkpoint_every_rounds
                      if self.checkpoint_every_rounds else None)
+        if not self.no_overlap and self.check_workers > 0 \
+                and _wants_analysis(test.get("checker")):
+            from ..checkers.pipeline import AnalysisPipeline
+            self.pipeline = AnalysisPipeline(workers=self.check_workers)
+        self._fed_upto = 0
         # host mirror of the device message-id counter (refreshed by every
         # dispatch's combined fetch)
-        self.transfer.record(self.sim.net.next_mid)
-        self._next_mid = int(jax.device_get(self.sim.net.next_mid))
+        self._next_mid = int(self.transfer.fetch(self.sim.net.next_mid))
+        try:
+            r = self._run_loop(test, cfg, program, gen, nemesis,
+                               processes, free, pending, history,
+                               max_rounds, next_ckpt, r)
+        except BaseException:
+            # don't leak the analysis worker (and its history refs) on
+            # generator/client errors or KeyboardInterrupt
+            if self.pipeline is not None:
+                self.pipeline.close()
+            raise
+        if r >= max_rounds:
+            log.warning("TPU runner hit max_rounds=%d", max_rounds)
+        self.final_round = r
+        if self.pipeline is not None:
+            # overlapped_s counts only worker time that ran while the
+            # device was still computing; the tail segment (analyzed
+            # after the last dispatch, device idle) is excluded
+            overlapped = self.pipeline.busy_s
+            self._overlap_feed(history)
+            self.pipeline.finish()
+            self.transfer.overlapped_s += overlapped
+        log.info("TPU run finished at virtual round %d (%.1f virtual s), "
+                 "%d history ops, %d host drains (%d bytes, "
+                 "%.3fs blocked / %.3fs analysis overlapped)",
+                 r, r * self.ms_per_round / 1e3, len(history),
+                 self.transfer.drains, self.transfer.host_bytes,
+                 self.transfer.blocked_s, self.transfer.overlapped_s)
+        return history
+
+    def _run_loop(self, test, cfg, program, gen, nemesis, processes,
+                  free, pending, history, max_rounds, next_ckpt,
+                  r) -> int:
+        N, C = cfg.n_nodes, self.concurrency
         exhausted = False
         while r < max_rounds:
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
@@ -613,10 +686,9 @@ class TpuRunner:
                 self._dispatches += 1
                 free.discard(process)
                 op = {k: v for k, v in res.items() if k != "time"}
-                history.append(Op(type="invoke", f=op.get("f"),
-                                  value=op.get("value"), process=process,
-                                  time=ctx["time"],
-                                  final=op.get("final", False)))
+                history.append_row("invoke", op.get("f"), op.get("value"),
+                                   process, ctx["time"],
+                                   final=op.get("final", False))
                 if process == g.NEMESIS:
                     completed = nemesis.invoke(op)
                     # fault installs are eager host-side surgery on the
@@ -735,6 +807,9 @@ class TpuRunner:
                 self.sim, _cm, k, rl, buf = self._scan_journal_fn(
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
+                # stretch N+1 is in flight: overlap the host-side
+                # analysis of segment N with its device time
+                self._overlap_feed(history)
                 if self._pack_buf is None:
                     self._pack_buf = self._make_packer(
                         (buf, rl, k, self.sim.net.next_mid))
@@ -743,8 +818,7 @@ class TpuRunner:
                 # packed buffer (every separately fetched array is its own
                 # round trip on remote backends)
                 packed = pack((buf, rl, k, self.sim.net.next_mid))
-                self.transfer.record(packed)
-                flat = jax.device_get(packed)
+                flat = self.transfer.fetch(packed)
                 buf, (rlog, rounds, plog, rn), k, self._next_mid = \
                     unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
@@ -772,14 +846,16 @@ class TpuRunner:
                 self.sim, _cm, k, rl = self._scan_fn(
                     self.sim, inject, jnp.int32(k_max), stop)
                 self._state_cache = None
+                # stretch N+1 is in flight: overlap the host-side
+                # analysis of segment N with its device time
+                self._overlap_feed(history)
                 if self._pack_replies is None:
                     self._pack_replies = self._make_packer(
                         (rl, k, self.sim.net.next_mid))
                 pack, unpack = self._pack_replies
                 # ONE fetched array per dispatch (see journal branch)
                 packed = pack((rl, k, self.sim.net.next_mid))
-                self.transfer.record(packed)
-                flat = jax.device_get(packed)
+                flat = self.transfer.fetch(packed)
                 (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
                 k, self._next_mid = int(k), int(self._next_mid)
                 rn = int(rn)
@@ -835,14 +911,7 @@ class TpuRunner:
                 self._save_checkpoint(gen, history, pending, free, r)
                 next_ckpt = r + self.checkpoint_every_rounds
 
-        if r >= max_rounds:
-            log.warning("TPU runner hit max_rounds=%d", max_rounds)
-        self.final_round = r
-        log.info("TPU run finished at virtual round %d (%.1f virtual s), "
-                 "%d history ops, %d host drains (%d bytes)",
-                 r, r * self.ms_per_round / 1e3, len(history),
-                 self.transfer.drains, self.transfer.host_bytes)
-        return history
+        return r
 
     def _journal_round(self, io, client_msgs, r: int):
         """Materializes this round's device messages as journal rows
@@ -929,9 +998,7 @@ class TpuRunner:
                     q = q & prog_q(sim.nodes)
                 return q
             self._quiet_fn = jax.jit(quiet)
-        q = self._quiet_fn(self.sim)
-        self.transfer.record(q)
-        return bool(q)
+        return bool(self.transfer.fetch(self._quiet_fn(self.sim)))
 
 
 def run_tpu_test(test: dict, test_dir: str) -> dict:
@@ -951,7 +1018,13 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         cp.check_fingerprint(resume, test)
 
     history = runner.run(resume=resume)
+    if runner.pipeline is not None:
+        # checkers consume the incrementally-built partitions (register
+        # fast path); verdicts stay bit-identical to the sequential path
+        test["analysis"] = runner.pipeline
     results = test["checker"].check(test, history, {})
+    if runner.pipeline is not None:
+        results["analysis-pipeline"] = runner.pipeline.report()
     if resume is not None:
         results["resumed-at-round"] = resume["r"]
     if runner.journal is not None:

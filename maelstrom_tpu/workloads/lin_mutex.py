@@ -140,6 +140,9 @@ class LinMutexChecker(Checker):
     register-level WGL check of the same cas history."""
 
     name = "lin-mutex"
+    # delegates to LinearizableRegisterChecker, which consumes the
+    # overlapped pipeline's partitions when the runner provides them
+    consumes_analysis = True
 
     def check(self, test, history, opts=None):
         history = coerce_history(history)

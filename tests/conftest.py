@@ -41,13 +41,22 @@ def pytest_collection_modifyitems(config, items):
     import jax
     import pytest
     n = jax.device_count()
-    if n >= 2:
-        return
-    skip = pytest.mark.skip(
-        reason=f"multichip: needs >= 2 JAX devices, have {n}")
-    for item in items:
-        if "multichip" in item.keywords:
-            item.add_marker(skip)
+    if n < 2:
+        skip = pytest.mark.skip(
+            reason=f"multichip: needs >= 2 JAX devices, have {n}")
+        for item in items:
+            if "multichip" in item.keywords:
+                item.add_marker(skip)
+    # checker_bench: throughput micro-benches of the analysis pipeline.
+    # Auto-skipped in tier-1 (they measure, they don't verify — the
+    # equivalence suites own correctness); opt in explicitly, mirroring
+    # the multichip gate: MAELSTROM_CHECKER_BENCH=1 pytest -m checker_bench
+    if not os.environ.get("MAELSTROM_CHECKER_BENCH"):
+        skip_cb = pytest.mark.skip(
+            reason="checker_bench: set MAELSTROM_CHECKER_BENCH=1 to run")
+        for item in items:
+            if "checker_bench" in item.keywords:
+                item.add_marker(skip_cb)
 
 
 def ops_projection(history):

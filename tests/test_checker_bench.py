@@ -1,0 +1,44 @@
+"""Checker-throughput micro-benches (`checker_bench` marker).
+
+Auto-skipped in tier-1 (see conftest): these measure the analysis
+pipeline's register fast path and Elle edge build against their
+pure-Python baselines on shrunk synthetic histories, asserting the
+fast paths stay (a) correct and (b) actually faster. The full-size 1M
+numbers ride bench.py's BENCH json (`checker` section); run these with
+MAELSTROM_CHECKER_BENCH=1 pytest -m checker_bench."""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.checker_bench
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _record(n):
+    import bench
+    return bench.bench_checkers_record(n_rows=n, elle_ops=n)
+
+
+def test_register_fast_path_beats_baseline():
+    r = _record(120_000)["register"]
+    assert r["verdicts_match"] is True
+    assert r["valid"] is True
+    # 5x is the acceptance bar at 1M ops; at this shrunk size fixed
+    # overheads bite harder, so require a conservative 2x
+    assert r["speedup"] >= 2.0, r
+
+
+def test_elle_edge_build_matches_and_beats_baseline():
+    r = _record(120_000)["elle"]
+    assert r["match"] is True
+    assert r["speedup"] >= 1.0, r
+
+
+def test_full_record_shape():
+    r = _record(40_000)
+    assert r["valid"] is True
+    for section in ("register", "elle"):
+        assert r[section]["speedup"] > 0

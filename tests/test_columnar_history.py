@@ -1,0 +1,173 @@
+"""Equivalence suite: the columnar (struct-of-arrays) History vs the
+Op-list semantics it replaced. Every facade surface — iteration order,
+indexing, pairing, filtered views, JSONL round-trips, and the
+checkpoint-resume materialize/rebuild cycle — must behave exactly like
+a plain list of Ops."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from maelstrom_tpu.history import History, Op, coerce_history
+
+
+def reference_pairs(ops):
+    """The pre-columnar open-slot pairing scan (the semantics
+    pairs_index must reproduce)."""
+    out = []
+    open_by_process = {}
+    for o in ops:
+        if o.type == "invoke":
+            open_by_process[o.process] = len(out)
+            out.append((o, None))
+        elif o.process in open_by_process:
+            i = open_by_process.pop(o.process)
+            out[i] = (out[i][0], o)
+    return out
+
+
+def random_ops(seed, n=500, workers=8, stray=True):
+    rng = random.Random(seed)
+    ops = []
+    t = 0
+    openp = set()
+    for i in range(n):
+        t += rng.randrange(0, 3)
+        p = rng.randrange(workers) if rng.random() < 0.9 else "nemesis"
+        if p in openp and rng.random() < 0.65:
+            openp.discard(p)
+            ops.append(Op(type=rng.choice(["ok", "fail", "info"]),
+                          f=rng.choice(["read", "write", "txn", None]),
+                          value=rng.choice([None, [1, 2], "x", 7]),
+                          process=p, time=t,
+                          error=rng.choice([None, "net-timeout",
+                                            ["code", "text"]]),
+                          final=rng.random() < 0.05))
+        else:
+            openp.add(p)
+            ops.append(Op(type="invoke", f=rng.choice(["read", "write"]),
+                          value=[rng.randrange(3), rng.randrange(5)],
+                          process=p, time=t))
+    if stray:
+        # completions with no open invoke, processes never seen before
+        ops.append(Op(type="ok", f="read", value=None, process=777, time=t))
+    return ops
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_facade_matches_op_list(seed):
+    ops = random_ops(seed)
+    h = History(ops)
+    assert len(h) == len(ops)
+    # append assigned indices in order, mutating the originals like the
+    # list form did
+    assert [o.index for o in ops] == list(range(len(ops)))
+    assert [o.to_dict() for o in h] == [o.to_dict() for o in ops]
+    assert h[0].to_dict() == ops[0].to_dict()
+    assert h[-1].to_dict() == ops[-1].to_dict()
+    assert [o.to_dict() for o in h[3:10]] == \
+        [o.to_dict() for o in ops[3:10]]
+    assert [o.to_dict() for o in h.ops] == [o.to_dict() for o in ops]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_pairs_equivalence(seed):
+    ops = random_ops(seed, n=800, workers=5)
+    h = History(ops)
+    ref = reference_pairs(ops)
+    got = h.pairs()
+    assert len(ref) == len(got)
+    for (i1, c1), (i2, c2) in zip(ref, got):
+        assert i1.to_dict() == i2.to_dict()
+        assert (c1 is None) == (c2 is None)
+        if c1 is not None:
+            assert c1.to_dict() == c2.to_dict()
+
+
+def test_filtered_views():
+    ops = random_ops(3)
+    h = History(ops)
+    assert [o.to_dict() for o in h.invokes()] == \
+        [o.to_dict() for o in ops if o.type == "invoke"]
+    assert [o.to_dict() for o in h.oks()] == \
+        [o.to_dict() for o in ops if o.type == "ok"]
+    assert [o.to_dict() for o in h.completions()] == \
+        [o.to_dict() for o in ops if o.type in ("ok", "fail", "info")]
+    assert [o.to_dict() for o in h.client_ops()] == \
+        [o.to_dict() for o in ops if o.process != "nemesis"]
+
+
+def test_jsonl_round_trip():
+    ops = random_ops(4)
+    h = History(ops)
+    text = h.to_jsonl()
+    # line-per-op, dict-shaped exactly like Op.to_dict
+    lines = [json.loads(x) for x in text.splitlines()]
+    assert lines == [json.loads(json.dumps(o.to_dict(), default=str))
+                     for o in ops]
+    h2 = History.from_jsonl(text)
+    assert [o.to_dict() for o in h2] == [o.to_dict() for o in h]
+
+
+def test_checkpoint_materialize_rebuild_cycle():
+    """The checkpoint path saves list(history) and resumes with
+    History(list): the cycle must be lossless, and the rebuilt history
+    must keep appending with correct indices."""
+    ops = random_ops(5, n=300)
+    h = History(ops)
+    rebuilt = History(list(h))
+    assert [o.to_dict() for o in rebuilt] == [o.to_dict() for o in h]
+    nxt = rebuilt.append(Op(type="invoke", f="read", value=[0, None],
+                            process=1, time=999))
+    assert nxt.index == len(ops)
+    assert rebuilt[-1].index == len(ops)
+
+
+def test_extend_columns_matches_append():
+    rows = [("invoke", "read", [0, 1], 0, 10, None, False),
+            ("ok", "read", [0, 1], 0, 12, None, False),
+            ("invoke", "write", [1, 5], 1, 13, None, True),
+            ("info", "write", [1, 5], 1, 20, "net-timeout", False)]
+    h1 = History()
+    for t, f, v, p, tm, e, fin in rows:
+        h1.append(Op(type=t, f=f, value=v, process=p, time=tm, error=e,
+                     final=fin))
+    h2 = History()
+    h2.extend_columns([r[0] for r in rows], [r[1] for r in rows],
+                      [r[2] for r in rows], [r[3] for r in rows],
+                      [r[4] for r in rows], [r[5] for r in rows],
+                      np.asarray([r[6] for r in rows]))
+    assert [o.to_dict() for o in h1] == [o.to_dict() for o in h2]
+    # equal-length list values must stay per-row lists (the 2-D
+    # collapse hazard of np.asarray on object input)
+    assert h2[0].value == [0, 1] and h2[2].value == [1, 5]
+
+
+def test_soa_views_are_append_stable():
+    """Column views taken before later appends keep reading the rows
+    that existed when they were taken (the analysis pipeline reads
+    segment slices from a worker thread while the runner appends)."""
+    h = History()
+    for i in range(10):
+        h.append(Op(type="invoke", f="read", value=[i, i], process=0,
+                    time=i))
+    soa = h.soa()
+    times = soa.time.copy()
+    for i in range(5000):           # force several growth reallocations
+        h.append(Op(type="ok", f="read", value=[i, i], process=0,
+                    time=100 + i))
+    assert np.array_equal(soa.time, times)
+    assert h.soa().n == 5010
+
+
+def test_coerce_from_dicts_and_history_identity():
+    ops = [{"type": "invoke", "f": "read", "value": [0, None],
+            "process": 0, "time": 1},
+           {"type": "ok", "f": "read", "value": [0, None],
+            "process": 0, "time": 2}]
+    h = coerce_history(ops)
+    assert isinstance(h, History) and len(h) == 2
+    assert coerce_history(h) is h
+    assert h.pairs()[0][1].type == "ok"

@@ -12,6 +12,12 @@ counted); masked (lost/partitioned) messages never enter the ring."""
 
 from __future__ import annotations
 
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis; skip where it isn't baked in")
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,8 +25,6 @@ from hypothesis import given, settings, strategies as st
 
 from maelstrom_tpu.net import static as S
 from maelstrom_tpu.net.tpu import I32
-
-import pytest
 
 pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
 

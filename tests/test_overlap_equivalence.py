@@ -1,0 +1,293 @@
+"""The analysis pipeline's hard invariant: overlapped/columnar analysis
+produces bit-identical histories and checker verdicts to the sequential
+path for the same seed.
+
+Three layers:
+  - checker-level: the columnar fast path (partition + vectorized
+    screen + WGL fallback) vs the sequential pairs()+WGL baseline on
+    randomized register histories, full result-dict equality
+  - pipeline-level: incrementally-fed partitions vs one-shot columnar
+    partitioning, array-for-array
+  - end-to-end: same-seed runs with the pipeline on vs --no-overlap,
+    history files byte-identical, workload verdicts equal (lin-kv in
+    the fast tier; broadcast/raft/kafka fault soups in the slow tier)
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu.checkers.linearizable import (
+    INF, LinearizableRegisterChecker, check_history, check_register_history,
+    ops_from_arrays, partition_register, screen_register_arrays)
+from maelstrom_tpu.checkers.pipeline import AnalysisPipeline
+from maelstrom_tpu.history import History, Op
+
+STORE = "/tmp/maelstrom-tpu-test-store"
+
+
+def random_register_history(seed, n=500, keys=4, workers=6,
+                            info_rate=0.08, fail_rate=0.05,
+                            corrupt=0.0, sequential=False):
+    """Registers under a mix of outcomes; corrupt > 0 plants stale
+    reads; sequential=True keeps every key in the screen's decidable
+    class."""
+    rng = random.Random(seed)
+    h = History()
+    t = 0
+    state = {}
+    openp = {}
+    workers = 1 if sequential else workers
+    for i in range(n):
+        t += rng.randrange(1, 4)
+        p = rng.randrange(workers)
+        if p in openp:
+            f, k, v = openp.pop(p)
+            roll = rng.random()
+            if not sequential and roll < fail_rate:
+                h.append(Op(type="fail", f=f, value=[k, v], process=p,
+                            time=t, error=["abort", "definite"]))
+            elif not sequential and roll < fail_rate + info_rate:
+                h.append(Op(type="info", f=f, value=[k, v], process=p,
+                            time=t, error="net-timeout"))
+            else:
+                if f == "write":
+                    state[k] = v
+                val = state.get(k) if f == "read" else v
+                if corrupt and f == "read" and rng.random() < corrupt:
+                    val = 999
+                h.append(Op(type="ok", f=f, value=[k, val], process=p,
+                            time=t))
+        else:
+            f = rng.choice(["read", "write", "write", "read"]
+                           + ([] if sequential else ["cas"]))
+            k = rng.randrange(keys)
+            v = (rng.randrange(5) if f != "cas"
+                 else [rng.randrange(5), rng.randrange(5)])
+            h.append(Op(type="invoke", f=f, value=[k, v], process=p,
+                        time=t))
+            openp[p] = (f, k, v)
+    return h
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_checker_fast_path_matches_sequential(seed):
+    rng = random.Random(seed)
+    h = random_register_history(
+        seed, info_rate=rng.random() * 0.2, fail_rate=0.05,
+        corrupt=rng.choice([0.0, 0.0, 0.1]),
+        sequential=seed % 4 == 0)
+    c = LinearizableRegisterChecker()
+    assert c.check({}, h) == c.check({}, h, {"no_fast": True})
+
+
+def test_screen_is_sound_never_false():
+    """The screen may only ever answer True (definitely linearizable)
+    or None (undecided); an invalid partition must come back None so
+    WGL alone renders failures."""
+    for seed in range(20):
+        h = random_register_history(seed, corrupt=0.2, sequential=True)
+        for k, arrs in partition_register(h):
+            s = screen_register_arrays(arrs["f"], arrs["value"],
+                                       arrs["inv"], arrs["ret"],
+                                       arrs["ok"])
+            assert s in (True, None)
+            if s is True:
+                assert check_history(ops_from_arrays(arrs))["valid"] \
+                    is True
+
+
+def test_undecided_result_is_structured():
+    """The max_states guard reports a structured undecided result the
+    overlapped screen can defer on (not an exception, not a bare
+    string)."""
+    ops = [{"f": "write", "value": i % 3, "inv": i, "ret": INF,
+            "ok": False} for i in range(40)]
+    r = check_register_history(ops, max_states=10)
+    assert r["valid"] == "unknown"
+    assert r["undecided"] is True
+    assert r["reason"] == "max-states"
+    assert r["max-states"] == 10
+    assert r["op-count"] == 40
+    assert r["explored-configurations"] > 10
+
+
+def test_pipeline_partitions_match_columnar():
+    h = random_register_history(21, n=1200, keys=5, workers=7)
+    # a key whose every op definitely failed: it still counts toward
+    # key-count (the sequential by_key holds it with zero ops), so the
+    # pipeline must surface an empty partition for it
+    h.append(Op(type="invoke", f="write", value=["failk", 1], process=0,
+                time=10 ** 8))
+    h.append(Op(type="fail", f="write", value=["failk", 1], process=0,
+                time=10 ** 8 + 1, error=["abort", "definite"]))
+    p = AnalysisPipeline(workers=2)
+    step = 97                      # deliberately odd segment boundaries
+    for lo in range(0, len(h), step):
+        p.feed(h, lo, min(lo + step, len(h)))
+    p.finish()
+    got = p.register_partitions(len(h))
+    want = partition_register(h)
+    assert got is not None and len(got) == len(want)
+    for (k1, a1, screened), (k2, a2) in zip(got, want):
+        assert k1 == k2
+        for field in ("f", "inv", "ret", "ok"):
+            assert np.array_equal(a1[field], a2[field]), (k1, field)
+        assert list(a1["value"]) == list(a2["value"])
+        if screened is True:
+            # incremental screen short-circuits only truly-valid keys
+            assert check_history(ops_from_arrays(a2))["valid"] is True
+    # full checker through the pipeline == sequential baseline
+    res_pipe = LinearizableRegisterChecker().check({"analysis": p}, h)
+    res_seq = LinearizableRegisterChecker().check({}, h,
+                                                  {"no_fast": True})
+    assert res_pipe == res_seq
+
+
+def test_closed_pipeline_declines_service():
+    """close() (the runner's error-path cleanup) stops the worker and
+    the pipeline refuses to vouch for anything afterwards."""
+    h = random_register_history(6, n=200)
+    p = AnalysisPipeline()
+    p.feed(h, 0, len(h))
+    p.close()
+    p.close()                             # idempotent
+    assert p.register_partitions(len(h)) is None
+    assert not p._thread.is_alive()
+    c = LinearizableRegisterChecker()
+    assert c.check({"analysis": p}, h) == c.check({}, h,
+                                                  {"no_fast": True})
+
+
+def test_stale_pipeline_falls_back():
+    h = random_register_history(5, n=300)
+    p = AnalysisPipeline()
+    p.feed(h, 0, len(h))
+    p.finish()
+    h.append(Op(type="invoke", f="read", value=[0, None], process=0,
+                time=10 ** 9))
+    assert p.register_partitions(len(h)) is None
+    c = LinearizableRegisterChecker()
+    assert c.check({"analysis": p}, h) == c.check({}, h,
+                                                  {"no_fast": True})
+
+
+def random_append_history(seed, n_txn=150, keys=5, workers=6,
+                          corrupt=0.0, empty_reads=False):
+    rng = random.Random(seed)
+    h = History()
+    t = 0
+    lists = {k: [] for k in range(keys)}
+    nextv = [0]
+    openp = {}
+    for i in range(n_txn * 2):
+        t += rng.randrange(1, 3)
+        p = rng.randrange(workers)
+        if p in openp:
+            micro, kind = openp.pop(p)
+            if kind != "ok":
+                h.append(Op(type=kind, f="txn", value=micro, process=p,
+                            time=t))
+                continue
+            done = []
+            for f, k, v in micro:
+                if f == "append":
+                    lists[k].append(v)
+                    done.append([f, k, v])
+                else:
+                    obs = [] if empty_reads else list(lists[k])
+                    if corrupt and rng.random() < corrupt:
+                        obs = obs[:-1][::-1]
+                    done.append([f, k, obs])
+            h.append(Op(type="ok", f="txn", value=done, process=p,
+                        time=t))
+        else:
+            micro = []
+            for _ in range(rng.randrange(1, 4)):
+                k = rng.randrange(keys)
+                if not empty_reads and rng.random() < 0.5:
+                    nextv[0] += 1
+                    micro.append(["append", k, nextv[0]])
+                else:
+                    micro.append(["r", k, None])
+            kind = rng.choices(["ok", "fail", "info"],
+                               [0.85, 0.07, 0.08])[0]
+            h.append(Op(type="invoke", f="txn", value=micro, process=p,
+                        time=t))
+            openp[p] = (micro, kind)
+    return h
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_elle_vectorized_edges_match_python(seed):
+    from maelstrom_tpu.checkers.elle import (_edges_python, analyze)
+    rng = random.Random(seed)
+    h = random_append_history(seed, corrupt=rng.choice([0.0, 0.15]),
+                              empty_reads=seed == 3)
+    assert analyze(h) == analyze(h, edges_impl=_edges_python)
+
+
+def test_elle_reads_with_no_observed_versions():
+    """Regression: histories whose every read is empty build an empty
+    version table; the vectorized edge gather must not index it."""
+    from maelstrom_tpu.checkers.elle import (_edges_python, analyze)
+    h = random_append_history(9, empty_reads=True)
+    assert analyze(h) == analyze(h, edges_impl=_edges_python)
+
+
+# --- end to end: overlapped vs sequential runs, same seed ---
+
+def _run_pair(opts):
+    """Runs the same test twice — pipeline on vs --no-overlap — and
+    returns ((results, history_text) x 2)."""
+    out = []
+    for variant in ({"check_workers": 2}, {"no_overlap": True}):
+        root = os.path.join(STORE, f"overlap-{len(out)}")
+        res = core.run({**opts, **variant, "store_root": root})
+        with open(os.path.join(root, "latest", "history.jsonl")) as f:
+            out.append((res, f.read()))
+    return out
+
+
+def _comparable(res):
+    """Checker results minus wall-clock-dependent accounting."""
+    drop = {"host-blocked-s", "host-overlapped-s"}
+    return {name: ({k: v for k, v in r.items() if k not in drop}
+                   if isinstance(r, dict) else r)
+            for name, r in res.items()
+            if name not in ("analysis-pipeline",)}
+
+
+def test_overlap_run_bit_identical_lin_kv():
+    (r1, h1), (r2, h2) = _run_pair(dict(
+        seed=11, workload="lin-kv", node="tpu:lin-kv", node_count=5,
+        rate=20.0, time_limit=3.0, journal_rows=False,
+        nemesis={"partition"}, nemesis_interval=1.5))
+    assert h1 == h2                      # histories byte-identical
+    assert _comparable(r1) == _comparable(r2)
+    assert r1["valid"] is True
+    assert r1["analysis-pipeline"]["rows"] == len(h1.strip().splitlines())
+
+
+SOUPS = [
+    ("broadcast", "tpu:broadcast", {"topology": "grid"},
+     {"partition"}),
+    ("lin-kv", "tpu:lin-kv", {}, {"kill", "partition"}),
+    ("kafka", "tpu:kafka", {}, {"partition", "duplicate"}),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("workload,node,extra,nemesis", SOUPS,
+                         ids=[s[0] for s in SOUPS])
+def test_overlap_soups_bit_identical(workload, node, extra, nemesis):
+    (r1, h1), (r2, h2) = _run_pair(dict(
+        seed=29, workload=workload, node=node, node_count=5,
+        rate=15.0, time_limit=4.0, journal_rows=False,
+        latency={"mean": 3, "dist": "exponential"}, p_loss=0.02,
+        nemesis=nemesis, nemesis_interval=2.0, **extra))
+    assert h1 == h2
+    assert _comparable(r1) == _comparable(r2)

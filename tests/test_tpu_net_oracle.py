@@ -15,14 +15,18 @@ from __future__ import annotations
 
 from collections import defaultdict
 
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis; skip where it isn't baked in")
+
 import jax
 import numpy as np
 from hypothesis import given, settings, strategies as st
 
 from maelstrom_tpu.net import tpu as T
 from test_tpu_net import mk
-
-import pytest
 
 pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
 
