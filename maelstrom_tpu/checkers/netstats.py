@@ -35,6 +35,15 @@ class TransferStats:
     host_bytes: int = 0
     blocked_s: float = 0.0
     overlapped_s: float = 0.0
+    # checkpoint accounting (doc/checkpoint.md): `ckpt_blocked_s` is
+    # main-thread time per save — the sim device pull plus the snapshot
+    # of the mutable host state; `ckpt_write_s` is background-writer
+    # wall time (pickle + fsync + rename) that overlapped with device
+    # compute. Async checkpointing is healthy when write_s dwarfs
+    # blocked_s; --sync-checkpoint folds everything into blocked_s.
+    ckpt_saves: int = 0
+    ckpt_blocked_s: float = 0.0
+    ckpt_write_s: float = 0.0
 
     def record(self, tree) -> None:
         """Count one drain of `tree` (any pytree of device/numpy arrays),
@@ -57,9 +66,14 @@ class TransferStats:
         return out
 
     def as_dict(self) -> dict:
-        return {"drains": self.drains, "host-bytes": self.host_bytes,
-                "host-blocked-s": round(self.blocked_s, 6),
-                "host-overlapped-s": round(self.overlapped_s, 6)}
+        out = {"drains": self.drains, "host-bytes": self.host_bytes,
+               "host-blocked-s": round(self.blocked_s, 6),
+               "host-overlapped-s": round(self.overlapped_s, 6)}
+        if self.ckpt_saves:
+            out["ckpt-saves"] = self.ckpt_saves
+            out["ckpt-blocked-s"] = round(self.ckpt_blocked_s, 6)
+            out["ckpt-write-s"] = round(self.ckpt_write_s, 6)
+        return out
 
 
 class NetStatsChecker(Checker):

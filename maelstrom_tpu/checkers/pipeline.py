@@ -113,6 +113,7 @@ class AnalysisPipeline:
         self._open: dict = {}       # process code -> invoke record
         self._parts: dict = {}      # key -> _KeyPart
         self._stats = {"ok": 0, "fail": 0, "info": 0}
+        self.resumed_rows = 0       # rows seeded from a resume checkpoint
         self._finished = False
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
@@ -124,6 +125,18 @@ class AnalysisPipeline:
     def feed(self, history, lo: int, hi: int):
         if hi > lo and not self._finished:
             self._q.put((history, lo, hi))
+
+    def seed_resumed(self, history, n: int):
+        """Feeds a resumed run's pre-existing rows [0, n) as segment 0,
+        so the pipeline's pairing/partition state covers the whole
+        stitched history. Without this a resumed run fails the
+        check-time row-count match (`register_partitions`) and silently
+        loses the overlap fast path; with it, resumed verdicts stay
+        bit-identical AND fast (pinned by
+        tests/test_checkpoint_resilience.py::
+        test_resume_keeps_pipeline_overlap)."""
+        self.resumed_rows = n
+        self.feed(history, 0, n)
 
     def close(self):
         """Error-path shutdown: stops the worker without finalizing
@@ -188,6 +201,8 @@ class AnalysisPipeline:
                "register-keys": len(self._parts),
                "screened-clean-keys": screened,
                "completions": dict(self._stats)}
+        if self.resumed_rows:
+            out["resumed-rows"] = self.resumed_rows
         if self.error:
             out["error"] = self.error
         return out

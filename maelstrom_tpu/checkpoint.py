@@ -9,31 +9,112 @@ bookkeeping — so a checkpoint is one atomic file, and a resumed run
 continues *deterministically*: it produces byte-identical histories to an
 uninterrupted run with the same options.
 
-Layout: `store/<test>/<time>/checkpoint.pkl`, rewritten atomically
-(tmp + rename) every `--checkpoint-every` virtual seconds. Resume with
+Layout: `store/<test>/<time>/checkpoint.pkl`, rewritten every
+`--checkpoint-every` virtual seconds. Resume with
 `maelstrom_tpu test ... --resume <that dir>` (same workload options).
+
+Durability (doc/checkpoint.md): the file is a framed container —
+magic, format version, payload length, SHA-256 digest, pickle payload —
+written tmp-first with an fsync of both the tmp file and its directory
+around the atomic rename, and the previous good checkpoint is kept as
+`checkpoint.prev.pkl` so a write torn by SIGKILL/power loss can never
+cost more than one checkpoint interval. `load` verifies the frame
+(magic/version/length/digest) and falls back to the previous checkpoint
+when the newest one is torn.
+
+Writes happen on a background writer thread by default
+(`CheckpointWriter`, at most one write in flight) so the device keeps
+dispatching while the previous snapshot lands; `--sync-checkpoint`
+forces the old synchronous behavior. On SIGTERM/SIGINT the runner
+finishes the in-flight compiled stretch, writes a final checkpoint, and
+exits with `EXIT_PREEMPTED` so a supervisor can relaunch with
+`--resume` (see run_crash_soak.sh).
 """
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
 import pickle
+import struct
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
 
+log = logging.getLogger("maelstrom.checkpoint")
+
 CHECKPOINT_FILE = "checkpoint.pkl"
+PREV_CHECKPOINT_FILE = "checkpoint.prev.pkl"
+
+# Framed container: magic + version + payload length + SHA-256(payload),
+# then the pickle payload. The frame is what makes torn/truncated writes
+# *detectable* (and old raw-pickle checkpoints cleanly rejectable).
+MAGIC = b"MAELCKPT"
+VERSION = 2
+_HEADER = struct.Struct("<8sIQ32s")     # magic, version, payload len, digest
+
+# The exit code of a run that was preempted (SIGTERM/SIGINT) and wrote a
+# final checkpoint: distinct from success (0), invalid analysis (1), and
+# errors (2), so an outer supervisor knows to relaunch with --resume.
+# 75 is sysexits' EX_TEMPFAIL ("temporary failure, retry later").
+EXIT_PREEMPTED = 75
 
 # Options that must match between the checkpointing run and the resuming
-# run: they shape the compiled round function, the generator tree, the
-# simulated cluster, or the runner's dispatch cadence (anything that can
+# run: they shape the compiled round function, the simulated state tree,
+# the generator tree, or the runner's dispatch routing (anything that can
 # change the op stream or the PRNG consumption order).
+#
+#   - mesh: sharded runs are bit-identical to single-chip, but the saved
+#     sim tree is re-placed via TpuRunner._reshard on resume; requiring
+#     the same mesh keeps the donation/sharding invariants trivially true
+#     (and a cross-mesh resume is a deliberate, reviewable change).
+#   - journal_rows/collect_replies: shape the sim tree (edge send-round
+#     tracking) and the dispatch/read_state cadence respectively.
+#   - journal_scan_cap/reply_log_cap: size the device-resident io/reply
+#     rings the scans are compiled against.
+#
+# Deliberately NOT fingerprinted:
+#   - check_workers/no_overlap/sync_checkpoint/on_preempt: analysis- and
+#     durability-side only; they never touch the op stream (pinned by
+#     test_checkpoint_resilience.py::test_fingerprint_excludes_analysis_flags).
+#   - checkpoint_every: the cadence bounds compiled stretches, but
+#     stretch-boundary placement is observationally neutral — generator
+#     polls at non-interesting times are side-effect-free and timeouts
+#     fire at their deadline rounds either way (pinned by
+#     test_checkpoint_resume_identical_history, which compares a
+#     checkpointed run against an un-checkpointed baseline).
 FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     "concurrency", "latency", "nemesis", "nemesis_interval",
                     "topology", "seed", "key_count", "max_txn_length",
                     "max_writes_per_key", "min_txn_length", "ops_per_key",
                     "p_loss", "timeout_ms", "ms_per_round", "recovery_s",
-                    "journal_rows", "max_scan", "pool_cap", "gossip_fanout")
+                    "journal_rows", "max_scan", "pool_cap", "gossip_fanout",
+                    "mesh", "journal_scan_cap", "reply_log_cap",
+                    "collect_replies")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written or loaded (torn/truncated file,
+    unknown or pre-versioning format, digest mismatch, writer failure)."""
+
+
+class Preempted(RuntimeError):
+    """The run was interrupted (SIGTERM/SIGINT) and exited through the
+    graceful-preemption path. `checkpoint_dir` names the directory
+    holding the final checkpoint (None when the run had no store dir to
+    save into); relaunch with `--resume <checkpoint_dir>`."""
+
+    def __init__(self, round_: int, checkpoint_dir: str | None):
+        self.round = round_
+        self.checkpoint_dir = checkpoint_dir
+        where = (f"final checkpoint in {checkpoint_dir!r}"
+                 if checkpoint_dir else "no store dir, nothing saved")
+        super().__init__(
+            f"preempted at virtual round {round_} ({where}); "
+            f"relaunch with --resume to continue")
 
 
 def fingerprint(test: dict) -> dict:
@@ -41,28 +122,123 @@ def fingerprint(test: dict) -> dict:
             for k, v in ((k, test.get(k)) for k in FINGERPRINT_KEYS)}
 
 
+def _encode(state: dict) -> bytes:
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HEADER.pack(MAGIC, VERSION, len(payload),
+                        hashlib.sha256(payload).digest()) + payload
+
+
+def _decode(blob: bytes, path: str) -> dict:
+    if blob[:1] == b"\x80" and blob[:len(MAGIC)] != MAGIC:
+        # a bare pickle protocol marker: the pre-versioning format
+        raise CheckpointError(
+            f"{path!r}: pre-versioning raw-pickle checkpoint (format "
+            f"v1); this build reads v{VERSION} — re-create it with a "
+            f"current run")
+    if len(blob) < _HEADER.size:
+        raise CheckpointError(
+            f"{path!r}: truncated checkpoint ({len(blob)} bytes is "
+            f"smaller than the {_HEADER.size}-byte v{VERSION} header)")
+    magic, version, n, digest = _HEADER.unpack_from(blob)
+    if magic != MAGIC:
+        raise CheckpointError(
+            f"{path!r}: not a maelstrom checkpoint (bad magic)")
+    if version != VERSION:
+        raise CheckpointError(
+            f"{path!r}: checkpoint format v{version} is not supported "
+            f"by this build (expected v{VERSION})")
+    payload = blob[_HEADER.size:]
+    if len(payload) != n:
+        raise CheckpointError(
+            f"{path!r}: truncated checkpoint (header promises {n} "
+            f"payload bytes, file holds {len(payload)})")
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointError(
+            f"{path!r}: corrupt checkpoint (payload digest mismatch — "
+            f"torn write?)")
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        raise CheckpointError(
+            f"{path!r}: checkpoint payload failed to unpickle "
+            f"({e!r})") from e
+
+
+def _fsync_dir(dir_path: str):
+    fd = os.open(dir_path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save(dir_path: str, state: dict) -> str:
-    """Atomically writes a checkpoint into `dir_path`. Device arrays are
-    pulled to host numpy first (one transfer for the whole pytree)."""
+    """Durably writes a checkpoint into `dir_path`: tmp file + fsync +
+    atomic rename + directory fsync, keeping the previous checkpoint as
+    `checkpoint.prev.pkl` (the fallback if this write is torn). Device
+    arrays in `state["sim"]` are pulled to host numpy first (a no-op
+    when the caller already did — the async writer path must, so the
+    device pull never happens off the main thread)."""
     os.makedirs(dir_path, exist_ok=True)
     path = os.path.join(dir_path, CHECKPOINT_FILE)
+    prev = os.path.join(dir_path, PREV_CHECKPOINT_FILE)
     tmp = path + ".tmp"
-    state = dict(state, sim=jax.device_get(state["sim"]))
-    with open(tmp, "wb") as f:
-        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    if "sim" in state:
+        state = dict(state, sim=jax.device_get(state["sim"]))
+    blob = _encode(state)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(path):
+            os.replace(path, prev)      # keep the last good checkpoint
+        os.replace(tmp, path)
+        _fsync_dir(dir_path)
+    finally:
+        # never leave a stale .tmp behind on a failed/interrupted write
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:                 # pragma: no cover - best effort
+            pass
     return path
 
 
-def load(dir_path: str) -> dict:
-    """Loads a checkpoint; `sim` leaves come back as device arrays."""
+def _load_state(dir_path: str) -> dict:
     path = os.path.join(dir_path, CHECKPOINT_FILE)
-    if not os.path.exists(path):
+    prev = os.path.join(dir_path, PREV_CHECKPOINT_FILE)
+    if not os.path.exists(path) and not os.path.exists(prev):
         raise FileNotFoundError(
             f"no {CHECKPOINT_FILE} in {dir_path!r} - was the original run "
             "started with --checkpoint-every?")
-    with open(path, "rb") as f:
-        state = pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            return _decode(f.read(), path)
+    except (CheckpointError, OSError) as e:
+        if not os.path.exists(prev):
+            raise
+        log.warning("newest checkpoint unusable (%s); falling back to "
+                    "the previous one (%s)", e, prev)
+        with open(prev, "rb") as f:
+            return _decode(f.read(), prev)
+
+
+def load(dir_path: str) -> dict:
+    """Loads (and integrity-checks) a checkpoint; `sim` leaves come back
+    as device arrays, `history` as a rebuilt History. Falls back to the
+    previous checkpoint when the newest write is torn."""
+    state = _load_state(dir_path)
+    # the writer stores the mutable host-side run state (generator tree,
+    # pending RPCs, intern tables, nemesis rng) as one blob pickled on
+    # the main thread at snapshot time; flatten it back out
+    meta = state.pop("meta_blob", None)
+    if meta is not None:
+        state.update(pickle.loads(meta))
+    cols = state.pop("history_columns", None)
+    if cols is not None:
+        from .history import History
+        state["history"] = History.from_columns(cols)
     state["sim"] = jax.tree.map(jnp.asarray, state["sim"])
     return state
 
@@ -75,3 +251,51 @@ def check_fingerprint(ckpt: dict, test: dict):
         raise ValueError(
             "resume options differ from the checkpointed run "
             f"(checkpointed vs given): {diffs}")
+
+
+class CheckpointWriter:
+    """Background checkpoint writer with AT MOST ONE write in flight:
+    `submit` hands the pickle+fsync+rename of a fully host-materialized
+    state to a daemon thread and returns immediately, so the device
+    keeps dispatching while the snapshot lands. A second submit (or
+    `wait`) first joins the in-flight write — the invariant is asserted,
+    not hoped for — and re-raises any writer failure as a
+    CheckpointError on the main thread."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._exc: BaseException | None = None
+        self.writes = 0
+        self.write_s = 0.0          # cumulative background write wall time
+
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def submit(self, dir_path: str, state: dict):
+        self.wait()                 # enforce the one-in-flight invariant
+        assert self._thread is None, "checkpoint writer already in flight"
+
+        def _write():
+            t0 = time.perf_counter()
+            try:
+                save(dir_path, state)
+            except BaseException as e:      # surfaced by the next wait()
+                self._exc = e
+            finally:
+                self.writes += 1
+                self.write_s += time.perf_counter() - t0
+
+        t = threading.Thread(target=_write, name="maelstrom-ckpt-writer",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    def wait(self):
+        """Joins the in-flight write (if any); raises if it failed."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._exc is not None:
+            e, self._exc = self._exc, None
+            raise CheckpointError(
+                f"background checkpoint write failed: {e!r}") from e

@@ -118,10 +118,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "resolution)")
     t.add_argument("--checkpoint-every", type=float,
                    help="Checkpoint the run every N virtual seconds "
-                        "(TPU path only)")
+                        "(TPU path only; crash-consistent and written "
+                        "by a background thread — see doc/checkpoint.md)")
     t.add_argument("--resume",
                    help="Resume from the checkpoint in this store test dir "
                         "(TPU path only; same options as the original run)")
+    t.add_argument("--sync-checkpoint", action="store_true",
+                   help="Write checkpoints synchronously on the main "
+                        "thread instead of the background writer "
+                        "(escape hatch; saves then block dispatching)")
+    t.add_argument("--on-preempt", choices=["checkpoint", "abort"],
+                   default="checkpoint",
+                   help="What SIGTERM/SIGINT does to a TPU-path run: "
+                        "'checkpoint' (default) finishes the in-flight "
+                        "compiled stretch, writes a final checkpoint, "
+                        "and exits code 75 so a supervisor can relaunch "
+                        "with --resume; 'abort' dies immediately")
 
     s = sub.add_parser("serve", help="Serve the store directory")
     s.add_argument("--port", type=int, default=8080)
@@ -208,6 +220,8 @@ def opts_from_args(args) -> dict:
         "ms_per_round": args.ms_per_round,
         "checkpoint_every": args.checkpoint_every,
         "resume": args.resume,
+        "sync_checkpoint": args.sync_checkpoint,
+        "on_preempt": args.on_preempt,
         "no_overlap": args.no_overlap,
     }
     # TPU-path performance knobs: only forwarded when given, so the
@@ -279,9 +293,15 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.cmd == "test":
+        from . import checkpoint as cp
         from . import core
         try:
             results = core.run(opts_from_args(args))
+        except cp.Preempted as e:
+            # graceful preemption: distinct exit code so a supervisor
+            # (run_crash_soak.sh) relaunches with --resume
+            print(f"\npreempted: {e}", file=sys.stderr)
+            return cp.EXIT_PREEMPTED
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2

@@ -48,6 +48,13 @@ DEFAULTS = dict(
     # runs the next stretch (None = runner default of 1; --no-overlap
     # or check_workers=0 force the sequential analysis path)
     check_workers=None, no_overlap=False,
+    # preemption-tolerant execution (doc/checkpoint.md): periodic
+    # crash-consistent checkpoints off the critical path (background
+    # writer unless sync_checkpoint), and SIGTERM/SIGINT graceful
+    # shutdown (on_preempt="checkpoint" writes a final checkpoint and
+    # exits EXIT_PREEMPTED for a supervised --resume relaunch)
+    checkpoint_every=None, resume=None, sync_checkpoint=False,
+    on_preempt="checkpoint",
 )
 
 

@@ -1,0 +1,311 @@
+"""Kill/resume soak harness: proves byte-identical crash recovery.
+
+Runs a TPU-path test as a subprocess, SIGKILLs it at randomized moments
+(always after at least one checkpoint has landed, so every cycle
+exercises a real resume), relaunches it with `--resume` from the newest
+durable checkpoint, and — once a launch finally runs to completion —
+asserts that the stitched history and the checker verdicts are
+**bit-identical** to an uninterrupted run with the same seed and
+options. This is the executable form of doc/checkpoint.md's recovery
+guarantee, and the companion of `run_crash_soak.sh` (the supervisor
+relaunch recipe for graceful SIGTERM preemption).
+
+Usage (also wrapped by the `soak`-marked tests in
+tests/test_crash_soak.py, opt-in via MAELSTROM_SOAK=1):
+
+    python -m maelstrom_tpu.crash_soak --kills 5 --seed 3
+    python -m maelstrom_tpu.crash_soak --kills 5 --mesh 1,2   # sharded
+
+SIGKILL (not SIGTERM) on purpose: the graceful path gets its own
+coverage; the soak proves recovery with *no* cooperation from the
+victim — the same discipline Jepsen applies to the systems under test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .checkpoint import (CHECKPOINT_FILE, EXIT_PREEMPTED,
+                         PREV_CHECKPOINT_FILE)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Result blocks that legitimately differ between an interrupted and an
+# uninterrupted run: host-transfer/checkpoint counters (drains and saves
+# restart per launch), pipeline segmentation, and the resume marker.
+# Everything else — workload verdicts, stats, perf (virtual-time
+# latencies), validity — must match exactly.
+VOLATILE_RESULT_KEYS = ("net", "analysis-pipeline", "resumed-at-round")
+
+# A small but honest default config: raft-backed lin-kv (durable store,
+# so the kill nemesis is recoverable), the full combined fault soup, and
+# a checkpoint cadence short enough that every kill lands mid-stretch.
+DEFAULT_OPTS = {
+    "-w": "lin-kv", "--node": "tpu:lin-kv", "--node-count": "5",
+    "--rate": "15", "--time-limit": "10", "--seed": "3",
+    "--nemesis": "kill,pause,partition,duplicate",
+    "--nemesis-interval": "2",
+    "--checkpoint-every": "0.25",
+}
+
+
+def child_env(mesh_devices: int | None = None) -> dict:
+    """The subprocess environment: CPU backend, the repo's shared
+    persistent compile cache, and (for --mesh runs) enough virtual CPU
+    devices to place the requested mesh."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, "artifacts", "xla-cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    if mesh_devices:
+        from .util import xla_device_count_flags
+        env["XLA_FLAGS"] = xla_device_count_flags(
+            env.get("XLA_FLAGS", ""), mesh_devices)
+    return env
+
+
+def argv_for(store_root: str, opts: dict, resume: str | None = None):
+    argv = [sys.executable, "-m", "maelstrom_tpu", "test",
+            "--store", store_root]
+    for k, v in opts.items():
+        if v is True:
+            argv.append(k)
+        elif v is not None:
+            argv += [k, str(v)]
+    if resume:
+        argv += ["--resume", resume]
+    return argv
+
+
+def run_dirs(store_root: str, name: str) -> list[str]:
+    """Timestamped run dirs under store_root/<name>/, oldest first."""
+    out = [d for d in glob.glob(os.path.join(store_root, name, "*"))
+           if os.path.isdir(d) and not os.path.islink(d)]
+    return sorted(out)
+
+
+def _has_checkpoint(d: str) -> bool:
+    """True when `cp.load` could resume from this run dir — including
+    the prev-only state a SIGKILL between save's two renames leaves
+    behind (checkpoint.prev.pkl without checkpoint.pkl)."""
+    return (os.path.exists(os.path.join(d, CHECKPOINT_FILE))
+            or os.path.exists(os.path.join(d, PREV_CHECKPOINT_FILE)))
+
+
+def _mesh_devices(opts: dict) -> int | None:
+    spec = opts.get("--mesh")
+    if not spec:
+        return None
+    dp, sp = (int(x) for x in str(spec).split(","))
+    return dp * sp
+
+
+def run_once(store_root: str, opts: dict, log_path: str,
+             timeout_s: float = 600.0) -> str:
+    """One uninterrupted run to completion; returns its store dir."""
+    with open(log_path, "ab") as lf:
+        rc = subprocess.call(argv_for(store_root, opts),
+                             env=child_env(_mesh_devices(opts)),
+                             stdout=lf, stderr=subprocess.STDOUT,
+                             timeout=timeout_s)
+    if rc != 0:
+        raise RuntimeError(
+            f"baseline run failed rc={rc}; see {log_path}")
+    dirs = run_dirs(store_root, opts["-w"])
+    return dirs[-1]
+
+
+def run_with_kills(store_root: str, opts: dict, kills: int, rng,
+                   kill_jitter_s: float = 0.75,
+                   launch_timeout_s: float = 600.0,
+                   log=lambda m: print(m, file=sys.stderr)) -> dict:
+    """Launch/SIGKILL/resume loop: SIGKILLs the first `kills` launches
+    at a randomized moment after their first checkpoint lands, then
+    lets the final launch run to completion. Returns the completed
+    run's store dir plus the kill log."""
+    name = opts["-w"]
+    known: set = set(run_dirs(store_root, name))
+    resume_dir = None
+    kill_log: list = []
+    launches = 0
+    missed = 0
+    log_path = os.path.join(store_root, "soak-children.log")
+    os.makedirs(store_root, exist_ok=True)
+    while True:
+        argv = argv_for(store_root, opts, resume=resume_dir)
+        launches += 1
+        with open(log_path, "ab") as lf:
+            lf.write(f"\n=== launch {launches} (resume={resume_dir}) "
+                     f"===\n".encode())
+            lf.flush()
+            proc = subprocess.Popen(argv, env=child_env(_mesh_devices(opts)),
+                                    stdout=lf, stderr=subprocess.STDOUT)
+            my_dir = None
+            if len(kill_log) < kills:
+                # wait for this launch's run dir, then for its first
+                # checkpoint, then kill at a random moment (possibly
+                # mid-write: durability must absorb that too)
+                deadline = time.time() + launch_timeout_s
+                ckpt = None
+                while proc.poll() is None and time.time() < deadline:
+                    if my_dir is None:
+                        fresh = [d for d in run_dirs(store_root, name)
+                                 if d not in known]
+                        if fresh:
+                            my_dir = fresh[-1]
+                            ckpt = os.path.join(my_dir, CHECKPOINT_FILE)
+                    elif os.path.exists(ckpt):
+                        break
+                    time.sleep(0.02)
+                if proc.poll() is None and ckpt and os.path.exists(ckpt):
+                    delay = rng.uniform(0, kill_jitter_s)
+                    time.sleep(delay)
+                    # freeze before the coup de grâce: a warm-cache
+                    # child could otherwise outrun the kill, complete,
+                    # and short the kill quota
+                    try:
+                        proc.send_signal(signal.SIGSTOP)
+                    except ProcessLookupError:  # pragma: no cover
+                        pass
+                    if proc.poll() is None:
+                        proc.send_signal(signal.SIGKILL)
+                        proc.wait()
+                        kill_log.append({"launch": launches,
+                                         "dir": my_dir,
+                                         "delay_s": round(delay, 3)})
+                        log(f"  SIGKILL #{len(kill_log)} "
+                            f"(launch {launches}, +{delay:.2f}s)")
+            try:
+                rc = proc.wait(timeout=launch_timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise RuntimeError(
+                    f"soak launch {launches} hung; see {log_path}")
+        known.update(run_dirs(store_root, name))
+        if rc == 0:
+            if len(kill_log) < kills:
+                # the child completed before this cycle's kill landed
+                # (it finished during the jitter sleep). Determinism
+                # makes a redo equivalent: relaunch the SAME cycle —
+                # from the escaped launch's own resume point, NOT the
+                # completed run's final checkpoint (a resume one
+                # cadence from the end can never be killed again) —
+                # and draw a fresh kill delay.
+                missed += 1
+                if missed > 3:
+                    raise RuntimeError(
+                        f"could not land {kills} kills in "
+                        f"{launches} launches ({len(kill_log)} landed); "
+                        f"grow --time-limit or shrink kill_jitter_s")
+                log(f"  launch {launches} completed before kill "
+                    f"#{len(kill_log) + 1}; redoing the cycle")
+                continue
+            else:
+                final = run_dirs(store_root, name)[-1]
+                return {"dir": final, "launches": launches,
+                        "kills": kill_log, "log": log_path}
+        elif rc not in (-signal.SIGKILL, EXIT_PREEMPTED):
+            raise RuntimeError(
+                f"soak launch {launches} exited rc={rc} (expected kill "
+                f"or preempt); see {log_path}")
+        # resume from the newest run dir that owns a loadable
+        # checkpoint — checkpoint.pkl or the prev-only state a kill
+        # mid-save leaves (a launch killed before its first save
+        # contributes nothing; the previous checkpoint still owns the
+        # most progress)
+        with_ckpt = [d for d in sorted(known, reverse=True)
+                     if _has_checkpoint(d)]
+        resume_dir = with_ckpt[0] if with_ckpt else None
+
+
+def _strip_volatile(results: dict) -> dict:
+    return {k: v for k, v in results.items()
+            if k not in VOLATILE_RESULT_KEYS}
+
+
+def compare_runs(dir_a: str, dir_b: str) -> dict:
+    """Bit-identity verdict between two completed runs' artifacts."""
+    with open(os.path.join(dir_a, "history.jsonl"), "rb") as f:
+        ha = f.read()
+    with open(os.path.join(dir_b, "history.jsonl"), "rb") as f:
+        hb = f.read()
+    with open(os.path.join(dir_a, "results.json")) as f:
+        ra = json.load(f)
+    with open(os.path.join(dir_b, "results.json")) as f:
+        rb = json.load(f)
+    sa, sb = _strip_volatile(ra), _strip_volatile(rb)
+    out = {"history_identical": ha == hb,
+           "results_identical": sa == sb,
+           "valid": (ra.get("valid"), rb.get("valid"))}
+    if not out["results_identical"]:
+        out["results_diff_keys"] = sorted(
+            k for k in set(sa) | set(sb) if sa.get(k) != sb.get(k))
+    return out
+
+
+def soak(store_root: str, kills: int = 5, rng_seed: int = 0,
+         mesh: str | None = None, opts_over: dict | None = None,
+         log=lambda m: print(m, file=sys.stderr)) -> dict:
+    """Baseline + kill/resume soak + bit-identity comparison."""
+    import random
+    rng = random.Random(rng_seed)
+    opts = dict(DEFAULT_OPTS)
+    if mesh:
+        opts["--mesh"] = mesh
+    opts.update(opts_over or {})
+    base_root = os.path.join(store_root, "baseline")
+    soak_root = os.path.join(store_root, "soak")
+    os.makedirs(base_root, exist_ok=True)
+    log(f"crash soak: baseline run ({opts['-w']}, mesh={mesh})")
+    base_dir = run_once(base_root, opts,
+                        os.path.join(base_root, "baseline.log"))
+    log(f"crash soak: {kills} randomized SIGKILL+resume cycles")
+    soaked = run_with_kills(soak_root, opts, kills, rng, log=log)
+    verdict = compare_runs(base_dir, soaked["dir"])
+    return {**verdict, "baseline_dir": base_dir, "soak_dir": soaked["dir"],
+            "launches": soaked["launches"],
+            "kills": len(soaked["kills"]), "kill_log": soaked["kills"]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="maelstrom_tpu.crash_soak",
+        description="SIGKILL/resume soak: byte-identical recovery proof")
+    ap.add_argument("--kills", type=int, default=5,
+                    help="randomized SIGKILL+resume cycles (default 5)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="harness rng seed (kill timing)")
+    ap.add_argument("--mesh", default=None,
+                    help="run the child sharded, e.g. --mesh 1,2")
+    ap.add_argument("--store", default=None,
+                    help="store root (default: a fresh temp dir)")
+    ap.add_argument("--time-limit", type=float, default=None,
+                    help="child test duration in virtual seconds")
+    args = ap.parse_args(argv)
+    store = args.store
+    if store is None:
+        import tempfile
+        store = tempfile.mkdtemp(prefix="maelstrom-crash-soak-")
+    over = {}
+    if args.time_limit is not None:
+        over["--time-limit"] = str(args.time_limit)
+    verdict = soak(store, kills=args.kills, rng_seed=args.seed,
+                   mesh=args.mesh, opts_over=over)
+    print(json.dumps(verdict, indent=2))
+    ok = verdict["history_identical"] and verdict["results_identical"]
+    print(("crash soak PASSED: byte-identical recovery" if ok else
+           "crash soak FAILED: recovery diverged"), file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ rings and reach the host as one batched drain per dispatch
 from __future__ import annotations
 
 import logging
+import threading
 from functools import partial
 
 import jax
@@ -399,6 +400,17 @@ class TpuRunner:
         self.checkpoint_every_rounds = (
             int(float(ckpt_s) * 1000.0 / self.ms_per_round)
             if ckpt_s else None)
+        # async crash-consistent checkpointing (doc/checkpoint.md): the
+        # main thread snapshots (device pull + a pickle of the mutable
+        # host state) and a background writer lands the file, so saves
+        # stay off the dispatch critical path; --sync-checkpoint forces
+        # the old inline write. On SIGTERM/SIGINT (--on-preempt
+        # checkpoint, the default) the run finishes the in-flight
+        # stretch, writes a final checkpoint, and exits EXIT_PREEMPTED.
+        self.sync_checkpoint = bool(test.get("sync_checkpoint"))
+        self.on_preempt = str(test.get("on_preempt") or "checkpoint")
+        self._ckpt_writer = None
+        self._preempt = threading.Event()
         self.nemesis = None
         # donated carry: the bump is pure round-counter surgery on the
         # full state tree, so buffer reuse saves a whole-tree copy per
@@ -570,23 +582,86 @@ class TpuRunner:
 
     # --- checkpoint/resume (SURVEY.md section 5.4: the reference can't) ---
 
-    def _save_checkpoint(self, gen, history, pending, free, r):
+    def _save_checkpoint(self, gen, history, pending, free, r,
+                         sync: bool = False):
+        """Snapshots the run. Main-thread work is only what MUST happen
+        before the next dispatch mutates state: the sim device pull
+        (copied when donation may recycle buffers), one pickle of the
+        small mutable host objects (generator tree, pending RPCs,
+        intern tables, nemesis rng — the loop keeps mutating the live
+        ones), and an O(columns) view-snapshot of the history. The big
+        pickle + fsync + rename runs on the background writer unless
+        `sync` (or --sync-checkpoint)."""
+        import pickle
+        import time as _time
+
         from .. import checkpoint as cp
-        state = {
-            "fingerprint": cp.fingerprint(self.test),
+        t0 = _time.perf_counter()
+        sim_host = jax.device_get(self.sim)
+        if donation_enabled():
+            # CPU device_get returns zero-copy views into device
+            # buffers; a later donated dispatch may recycle them while
+            # the writer is still pickling (same hazard as _read_state)
+            sim_host = jax.tree.map(np.array, sim_host)
+        meta = {
             "r": r,
             "dispatches": self._dispatches,
-            "sim": self.sim,
             "gen": gen,
-            "history": list(history),
             "pending": dict(pending),
             "free": set(free),
             "intern": self.intern,
             "nemesis_rng": (self.nemesis.rng_state()
                             if self.nemesis else None),
         }
-        path = cp.save(self.test["store_dir"], state)
-        log.info("checkpointed round %d -> %s", r, path)
+        state = {
+            "fingerprint": cp.fingerprint(self.test),
+            "r": r,
+            "sim": sim_host,
+            "meta_blob": pickle.dumps(meta,
+                                      protocol=pickle.HIGHEST_PROTOCOL),
+            "history_columns": history.snapshot_columns(),
+        }
+        store_dir = self.test["store_dir"]
+        if sync or self.sync_checkpoint:
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()    # never two writers on one file
+            path = cp.save(store_dir, state)
+            self.transfer.ckpt_saves += 1
+            self.transfer.ckpt_blocked_s += _time.perf_counter() - t0
+            log.info("checkpointed round %d -> %s (sync)", r, path)
+        else:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = cp.CheckpointWriter()
+            self._ckpt_writer.submit(store_dir, state)
+            self.transfer.ckpt_saves += 1
+            self.transfer.ckpt_blocked_s += _time.perf_counter() - t0
+            log.info("checkpoint snapshot at round %d -> background "
+                     "writer (%s)", r, store_dir)
+
+    def _finish_checkpoints(self):
+        """Joins the background writer (if any) and books its wall time
+        into the transfer counters, so results show how much save work
+        the writer amortized off the critical path."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+            self.transfer.ckpt_write_s = self._ckpt_writer.write_s
+
+    def _check_preempted(self, gen, history, pending, free, r):
+        """The graceful-preemption point, called at stretch boundaries:
+        the in-flight compiled stretch has completed and its replies are
+        folded into the history, so the state is checkpointable. Writes
+        a final (synchronous) checkpoint and unwinds with Preempted."""
+        if not self._preempt.is_set():
+            return
+        from .. import checkpoint as cp
+        store_dir = self.test.get("store_dir")
+        if store_dir:
+            self._save_checkpoint(gen, history, pending, free, r,
+                                  sync=True)
+        log.warning("preempted at virtual round %d (%d history ops, "
+                    "%d in flight): exiting %d for supervised relaunch",
+                    r, len(history), len(pending), cp.EXIT_PREEMPTED)
+        raise cp.Preempted(r, store_dir or None)
 
     # --- main loop ---
 
@@ -613,7 +688,8 @@ class TpuRunner:
             self._reshard()
             self._state_cache = None
             gen = resume["gen"]
-            history = History(resume["history"])
+            rh = resume["history"]
+            history = rh if isinstance(rh, History) else History(rh)
             pending = dict(resume["pending"])
             free = set(resume["free"])
             self.intern = resume["intern"]
@@ -633,16 +709,76 @@ class TpuRunner:
             from ..checkers.pipeline import AnalysisPipeline
             self.pipeline = AnalysisPipeline(workers=self.check_workers)
         self._fed_upto = 0
+        if resume is not None and self.pipeline is not None and \
+                len(history) > 0:
+            # pipeline-aware resume: seed the overlap bookkeeping with
+            # the resumed rows as segment 0, so the pipeline covers the
+            # whole stitched history and the checkers keep their fast
+            # path (a partial pipeline would fail the check-time
+            # row-count match and decline service, silently losing the
+            # overlap on every resumed run)
+            self.pipeline.seed_resumed(history, len(history))
+            self._fed_upto = len(history)
         # host mirror of the device message-id counter (refreshed by every
-        # dispatch's combined fetch)
+        # dispatch's combined fetch) — read BEFORE the signal handlers
+        # install: a transfer failure here must not leak them
         self._next_mid = int(self.transfer.fetch(self.sim.net.next_mid))
+        # graceful preemption (doc/checkpoint.md): SIGTERM/SIGINT set a
+        # flag; the loop finishes the in-flight compiled stretch, writes
+        # a final checkpoint, and unwinds with Preempted so the CLI can
+        # exit EXIT_PREEMPTED for a supervised --resume relaunch.
+        # Installed only on the main thread (signal() is refused
+        # elsewhere) and only for --on-preempt checkpoint.
+        import signal as _signal
+        prev_handlers = {}
+        if self.on_preempt == "checkpoint" and \
+                threading.current_thread() is threading.main_thread():
+            def _on_signal(signum, frame):
+                if self._preempt.is_set():
+                    # second signal: the user wants OUT, not graceful —
+                    # restore the previous handlers and abort now
+                    for s, h in prev_handlers.items():
+                        try:
+                            _signal.signal(s, h)
+                        except (ValueError, OSError):  # pragma: no cover
+                            pass
+                    raise KeyboardInterrupt
+                log.warning(
+                    "received %s: finishing the in-flight stretch, then "
+                    "writing a final checkpoint (signal again to abort "
+                    "immediately)", _signal.Signals(signum).name)
+                self._preempt.set()
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    prev_handlers[sig] = _signal.signal(sig, _on_signal)
+                except (ValueError, OSError):   # pragma: no cover
+                    pass
         try:
             r = self._run_loop(test, cfg, program, gen, nemesis,
                                processes, free, pending, history,
                                max_rounds, next_ckpt, r)
         except BaseException:
             # don't leak the analysis worker (and its history refs) on
-            # generator/client errors or KeyboardInterrupt
+            # generator/client errors or KeyboardInterrupt; land (or
+            # surface the failure of) any in-flight checkpoint write
+            if self.pipeline is not None:
+                self.pipeline.close()
+            try:
+                self._finish_checkpoints()
+            except Exception as e:
+                log.error("checkpoint writer failed during unwind: %s", e)
+            raise
+        finally:
+            for sig, h in prev_handlers.items():
+                try:
+                    _signal.signal(sig, h)
+                except (ValueError, OSError):   # pragma: no cover
+                    pass
+        try:
+            self._finish_checkpoints()
+        except BaseException:
+            # a failed background write surfaces here on the success
+            # path; don't leak the analysis worker on the way out
             if self.pipeline is not None:
                 self.pipeline.close()
             raise
@@ -671,6 +807,10 @@ class TpuRunner:
         N, C = cfg.n_nodes, self.concurrency
         exhausted = False
         while r < max_rounds:
+            # stretch boundary: the previous dispatch has landed and its
+            # replies are in the history, so this is the graceful spot
+            # to honor a pending SIGTERM/SIGINT
+            self._check_preempted(gen, history, pending, free, r)
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
             inject_rows = []
@@ -1011,13 +1151,21 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
         else None
 
+    from .. import checkpoint as cp
     resume = None
     if test.get("resume"):
-        from .. import checkpoint as cp
         resume = cp.load(test["resume"])
         cp.check_fingerprint(resume, test)
 
-    history = runner.run(resume=resume)
+    try:
+        history = runner.run(resume=resume)
+    except cp.Preempted:
+        # graceful preemption: the final checkpoint is on disk; flush
+        # the journal and let the CLI exit EXIT_PREEMPTED (the store dir
+        # keeps its in-progress shape — no results, not marked complete)
+        if runner.journal is not None:
+            runner.journal.close()
+        raise
     if runner.pipeline is not None:
         # checkers consume the incrementally-built partitions (register
         # fast path); verdicts stay bit-identical to the sequential path

@@ -49,6 +49,18 @@ def honor_jax_platforms():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def xla_device_count_flags(flags: str, n_devices: int) -> str:
+    """Returns `flags` with `--xla_force_host_platform_device_count`
+    set to `n_devices` (replacing any existing setting). Shared by
+    `force_virtual_cpu_mesh` and the crash-soak harness's subprocess
+    environment so the flag handling cannot diverge."""
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        return re.sub(r"--xla_force_host_platform_device_count=\d+",
+                      opt, flags)
+    return (flags + " " + opt).strip()
+
+
 def force_virtual_cpu_mesh(n_devices: int):
     """Puts this process on n_devices virtual CPU devices, defeating any
     sitecustomize backend override: env vars must be set before jax's
@@ -60,14 +72,8 @@ def force_virtual_cpu_mesh(n_devices: int):
     import os
 
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    opt = f"--xla_force_host_platform_device_count={n_devices}"
-    if "xla_force_host_platform_device_count" in flags:
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
-                       opt, flags)
-    else:
-        flags = (flags + " " + opt).strip()
-    os.environ["XLA_FLAGS"] = flags
+    os.environ["XLA_FLAGS"] = xla_device_count_flags(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
 
     honor_jax_platforms()
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Supervisor relaunch loop for preemption-tolerant runs
+# (doc/checkpoint.md). Runs a TPU-path test with checkpointing and
+# relaunches it with --resume whenever it exits preempted:
+#
+#   - rc 75 (EXIT_PREEMPTED): the run caught SIGTERM/SIGINT, finished
+#     its in-flight compiled stretch, and wrote a final checkpoint.
+#   - rc 137 (SIGKILL) with a checkpoint on disk: hard-killed mid-run;
+#     resume from the last durable periodic checkpoint.
+#
+# Set KILL_AFTER_S to have the wrapper itself SIGKILL the child after a
+# random 0..KILL_AFTER_S seconds each launch (a shell-only crash soak;
+# `python -m maelstrom_tpu.crash_soak` is the checked, bit-identity
+# version). Any other exit code ends the loop with that code.
+#
+# Usage:
+#   ./run_crash_soak.sh                      # default lin-kv fault soup
+#   ./run_crash_soak.sh --node tpu:kafka -w kafka --time-limit 60 ...
+#   KILL_AFTER_S=5 ./run_crash_soak.sh      # randomized SIGKILL soak
+set -u
+
+STORE="${STORE:-store}"
+MAX_RELAUNCHES="${MAX_RELAUNCHES:-50}"
+
+if [ "$#" -gt 0 ]; then
+    ARGS=("$@")
+else
+    ARGS=(--node tpu:lin-kv -w lin-kv --node-count 5 --rate 10
+          --time-limit 30 --nemesis kill,pause,partition,duplicate
+          --nemesis-interval 2 --checkpoint-every 1)
+fi
+
+RESUME=()
+relaunches=0
+while :; do
+    if [ -n "${KILL_AFTER_S:-}" ]; then
+        python -m maelstrom_tpu test "${ARGS[@]}" --store "$STORE" \
+            ${RESUME[@]+"${RESUME[@]}"} &
+        child=$!
+        # kill at a random moment; if the run finishes first, reap it
+        sleep_s=$(awk -v max="$KILL_AFTER_S" \
+            'BEGIN{srand(); printf "%.2f", rand()*max}')
+        (sleep "$sleep_s" && kill -9 "$child" 2>/dev/null) &
+        killer=$!
+        wait "$child"
+        rc=$?
+        kill "$killer" 2>/dev/null
+        wait "$killer" 2>/dev/null
+    else
+        python -m maelstrom_tpu test "${ARGS[@]}" --store "$STORE" \
+            ${RESUME[@]+"${RESUME[@]}"}
+        rc=$?
+    fi
+
+    # the run in progress (store/current) is where checkpoints land
+    last=$(readlink -f "$STORE/current" 2>/dev/null || true)
+    if [ "$rc" -eq 75 ] || [ "$rc" -eq 137 ]; then
+        relaunches=$((relaunches + 1))
+        if [ "$relaunches" -gt "$MAX_RELAUNCHES" ]; then
+            echo "run_crash_soak: gave up after $MAX_RELAUNCHES relaunches" >&2
+            exit 1
+        fi
+        if [ -n "$last" ] && { [ -e "$last/checkpoint.pkl" ] ||
+                [ -e "$last/checkpoint.prev.pkl" ]; }; then
+            echo "run_crash_soak: rc=$rc, relaunching with --resume $last" \
+                 "(relaunch $relaunches)" >&2
+            RESUME=(--resume "$last")
+        else
+            # killed before the first checkpoint of a fresh run: start
+            # over (determinism makes the retry equivalent); keep any
+            # previous RESUME if one was already in effect
+            echo "run_crash_soak: rc=$rc, no new checkpoint —" \
+                 "relaunching (relaunch $relaunches)" >&2
+        fi
+        continue
+    fi
+    exit "$rc"
+done

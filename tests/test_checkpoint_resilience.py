@@ -1,0 +1,318 @@
+"""Crash-consistent checkpointing: format durability, fingerprint
+drift, async-writer semantics, pipeline-aware resume, and sharded
+(mesh) checkpoint/resume.
+
+These are the fast (tier-1) companions of tests/test_checkpoint.py's
+slow end-to-end determinism suite and tests/test_crash_soak.py's
+subprocess kill/resume harness.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from maelstrom_tpu import checkpoint as cp
+from maelstrom_tpu import core
+from maelstrom_tpu.history import History, Op
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+from conftest import ops_projection as _ops
+
+
+# --- format / durability units (no simulation) ---
+
+
+def _mini_state(r=5):
+    h = History([Op(type="invoke", f="read", value=[0, None], process=0,
+                    time=10),
+                 Op(type="ok", f="read", value=[0, 7], process=0,
+                    time=20)])
+    return {
+        "fingerprint": {"seed": 0, "workload": "lin-kv"},
+        "r": r,
+        "sim": {"x": np.arange(3, dtype=np.int32), "y": np.float32(r)},
+        "meta_blob": pickle.dumps({"r": r, "dispatches": 2, "gen": None,
+                                   "pending": {}, "free": set(),
+                                   "intern": None, "nemesis_rng": None}),
+        "history_columns": h.snapshot_columns(),
+    }
+
+
+def test_save_load_roundtrip(tmp_path):
+    d = str(tmp_path)
+    cp.save(d, _mini_state())
+    st = cp.load(d)
+    assert st["r"] == 5 and st["dispatches"] == 2
+    assert isinstance(st["history"], History) and len(st["history"]) == 2
+    assert st["history"][1].value == [0, 7]
+    assert int(np.asarray(st["sim"]["x"]).sum()) == 3
+    # no stray tmp after a clean save
+    assert not os.path.exists(os.path.join(d, cp.CHECKPOINT_FILE + ".tmp"))
+
+
+def test_truncated_checkpoint_versioned_error(tmp_path):
+    d = str(tmp_path)
+    path = cp.save(d, _mini_state())
+    blob = open(path, "rb").read()
+    # header-only truncation
+    with open(path, "wb") as f:
+        f.write(blob[:8])
+    with pytest.raises(cp.CheckpointError, match="truncated"):
+        cp.load(d)
+    # payload truncation
+    with open(path, "wb") as f:
+        f.write(blob[:-20])
+    with pytest.raises(cp.CheckpointError, match="truncated"):
+        cp.load(d)
+
+
+def test_old_raw_pickle_versioned_error(tmp_path):
+    """Pre-versioning checkpoints were bare pickles: the load error must
+    say so instead of surfacing a raw UnpicklingError mid-resume."""
+    d = str(tmp_path)
+    with open(os.path.join(d, cp.CHECKPOINT_FILE), "wb") as f:
+        pickle.dump({"r": 1, "sim": {}}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    with pytest.raises(cp.CheckpointError, match="pre-versioning"):
+        cp.load(d)
+
+
+def test_unknown_version_versioned_error(tmp_path):
+    d = str(tmp_path)
+    path = cp.save(d, _mini_state())
+    blob = bytearray(open(path, "rb").read())
+    blob[8] = 99                    # bump the little-endian version field
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(cp.CheckpointError, match="v99"):
+        cp.load(d)
+
+
+def test_torn_write_falls_back_to_previous_checkpoint(tmp_path):
+    """A corrupted newest checkpoint (torn write) must not lose the run:
+    load falls back to checkpoint.prev.pkl, the last good snapshot."""
+    d = str(tmp_path)
+    cp.save(d, _mini_state(r=100))
+    path = cp.save(d, _mini_state(r=200))
+    assert os.path.exists(os.path.join(d, cp.PREV_CHECKPOINT_FILE))
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF                # flip a payload byte: digest mismatch
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    st = cp.load(d)
+    assert st["r"] == 100
+    # without a fallback the digest failure is surfaced, named
+    os.unlink(os.path.join(d, cp.PREV_CHECKPOINT_FILE))
+    with pytest.raises(cp.CheckpointError, match="digest"):
+        cp.load(d)
+
+
+def test_missing_checkpoint_still_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError, match="checkpoint-every"):
+        cp.load(str(tmp_path / "nope"))
+
+
+def test_failed_write_leaves_no_stale_tmp(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    real_replace = os.replace
+
+    def boom(src, dst):
+        if dst.endswith(cp.CHECKPOINT_FILE):
+            raise OSError("disk full")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="disk full"):
+        cp.save(d, _mini_state())
+    assert not os.path.exists(os.path.join(d, cp.CHECKPOINT_FILE + ".tmp"))
+
+
+def test_writer_failure_surfaces_on_wait(tmp_path):
+    w = cp.CheckpointWriter()
+    w.submit(str(tmp_path), {"sim": {}, "bad": lambda: None})  # unpicklable
+    with pytest.raises(cp.CheckpointError, match="write failed"):
+        w.wait()
+    # the writer recovers: a good snapshot still lands
+    w.submit(str(tmp_path), _mini_state())
+    w.wait()
+    assert cp.load(str(tmp_path))["r"] == 5
+    assert w.writes == 2 and not w.in_flight()
+
+
+def test_writer_single_flight(tmp_path):
+    """Back-to-back submits serialize: the second joins the first, so
+    the newest file always reflects the newest submit."""
+    w = cp.CheckpointWriter()
+    for r in (1, 2, 3):
+        w.submit(str(tmp_path), _mini_state(r=r))
+    w.wait()
+    assert cp.load(str(tmp_path))["r"] == 3
+    assert w.writes == 3
+
+
+# --- fingerprint drift ---
+
+
+def test_fingerprint_names_mismatched_compiled_shape_flags():
+    """Every flag that shapes the compiled state tree or the op stream
+    must be fingerprinted, and a mismatched resume must name the
+    offending key(s)."""
+    for key in ("mesh", "journal_scan_cap", "reply_log_cap",
+                "journal_rows", "collect_replies", "max_scan",
+                "pool_cap", "ms_per_round", "seed"):
+        assert key in cp.FINGERPRINT_KEYS, key
+    base = {"workload": "lin-kv", "seed": 1, "mesh": "1,2",
+            "journal_scan_cap": 128, "reply_log_cap": 256}
+    ck = {"fingerprint": cp.fingerprint(base)}
+    cp.check_fingerprint(ck, dict(base))        # identical: fine
+    for key, other in (("mesh", "1,4"), ("journal_scan_cap", 512),
+                       ("reply_log_cap", 64), ("seed", 2)):
+        with pytest.raises(ValueError, match=key):
+            cp.check_fingerprint(ck, {**base, key: other})
+
+
+def test_fingerprint_excludes_analysis_flags():
+    """Analysis- and durability-side flags deliberately stay OUT of the
+    fingerprint: they never touch the op stream, so a resume may freely
+    change them (e.g. resume with more check workers, or switch the
+    checkpoint cadence / sync mode)."""
+    for key in ("check_workers", "no_overlap", "checkpoint_every",
+                "sync_checkpoint", "on_preempt", "resume"):
+        assert key not in cp.FINGERPRINT_KEYS, key
+    base = {"workload": "lin-kv", "seed": 1, "check_workers": 1,
+            "no_overlap": False, "checkpoint_every": 1.0}
+    ck = {"fingerprint": cp.fingerprint(base)}
+    cp.check_fingerprint(ck, {**base, "check_workers": 4,
+                              "no_overlap": True,
+                              "checkpoint_every": 0.25,
+                              "sync_checkpoint": True})
+
+
+# --- end-to-end: async writer, pipeline-aware resume, mesh ---
+
+
+def _build(root, **over):
+    opts = {"workload": "lin-kv", "node": "tpu:lin-kv", "node_count": 3,
+            "rate": 15.0, "time_limit": 2.0, "nemesis": {"partition"},
+            "nemesis_interval": 1.0, "recovery_s": 0.5, "seed": 7,
+            "store_root": str(root)}
+    opts.update(over)
+    test = core.build_test(opts)
+    test["store_dir"] = str(root)
+    return test
+
+
+def _run_resumed(tmp_path, sub, **over):
+    """Checkpointed partial run + resume; returns (runner, history,
+    test) of the resumed run."""
+    tb = _build(tmp_path / sub, checkpoint_every=0.5, **over)
+    tb["max_rounds"] = 1000
+    TpuRunner(tb).run()
+    tc = _build(tmp_path / sub, checkpoint_every=0.5, **over)
+    runner = TpuRunner(tc)
+    resume = cp.load(str(tmp_path / sub))
+    cp.check_fingerprint(resume, tc)
+    return runner, runner.run(resume=resume), tc
+
+
+def test_async_and_sync_checkpoints_agree(tmp_path):
+    """--sync-checkpoint is an escape hatch, not a different format: the
+    background writer and the inline path produce interchangeable
+    checkpoints and identical resumed histories."""
+    ta = _build(tmp_path / "base")
+    hist_a = TpuRunner(ta).run()
+
+    runner_b, hist_b, _ = _run_resumed(tmp_path, "async")
+    runner_c, hist_c, _ = _run_resumed(tmp_path, "sync",
+                                       sync_checkpoint=True)
+    assert _ops(hist_b) == _ops(hist_a)
+    assert _ops(hist_c) == _ops(hist_a)
+    # the async path actually used the background writer; sync didn't
+    assert runner_b._ckpt_writer is not None
+    assert runner_c._ckpt_writer is None
+    for r in (runner_b, runner_c):
+        assert r.transfer.ckpt_saves > 0
+    # background write time is booked (the amortization counter)
+    assert runner_b.transfer.ckpt_write_s > 0.0
+
+
+def test_resume_keeps_pipeline_overlap(tmp_path):
+    """Regression: resumed runs must keep the overlapped analysis
+    pipeline. The pipeline is seeded with the resumed rows, covers the
+    whole stitched history at check time, and its verdicts equal the
+    sequential path's bit-for-bit."""
+    runner, hist, test = _run_resumed(tmp_path, "p")
+    assert runner.pipeline is not None
+    rep = runner.pipeline.report()
+    assert rep["rows"] == len(hist)
+    assert rep.get("resumed-rows", 0) > 0
+    assert "error" not in rep
+    # the checker actually gets served (no row-count decline)
+    parts = runner.pipeline.register_partitions(len(hist))
+    assert parts is not None and len(parts) > 0
+    # verdict equality: pipeline-fed vs sequential recompute
+    wl = test["workload_map"]["checker"]
+    fast = wl.check({**test, "analysis": runner.pipeline}, hist, {})
+    seq = wl.check({k: v for k, v in test.items() if k != "analysis"},
+                   hist, {})
+    assert fast == seq
+
+
+def test_preempt_writes_final_checkpoint_and_resumes(tmp_path):
+    """The graceful-preemption path, in-process and deterministic: with
+    the preempt flag raised, the runner writes a final (synchronous)
+    checkpoint at the next stretch boundary and unwinds with Preempted;
+    resuming from that checkpoint completes bit-identically to an
+    uninterrupted run. (The real-signal subprocess version — SIGTERM,
+    exit code 75 — lives in tests/test_crash_soak.py, slow suite.)"""
+    ta = _build(tmp_path / "base")
+    hist_a = TpuRunner(ta).run()
+
+    tb = _build(tmp_path / "g")
+    runner = TpuRunner(tb)
+    runner._preempt.set()
+    with pytest.raises(cp.Preempted) as ei:
+        runner.run()
+    assert ei.value.checkpoint_dir == str(tmp_path / "g")
+    st = cp.load(str(tmp_path / "g"))
+
+    tc = _build(tmp_path / "g")
+    rc = TpuRunner(tc)
+    cp.check_fingerprint(st, tc)
+    hist_c = rc.run(resume=st)
+    assert _ops(hist_c) == _ops(hist_a)
+
+
+@pytest.mark.multichip
+def test_mesh_checkpoint_resume_bit_identical(tmp_path):
+    """Sharded checkpointing: a --mesh 1,2 run checkpoints its sharded
+    state tree (saved host-side), resumes onto the same mesh via
+    `_reshard`, and the stitched history is bit-identical to the
+    uninterrupted sharded run."""
+    ta = _build(tmp_path / "base", mesh="1,2")
+    hist_a = TpuRunner(ta).run()
+    assert len(hist_a) > 10
+
+    _, hist_c, _ = _run_resumed(tmp_path, "m", mesh="1,2")
+    assert _ops(hist_c) == _ops(hist_a)
+
+
+@pytest.mark.multichip
+def test_mesh_checkpoint_rejects_other_mesh(tmp_path):
+    """A checkpoint taken under one mesh refuses to resume under a
+    different mesh (or none): the mismatch is named, not silently
+    resharded into an untested donation/sharding combination."""
+    tb = _build(tmp_path / "b", checkpoint_every=0.5, mesh="1,2")
+    tb["max_rounds"] = 1000
+    TpuRunner(tb).run()
+    ck = cp.load(str(tmp_path / "b"))
+    with pytest.raises(ValueError, match="mesh"):
+        cp.check_fingerprint(
+            ck, _build(tmp_path / "b", checkpoint_every=0.5, mesh="1,4"))
+    with pytest.raises(ValueError, match="mesh"):
+        cp.check_fingerprint(
+            ck, _build(tmp_path / "b", checkpoint_every=0.5))

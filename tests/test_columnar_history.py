@@ -112,7 +112,7 @@ def test_jsonl_round_trip():
 
 
 def test_checkpoint_materialize_rebuild_cycle():
-    """The checkpoint path saves list(history) and resumes with
+    """The (legacy) checkpoint path saved list(history) and resumed with
     History(list): the cycle must be lossless, and the rebuilt history
     must keep appending with correct indices."""
     ops = random_ops(5, n=300)
@@ -123,6 +123,36 @@ def test_checkpoint_materialize_rebuild_cycle():
                             process=1, time=999))
     assert nxt.index == len(ops)
     assert rebuilt[-1].index == len(ops)
+
+
+def test_checkpoint_columns_snapshot_rebuild_cycle():
+    """The checkpoint path proper saves snapshot_columns() and resumes
+    with from_columns(): lossless, no per-op materialization, and the
+    rebuilt history keeps appending with correct indices. The snapshot
+    must also be immune to appends that land after it was taken (the
+    async writer pickles it while the run keeps going)."""
+    import pickle
+
+    ops = random_ops(5, n=300)
+    h = History(ops)
+    snap = h.snapshot_columns()
+    # keep appending (growing past a buffer reallocation) AFTER the
+    # snapshot: the snapshot must still describe exactly the first 300
+    for i in range(2000):
+        h.append_row("invoke", "read", [i, None], i % 7, time=1000 + i)
+    snap = pickle.loads(pickle.dumps(snap))     # what the writer does
+    rebuilt = History.from_columns(snap)
+    assert len(rebuilt) == len(ops)
+    assert ([o.to_dict() for o in rebuilt]
+            == [o.to_dict() for o in History(ops)])
+    nxt = rebuilt.append(Op(type="invoke", f="read", value=[0, None],
+                            process=1, time=999))
+    assert nxt.index == len(ops)
+    assert rebuilt[-1].index == len(ops)
+    # pairing runs identically on the rebuilt columns
+    assert (History(ops).pairs_index().tolist()
+            == History.from_columns(History(ops).snapshot_columns())
+            .pairs_index().tolist())
 
 
 def test_extend_columns_matches_append():

@@ -1,0 +1,144 @@
+"""Kill/resume soak: byte-identical recovery under real SIGKILL.
+
+The harness (maelstrom_tpu.crash_soak) runs the test as a subprocess,
+SIGKILLs it after a checkpoint has landed, resumes with --resume, and
+compares the completed run's history.jsonl and results.json against an
+uninterrupted same-seed baseline.
+
+Tier-1 keeps the cheap proofs: one SIGKILL+resume cycle and one
+graceful SIGTERM (exit code EXIT_PREEMPTED, loadable final checkpoint).
+The full randomized multi-kill soaks — including the sharded --mesh
+path — carry the `soak` marker (opt in with MAELSTROM_SOAK=1).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import time
+
+import pytest
+
+from maelstrom_tpu import checkpoint as cp
+from maelstrom_tpu import crash_soak
+
+# Small, fast smoke config: partition nemesis only (the combined
+# kill/pause/duplicate soup belongs to the soak-marked runs), tight
+# checkpoint cadence so a kill always lands between checkpoints.
+SMOKE_OPTS = {
+    "-w": "lin-kv", "--node": "tpu:lin-kv", "--node-count": "3",
+    "--rate": "10", "--time-limit": "4", "--seed": "11",
+    "--nemesis": "partition", "--nemesis-interval": "1",
+    "--checkpoint-every": "0.5",
+}
+
+
+@pytest.fixture(scope="module")
+def smoke_baseline(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("crash-smoke-baseline"))
+    return crash_soak.run_once(root, SMOKE_OPTS,
+                               os.path.join(root, "baseline.log"))
+
+
+def test_single_sigkill_resume_bit_identical(smoke_baseline, tmp_path):
+    """Tier-1 smoke: one SIGKILL after the first checkpoint, one
+    resume, byte-identical history and verdicts."""
+    res = crash_soak.run_with_kills(str(tmp_path), SMOKE_OPTS, kills=1,
+                                    rng=random.Random(5),
+                                    kill_jitter_s=0.2)
+    assert len(res["kills"]) == 1, res
+    verdict = crash_soak.compare_runs(smoke_baseline, res["dir"])
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+
+
+@pytest.mark.slow
+def test_sigterm_graceful_preempt_then_resume(smoke_baseline, tmp_path):
+    """Graceful preemption end to end, real signal + real process:
+    SIGTERM mid-run exits EXIT_PREEMPTED with a loadable final
+    checkpoint; a --resume relaunch completes and matches the
+    uninterrupted baseline bit-for-bit. (Tier-1 pins the same path
+    in-process and cheaply:
+    test_checkpoint_resilience.py::test_preempt_writes_final_checkpoint.)"""
+    store = str(tmp_path)
+    log_path = os.path.join(store, "child.log")
+    os.makedirs(store, exist_ok=True)
+    with open(log_path, "ab") as lf:
+        proc = subprocess.Popen(
+            crash_soak.argv_for(store, SMOKE_OPTS),
+            env=crash_soak.child_env(), stdout=lf,
+            stderr=subprocess.STDOUT)
+        # wait for the run dir and its first checkpoint (the runner is
+        # live and its SIGTERM handler installed), then preempt
+        deadline = time.time() + 300
+        my_dir = None
+        while proc.poll() is None and time.time() < deadline:
+            dirs = crash_soak.run_dirs(store, SMOKE_OPTS["-w"])
+            if dirs:
+                my_dir = dirs[-1]
+                if os.path.exists(os.path.join(my_dir,
+                                               cp.CHECKPOINT_FILE)):
+                    break
+            time.sleep(0.02)
+        assert proc.poll() is None, "run finished before it could be " \
+            "preempted; grow --time-limit"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+    assert rc == cp.EXIT_PREEMPTED, (rc, open(log_path).read()[-2000:])
+    # the graceful path wrote a loadable final checkpoint
+    final = cp.load(my_dir)
+    assert final["r"] > 0
+    # supervisor relaunch: resume to completion, compare to baseline
+    with open(log_path, "ab") as lf:
+        rc2 = subprocess.call(
+            crash_soak.argv_for(store, SMOKE_OPTS, resume=my_dir),
+            env=crash_soak.child_env(), stdout=lf,
+            stderr=subprocess.STDOUT, timeout=600)
+    assert rc2 == 0, open(log_path).read()[-2000:]
+    done = crash_soak.run_dirs(store, SMOKE_OPTS["-w"])[-1]
+    verdict = crash_soak.compare_runs(smoke_baseline, done)
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+
+
+@pytest.mark.soak
+def test_crash_soak_combined_nemesis(tmp_path):
+    """≥5 randomized SIGKILL+resume cycles under the combined
+    kill/pause/partition/duplicate nemesis: stitched history and
+    checker verdicts bit-identical to the uninterrupted run, with the
+    analysis pipeline active after every resume (lin-kv's register
+    checker consumes it; a pipeline decline would still pass the
+    verdict check, so test_resume_keeps_pipeline_overlap pins the
+    coverage itself)."""
+    import json
+
+    verdict = crash_soak.soak(str(tmp_path), kills=5, rng_seed=1)
+    assert verdict["kills"] >= 5, verdict
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+    assert verdict["valid"][0] == verdict["valid"][1]
+    # the final (resumed) launch kept the overlapped analysis pipeline:
+    # it covered the whole stitched history, seeded with resumed rows
+    res = json.load(open(os.path.join(verdict["soak_dir"],
+                                      "results.json")))
+    pipe = res["analysis-pipeline"]
+    n_hist = sum(1 for line in open(
+        os.path.join(verdict["soak_dir"], "history.jsonl")) if line.strip())
+    assert pipe["rows"] == n_hist, pipe
+    assert pipe.get("resumed-rows", 0) > 0, pipe
+    assert "error" not in pipe, pipe
+
+
+@pytest.mark.soak
+@pytest.mark.multichip
+def test_crash_soak_mesh(tmp_path):
+    """The sharded path: same ≥5-kill soak under --mesh 1,2 (sharded
+    save, `_reshard` restore), bit-identical to the uninterrupted
+    sharded run."""
+    verdict = crash_soak.soak(str(tmp_path), kills=5, rng_seed=2,
+                              mesh="1,2")
+    assert verdict["kills"] >= 5, verdict
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
