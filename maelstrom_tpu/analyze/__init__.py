@@ -1,0 +1,406 @@
+"""Static analysis: determinism & hot-path hazard auditing.
+
+Every hard bug the project has shipped so far was a *silent hot-path
+hazard* found only by soak replay after the fact: the PR 2 unstable
+delivery sort that diverged under `--mesh` (partitioned sorts don't
+preserve stability), and the PR 2/4 donated-carry + CPU zero-copy
+`device_get` views that corrupted histories under buffer recycling.
+This package converts that bug history into machine-checked invariants,
+enforced at *trace time* instead of by replay:
+
+  - `jaxpr_audit` traces the real production step functions
+    (`round_fn`/`scan_fn` from `runner.tpu_runner`, plain and `--mesh`
+    variants) to ClosedJaxprs and walks every equation (recursing into
+    `scan`/`while`/`cond`/`pjit` sub-jaxprs) for unstable sorts, host
+    round-trips, dtype widening, non-unique scatters, and donation
+    hazards (aliased carries, resharded donated args, CPU zero-copy
+    views).
+  - `source_lint` (stdlib `ast`) walks the hot *host* modules for
+    Python-level nondeterminism: unstable `np.argsort`/`np.sort`,
+    iteration over sets feeding sim state, wall-clock reads and
+    unseeded module-level `random` in replayed paths.
+
+Findings are structured (rule id, severity, location, excerpt) and
+suppressible through the checked-in `analyze/baseline.json`, so the CI
+gate (`python -m maelstrom_tpu.analyze`, or the `analyze` CLI
+subcommand) only fails on *new* findings. See doc/analyze.md for the
+rule catalog and the incident each rule would have caught.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "RULES", "Finding", "AuditReport", "Baseline", "baseline_path",
+    "dedupe_sites", "apply_baseline", "run_audit", "audit_runner",
+]
+
+
+# ---------------------------------------------------------------------------
+# Rule catalog. Severity "error" = a hazard class that has shipped a real
+# bug here (or would corrupt results outright); "warn" = order/config
+# dependence that is frequently deliberate and gets baselined with a
+# justification. The gate treats both the same: any NON-baselined
+# finding fails.
+# ---------------------------------------------------------------------------
+
+RULES: dict[str, dict] = {
+    "unstable-sort": {
+        "severity": "error",
+        "summary": "sort without is_stable=True or an explicit index "
+                   "tiebreak operand (num_keys >= 2)",
+        "incident": "PR 2: delivery argsort ties diverged under --mesh — "
+                    "partitioned sorts don't preserve stability",
+    },
+    "host-transfer": {
+        "severity": "error",
+        "summary": "host round-trip primitive inside the compiled hot "
+                   "loop (io_callback/pure_callback/debug_callback/"
+                   "device_put)",
+        "incident": "a per-round host callback turns the one-dispatch "
+                    "scan into O(rounds) round trips (~160 ms each on "
+                    "remote backends)",
+    },
+    "dtype-widening": {
+        "severity": "error",
+        "summary": "implicit 32->64-bit dtype promotion "
+                   "(convert_element_type widening; x64 leak)",
+        "incident": "f64 sneaking into the scan doubles HBM traffic and "
+                    "breaks cross-backend bit-identity",
+    },
+    "scatter-nonunique": {
+        "severity": "warn",
+        "summary": "scatter-set without unique_indices: overlapping "
+                   "updates are compiler-order-dependent",
+        "incident": "same hazard class as the PR 2 sort ties: GSPMD may "
+                    "reorder per-shard updates",
+    },
+    "donation-alias": {
+        "severity": "error",
+        "summary": "donated argument tree contains the same buffer "
+                   "twice (XLA rejects f(donate(a), donate(a)); a "
+                   "missed dealias)",
+        "incident": "PR 2: make_sim trees alias heavily (Msgs.empty "
+                    "fan-out, durable_view views); donation requires "
+                    "sim.dealias first",
+    },
+    "donation-reshard": {
+        "severity": "error",
+        "summary": "donated carry's pinned input sharding differs from "
+                   "its output sharding — the next call must reshard a "
+                   "donated buffer",
+        "incident": "PR 2: donated args cannot be resharded at the call "
+                    "boundary; every producer of the carry must hand "
+                    "back the canonical placement",
+    },
+    "donation-cpu-view": {
+        "severity": "warn",
+        "summary": "carry donation forced on while the backend is CPU: "
+                   "device_get returns zero-copy views that a donating "
+                   "dispatch may recycle under live host references",
+        "incident": "PR 2/4: rare nondeterministic histories in CPU "
+                    "soak runs; donation defaults off on CPU "
+                    "(sim.donation_enabled)",
+    },
+    # ---- source-lint rules (host-side Python, stdlib ast) ----
+    "np-unstable-sort": {
+        "severity": "error",
+        "summary": "np.argsort/np.sort without kind=\"stable\" in a "
+                   "replayed host path (numpy defaults to introsort)",
+        "incident": "pairing/screening argsorts must be stable or "
+                    "equal-key op order diverges between runs",
+    },
+    "set-iteration": {
+        "severity": "warn",
+        "summary": "iteration over a set feeding sim/history state: "
+                   "order is hash-seed dependent",
+        "incident": "replay equality (checkpoint/resume, scan-vs-run) "
+                    "requires deterministic iteration order",
+    },
+    "wall-clock": {
+        "severity": "warn",
+        "summary": "time.time()/datetime.now() in a replayed path "
+                   "(virtual time must come from the round counter)",
+        "incident": "wall-clock reads make checkpoint/resume histories "
+                    "diverge byte-wise",
+    },
+    "unseeded-random": {
+        "severity": "error",
+        "summary": "module-level random.* call (unseeded global RNG) in "
+                   "a replayed path; use a seeded random.Random",
+        "incident": "nemesis/generator decisions must replay identically "
+                    "from the same seed on both paths",
+    },
+}
+
+
+@dataclass
+class Finding:
+    """One hazard site. `where` is display-precise
+    (``relpath:line (function)``); `key` is the line-free baseline
+    grouping key (``relpath:function``) so baselines survive unrelated
+    line drift."""
+    rule: str
+    where: str
+    key: str
+    detail: str = ""
+    entry: str = ""                 # traced entry point / "source-lint"
+    entries: list = field(default_factory=list)
+
+    @property
+    def severity(self) -> str:
+        return RULES.get(self.rule, {}).get("severity", "error")
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "where": self.where, "key": self.key,
+                "detail": self.detail,
+                "entries": sorted(set(self.entries or [self.entry]))}
+
+
+def dedupe_sites(findings: list[Finding]) -> list[Finding]:
+    """Collapses per-entry duplicates (the same source site traced in
+    round_fn, scan_fn, and the journal/mesh variants) into one site
+    finding that remembers every entry it appeared in."""
+    by_site: dict[tuple, Finding] = {}
+    for f in findings:
+        site = (f.rule, f.where, f.detail)
+        cur = by_site.get(site)
+        if cur is None:
+            cur = Finding(rule=f.rule, where=f.where, key=f.key,
+                          detail=f.detail, entry=f.entry,
+                          entries=[f.entry] if f.entry else [])
+            by_site[site] = cur
+        elif f.entry and f.entry not in cur.entries:
+            cur.entries.append(f.entry)
+    return sorted(by_site.values(), key=lambda f: (f.rule, f.where))
+
+
+# ---------------------------------------------------------------------------
+# Baseline: checked-in deliberate exceptions. Suppressions group by
+# (rule, relpath:function) with a max_sites budget, so unrelated line
+# drift never breaks CI but a NEW hazard in the same function (one more
+# site than budgeted) does.
+# ---------------------------------------------------------------------------
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class Baseline:
+    suppressions: list = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "Baseline":
+        path = path or baseline_path()
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(suppressions=list(data.get("suppressions", ())))
+
+    def budget(self, rule: str, key: str):
+        for s in self.suppressions:
+            if s.get("rule") == rule and s.get("where") == key:
+                return s
+        return None
+
+
+def apply_baseline(sites: list[Finding], baseline: Baseline):
+    """Splits deduped site findings into (new, suppressed). A
+    suppression covers up to `max_sites` distinct sites of its rule in
+    its function; extra sites mean something NEW appeared there and the
+    whole group is surfaced (we cannot tell old from new without line
+    numbers, and re-baselining is explicit)."""
+    groups: dict[tuple, list[Finding]] = {}
+    for f in sites:
+        groups.setdefault((f.rule, f.key), []).append(f)
+    new, suppressed = [], []
+    for (rule, key), group in sorted(groups.items()):
+        s = baseline.budget(rule, key)
+        if s is not None and len(group) <= int(s.get("max_sites", 1)):
+            suppressed.extend(group)
+        elif s is not None:
+            for f in group:
+                f.detail = (f.detail + " " if f.detail else "") + \
+                    f"[exceeds baseline max_sites={s.get('max_sites', 1)}]"
+            new.extend(group)
+        else:
+            new.extend(group)
+    return new, suppressed
+
+
+# ---------------------------------------------------------------------------
+# Reports
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AuditReport:
+    new: list = field(default_factory=list)          # [Finding]
+    suppressed: list = field(default_factory=list)   # [Finding]
+    entries: list = field(default_factory=list)      # audited entry points
+    notes: list = field(default_factory=list)
+    wall_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def rule_counts(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.new + self.suppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+    def as_dict(self) -> dict:
+        return {"ok": self.ok,
+                "rules": self.rule_counts(),
+                "new": [f.as_dict() for f in self.new],
+                "suppressed": [f.as_dict() for f in self.suppressed],
+                "suppressed-count": len(self.suppressed),
+                "entries": list(self.entries),
+                "notes": list(self.notes),
+                "wall-s": round(self.wall_s, 3)}
+
+    def render_text(self) -> str:
+        lines = [f"static audit: {len(self.entries)} entries traced, "
+                 f"{len(self.new)} new finding(s), "
+                 f"{len(self.suppressed)} baselined, "
+                 f"{self.wall_s:.1f}s"]
+        for note in self.notes:
+            lines.append(f"  note: {note}")
+        for f in self.new:
+            meta = RULES.get(f.rule, {})
+            lines.append(f"\nNEW [{f.severity}] {f.rule} @ {f.where}")
+            lines.append(f"  {meta.get('summary', '')}")
+            if f.detail:
+                lines.append(f"  detail: {f.detail}")
+            if f.entries:
+                lines.append(f"  seen in: {', '.join(sorted(f.entries))}")
+            if meta.get("incident"):
+                lines.append(f"  incident: {meta['incident']}")
+        if self.suppressed:
+            lines.append("\nbaselined:")
+            for f in self.suppressed:
+                lines.append(f"  [{f.rule}] {f.where}")
+        lines.append("\nresult: " + ("CLEAN (no new findings)" if self.ok
+                                     else f"{len(self.new)} NEW finding(s)"))
+        return "\n".join(lines)
+
+    def write_baseline(self, path: str | None = None) -> str:
+        """Regenerates baseline.json covering every current site.
+        Reasons for pre-existing entries are preserved; new entries get
+        a FIXME reason the author must edit."""
+        path = path or baseline_path()
+        old = Baseline.load(path)
+        groups: dict[tuple, int] = {}
+        for f in self.new + self.suppressed:
+            groups[(f.rule, f.key)] = groups.get((f.rule, f.key), 0) + 1
+        suppressions = []
+        for (rule, key), n in sorted(groups.items()):
+            prev = old.budget(rule, key) or {}
+            suppressions.append({
+                "rule": rule, "where": key, "max_sites": n,
+                "reason": prev.get("reason",
+                                   "FIXME: justify this exception")})
+        with open(path, "w") as f:
+            json.dump({"version": 1, "suppressions": suppressions}, f,
+                      indent=2)
+            f.write("\n")
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Top-level drivers
+# ---------------------------------------------------------------------------
+
+def run_audit(programs=None, mesh: str | None = "auto",
+              jaxpr: bool = True, lint: bool = True,
+              baseline: str | None = None) -> AuditReport:
+    """The full gate: trace the production step functions for every
+    requested workload (plus the `--mesh` variants when enough devices
+    are visible), lint the hot host modules, and split the deduped
+    findings against the checked-in baseline."""
+    t0 = time.perf_counter()
+    report = AuditReport()
+    raw: list[Finding] = []
+    if jaxpr:
+        from . import jaxpr_audit
+        fs, entries, notes = jaxpr_audit.audit_production(
+            programs=programs, mesh=mesh)
+        raw += fs
+        report.entries += entries
+        report.notes += notes
+    if lint:
+        from . import source_lint
+        raw += source_lint.lint_default_paths()
+        report.entries.append("source-lint")
+    sites = dedupe_sites(raw)
+    report.new, report.suppressed = apply_baseline(
+        sites, Baseline.load(baseline))
+    report.wall_s = time.perf_counter() - t0
+    return report
+
+
+_runner_audit_memo: dict = {}
+
+
+def audit_runner(runner, trace: bool = True) -> dict:
+    """The production self-report block (`static-audit` in results.json,
+    surfaced via TpuNetStats): audits the runner's OWN program/config —
+    jaxpr trace of its step functions under its actual donation/sharding
+    settings, source lint of the installed hot modules, and the runtime
+    config rules (donation-cpu-view). Memoized per config so repeated
+    runs in one process (test suites) pay the trace once. Never raises:
+    an audit failure must not fail a production run."""
+    t0 = time.perf_counter()
+    try:
+        from ..sim import donation_enabled
+        cfg_key = (type(runner.program).__name__, repr(runner.cfg),
+                   runner._shardings is not None, bool(trace),
+                   donation_enabled())
+        cached = _runner_audit_memo.get(cfg_key)
+        if cached is not None:
+            out = dict(cached)
+            out["wall-s"] = round(time.perf_counter() - t0, 3)
+            out["memoized"] = True
+            return out
+        raw: list[Finding] = []
+        notes: list[str] = []
+        if trace:
+            from . import jaxpr_audit
+            fs, _entries, notes = jaxpr_audit.audit_runner_steps(runner)
+            raw += fs
+        from . import source_lint
+        raw += source_lint.lint_default_paths()
+        # runtime config rule: the PR 2/4 CPU zero-copy hazard
+        import jax
+        if donation_enabled() and jax.default_backend() == "cpu":
+            raw.append(Finding(
+                rule="donation-cpu-view", entry="runtime-config",
+                where="sim.donation_enabled (MAELSTROM_DONATE forced on, "
+                      "cpu backend)",
+                key="maelstrom_tpu/sim.py:donation_enabled"))
+        new, suppressed = apply_baseline(dedupe_sites(raw),
+                                         Baseline.load())
+        counts: dict[str, int] = {}
+        for f in new + suppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        out = {"ok": not new,
+               "rules": dict(sorted(counts.items())),
+               "new": [f.as_dict() for f in new],
+               "suppressed-count": len(suppressed),
+               "traced": bool(trace)}
+        if notes:
+            out["notes"] = notes
+        _runner_audit_memo[cfg_key] = dict(out)
+        out["wall-s"] = round(time.perf_counter() - t0, 3)
+        return out
+    except Exception as e:       # the audit must never fail a real run
+        return {"ok": None, "audit-error": repr(e),
+                "wall-s": round(time.perf_counter() - t0, 3)}

@@ -1,0 +1,16 @@
+"""`python -m maelstrom_tpu.analyze` — the standalone CI gate.
+
+Identical to the `analyze` subcommand of `python -m maelstrom_tpu`;
+this module exists so CI scripts can run the gate without the full CLI
+(`scripts/check.sh` wires it next to ruff). Exit codes: 0 = clean,
+1 = new (non-baselined) findings, 2 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

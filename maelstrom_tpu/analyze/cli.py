@@ -1,0 +1,79 @@
+"""Argument parsing + entry point for the static-analysis gate.
+
+Shared by `python -m maelstrom_tpu analyze` (the CLI subcommand) and
+`python -m maelstrom_tpu.analyze` (the standalone module CI scripts
+call)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def add_analyze_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="Finding output format (json is one object: "
+                        "rules, new, suppressed, entries, wall-s)")
+    p.add_argument("--programs",
+                   help="Comma-separated workloads to trace (default: "
+                        "all built-in TPU node programs); 'none' skips "
+                        "the jaxpr audit entirely")
+    p.add_argument("--mesh", default="auto",
+                   help="Mesh variants: 'auto' (default) traces "
+                        "--mesh 1,2 for a pool-path and an edge-path "
+                        "program when >= 2 devices are visible; an "
+                        "explicit dp,sp spec applies to every program; "
+                        "'none' disables mesh variants")
+    p.add_argument("--no-lint", action="store_true",
+                   help="Skip the host-module source lint pass")
+    p.add_argument("--baseline",
+                   help="Alternate baseline.json (default: the "
+                        "checked-in analyze/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="Regenerate the baseline to cover every current "
+                        "finding (existing reasons are preserved; new "
+                        "entries get a FIXME reason to edit) and exit 0")
+
+
+def run_analyze(args) -> int:
+    from . import run_audit
+    programs = None
+    jaxpr = True
+    if args.programs:
+        if args.programs.strip() == "none":
+            jaxpr = False
+        else:
+            programs = [p.strip() for p in args.programs.split(",")
+                        if p.strip()]
+    mesh = None if args.mesh == "none" else args.mesh
+    try:
+        report = run_audit(programs=programs, mesh=mesh, jaxpr=jaxpr,
+                           lint=not args.no_lint, baseline=args.baseline)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        path = report.write_baseline(args.baseline)
+        print(f"wrote {path} ({len(report.new) + len(report.suppressed)} "
+              f"suppressed site(s)); edit any FIXME reasons before "
+              f"committing")
+        return 0
+    if args.format == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="maelstrom_tpu.analyze",
+        description="Static determinism & hot-path hazard audit "
+                    "(jaxpr trace of the production step functions + "
+                    "AST lint of the hot host modules), gated against "
+                    "analyze/baseline.json. See doc/analyze.md.")
+    add_analyze_args(p)
+    from ..util import honor_jax_platforms
+    honor_jax_platforms()
+    return run_analyze(p.parse_args(argv))

@@ -1,0 +1,422 @@
+"""Jaxpr-level hazard audit of the compiled hot loop.
+
+Traces step functions to ClosedJaxprs with `jax.make_jaxpr` (abstract
+evaluation only — nothing is compiled or executed) and walks every
+equation, recursing into `pjit`/`scan`/`while`/`cond` sub-jaxprs, to
+flag the hazard classes that have produced real soak-only bugs here:
+
+  - `unstable-sort`: a `sort` primitive with ``is_stable=False`` and no
+    index-tiebreak operand (``num_keys < 2``). Stability is NOT portable
+    across sharded sorts — the PR 2 delivery-order bug class. A lexsort
+    with an explicit ``arange`` tiebreak (num_keys >= 2) passes.
+  - `host-transfer`: `io_callback`/`pure_callback`/`debug_callback`/
+    `device_put` equations inside the traced step — each one is a host
+    round-trip per round instead of per dispatch.
+  - `dtype-widening`: `convert_element_type` widening a 32-bit type to
+    64 bits (x64 leaks, weak-type widening after canonicalization).
+  - `scatter-nonunique`: scatter-SET without ``unique_indices`` —
+    overlapping updates apply in compiler order (scatter-add/-mul/etc.
+    are combiner-commutative for ints and are not flagged).
+  - `donation-alias` / `donation-reshard`: donated example trees holding
+    one buffer twice, and donated carries whose pinned input sharding
+    differs from the output pin (a donated arg cannot be resharded).
+
+`audit_production` builds the REAL production step functions —
+`make_round_fn`/`make_scan_fn` over `TpuRunner`-constructed
+program/config/sharding state, exactly as `runner.tpu_runner` builds
+them — with donation forced ON (the TPU configuration) so the audit
+sees what production sees even when it runs on a CPU dev box.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass, field
+
+from . import Finding
+
+# Workload -> built-in TPU node program (the `--node tpu:<x>` namespace;
+# lin-mutex rides the lin-kv program).
+WORKLOAD_NODES = {
+    "broadcast": "tpu:broadcast", "g-set": "tpu:g-set",
+    "g-counter": "tpu:g-counter", "pn-counter": "tpu:pn-counter",
+    "lin-kv": "tpu:lin-kv", "txn-list-append": "tpu:txn-list-append",
+    "unique-ids": "tpu:unique-ids", "kafka": "tpu:kafka",
+    "txn-rw-register": "tpu:txn-rw-register",
+}
+DEFAULT_PROGRAMS = tuple(WORKLOAD_NODES)
+# mesh variants are traced for one pool-path and one edge-path program;
+# the sharding machinery is shared, so this covers the --mesh hot loop
+# without tripling the audit's wall time
+DEFAULT_MESH_PROGRAMS = ("lin-kv", "broadcast")
+
+HOST_TRANSFER_PRIMS = ("io_callback", "pure_callback", "debug_callback",
+                       "device_put")
+
+
+@dataclass
+class StepSpec:
+    """One auditable compiled entry point: the function, example args to
+    trace it with, and its donation/sharding contract (argument
+    `carry_argnum` is donated and comes back as output 0 under the same
+    pinned sharding — the contract every runner entry point follows)."""
+    name: str
+    fn: object
+    args: tuple
+    donate_argnums: tuple = ()
+    carry_argnum: int = 0
+    in_shardings: object = None     # sharding pytree for the carry, or None
+    out_shardings: object = None    # sharding pytree for output 0, or None
+    extra_findings: list = field(default_factory=list)
+
+
+def _repo_rel(path: str) -> str:
+    import maelstrom_tpu
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(maelstrom_tpu.__file__)))
+    ap = os.path.abspath(path)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root)
+    return os.path.basename(path)
+
+
+def _site(eqn):
+    """(display, key): `file:line (func)` and the line-free baseline key
+    `file:func`."""
+    from jax._src import source_info_util
+    summary = source_info_util.summarize(eqn.source_info)
+    # summarize() -> "path:line (function)" (or "unknown")
+    func = ""
+    path_line = summary
+    if " (" in summary and summary.endswith(")"):
+        path_line, func = summary[:-1].rsplit(" (", 1)
+    path, _, line = path_line.rpartition(":")
+    rel = _repo_rel(path) if path else path_line
+    display = f"{rel}:{line} ({func})" if func else f"{rel}:{line}"
+    return display, f"{rel}:{func or line}"
+
+
+def _iter_subjaxprs(params: dict):
+    from jax.core import ClosedJaxpr, Jaxpr
+    for v in params.values():
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        for item in items:
+            if isinstance(item, ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, Jaxpr):
+                yield item
+
+
+def audit_jaxpr(jaxpr, entry: str = "") -> list[Finding]:
+    """Walks one (open) jaxpr recursively and returns raw findings
+    (per-equation; `analyze.dedupe_sites` collapses duplicates)."""
+    import numpy as np
+    out: list[Finding] = []
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            p = eqn.primitive.name
+            if p == "sort":
+                if not eqn.params.get("is_stable") and \
+                        int(eqn.params.get("num_keys", 1)) < 2:
+                    where, key = _site(eqn)
+                    out.append(Finding(
+                        rule="unstable-sort", entry=entry, where=where,
+                        key=key,
+                        detail=f"sort is_stable=False "
+                               f"num_keys={eqn.params.get('num_keys', 1)}"))
+            elif p in HOST_TRANSFER_PRIMS:
+                where, key = _site(eqn)
+                out.append(Finding(rule="host-transfer", entry=entry,
+                                   where=where, key=key, detail=p))
+            elif p == "convert_element_type":
+                try:
+                    old = np.dtype(eqn.invars[0].aval.dtype)
+                    new = np.dtype(eqn.params["new_dtype"])
+                except (TypeError, AttributeError, KeyError):
+                    continue
+                if (new.itemsize > old.itemsize and new.itemsize >= 8
+                        and new.kind in "fiuc"):
+                    where, key = _site(eqn)
+                    out.append(Finding(
+                        rule="dtype-widening", entry=entry, where=where,
+                        key=key, detail=f"{old.name} -> {new.name}"))
+            elif p == "scatter":
+                # plain scatter = .at[].set — order-dependent under
+                # overlap. Combiner scatters (-add/-mul/-min/-max) are
+                # commutative over ints and stay un-flagged.
+                if not eqn.params.get("unique_indices"):
+                    where, key = _site(eqn)
+                    out.append(Finding(
+                        rule="scatter-nonunique", entry=entry,
+                        where=where, key=key,
+                        detail=f"mode={eqn.params.get('mode')}"))
+            for sub in _iter_subjaxprs(eqn.params):
+                visit(sub)
+
+    visit(jaxpr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Donation checks (example-tree level: aliasing is invisible in a jaxpr)
+# ---------------------------------------------------------------------------
+
+def _buffer_token(leaf):
+    """Best-effort identity of a leaf's underlying buffer."""
+    try:
+        return ("ptr", leaf.unsafe_buffer_pointer())
+    except Exception:
+        pass
+    try:
+        iface = leaf.__array_interface__
+        return ("np", iface["data"][0])
+    except Exception:
+        return ("id", id(leaf))
+
+
+def check_donation_alias(spec: StepSpec) -> list[Finding]:
+    """Two leaves of a donated argument sharing one buffer: XLA rejects
+    the dispatch outright (`f(donate(a), donate(a))`), and the usual
+    cause is state built without `sim.dealias` — the PR 2 bug class."""
+    import jax
+    out: list[Finding] = []
+    seen: dict = {}
+    for argnum in spec.donate_argnums:
+        for leaf in jax.tree.leaves(spec.args[argnum]):
+            if getattr(leaf, "size", 1) == 0:
+                continue            # zero-byte buffers may legally share
+            tok = _buffer_token(leaf)
+            if tok in seen:
+                out.append(Finding(
+                    rule="donation-alias", entry=spec.name,
+                    where=f"{spec.name} donated arg {argnum}",
+                    key=f"entry:{spec.name}:donation-alias",
+                    detail=f"duplicate buffer {tok[0]} in donated tree "
+                           f"(leaf shapes {seen[tok]} and "
+                           f"{getattr(leaf, 'shape', ())})"))
+            else:
+                seen[tok] = getattr(leaf, "shape", ())
+    return out
+
+
+def check_donation_reshard_pjit(closed, spec: StepSpec):
+    """Reads the REAL donation/sharding contract off the traced pjit
+    equation (`donated_invars`, resolved `in_shardings`/`out_shardings`)
+    and compares the pins positionally over the donated carry prefix —
+    the entry-point contract is carry = argument 0 = output 0, so leaf i
+    of the donated region must come back under the same pin. A donated
+    argument cannot be resharded at the next call boundary; a mismatch
+    forces a copy of a buffer the caller no longer owns.
+
+    Returns None when the trace exposes nothing comparable (not a
+    single-pjit trace, nothing donated, or unresolved shardings) — the
+    caller then falls back to the spec-declared pins."""
+    from jax.sharding import Sharding
+    eqns = closed.jaxpr.eqns
+    if len(eqns) != 1 or eqns[0].primitive.name != "pjit":
+        return None
+    params = eqns[0].params
+    donated = params.get("donated_invars") or ()
+    ins = params.get("in_shardings") or ()
+    outs = params.get("out_shardings") or ()
+    bad = []
+    comparable = False
+    for i, don in enumerate(donated):
+        if not don or i >= len(ins) or i >= len(outs):
+            continue
+        a, b = ins[i], outs[i]
+        if not (isinstance(a, Sharding) and isinstance(b, Sharding)):
+            continue                    # unresolved/unspecified pin
+        comparable = True
+        if a != b:
+            bad.append((i, a, b))
+    if not comparable:
+        return None
+    if not bad:
+        return []
+    i, a, b = bad[0]
+    return [Finding(
+        rule="donation-reshard", entry=spec.name,
+        where=f"{spec.name} carry leaf {i}",
+        key=f"entry:{spec.name}:donation-reshard",
+        detail=f"{len(bad)} leaf pin(s) differ, first: in={a} out={b}")]
+
+
+def check_donation_reshard(spec: StepSpec) -> list[Finding]:
+    """Spec-declared fallback for entry points whose trace exposes no
+    resolved pjit pins: compares the shardings the caller SAYS it pins.
+    Weaker than the pjit-param check (it cannot catch a builder that
+    diverges from its declaration), hence used only as the fallback."""
+    import jax
+    if spec.in_shardings is None or spec.out_shardings is None:
+        return []
+    ins = jax.tree.leaves(spec.in_shardings)
+    outs = jax.tree.leaves(spec.out_shardings)
+    bad = []
+    for i, (a, b) in enumerate(zip(ins, outs)):
+        if a != b:
+            bad.append((i, a, b))
+    if not bad:
+        return []
+    i, a, b = bad[0]
+    return [Finding(
+        rule="donation-reshard", entry=spec.name,
+        where=f"{spec.name} carry leaf {i}",
+        key=f"entry:{spec.name}:donation-reshard",
+        detail=f"{len(bad)} leaf pin(s) differ, first: in={a} out={b}")]
+
+
+def audit_step(spec: StepSpec) -> list[Finding]:
+    """Audits one entry point: donation checks on the example tree, then
+    the recursive jaxpr walk of the abstract trace. The reshard check
+    prefers the REAL pins on the traced pjit equation and falls back to
+    the spec-declared ones."""
+    import jax
+    findings = list(spec.extra_findings)
+    findings += check_donation_alias(spec)
+    closed = jax.make_jaxpr(spec.fn)(*spec.args)
+    reshard = check_donation_reshard_pjit(closed, spec)
+    if reshard is None:
+        reshard = check_donation_reshard(spec)
+    findings += reshard
+    findings += audit_jaxpr(closed.jaxpr, entry=spec.name)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Building the REAL production step functions
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _force_donation(on: bool = True):
+    """Audit-as-TPU: `sim.donation_enabled` consults MAELSTROM_DONATE at
+    every call, so pinning it while the step functions are BUILT makes a
+    CPU dev box trace exactly the donating TPU configuration."""
+    prev = os.environ.get("MAELSTROM_DONATE")
+    os.environ["MAELSTROM_DONATE"] = "1" if on else "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            del os.environ["MAELSTROM_DONATE"]
+        else:
+            os.environ["MAELSTROM_DONATE"] = prev
+
+
+def production_step_specs(workload: str, mesh: str | None = None,
+                          donate: bool = True) -> list[StepSpec]:
+    """Builds the production `round_fn` / `scan_fn` (plain and journaled)
+    for one workload the exact way `runner.tpu_runner` does — same
+    program, NetConfig, capacities, shardings, donation — and returns
+    them as auditable StepSpecs. With `mesh`, the runner's `--mesh`
+    sharding pins are applied and traced."""
+    import jax.numpy as jnp
+
+    from .. import core
+    from ..net import tpu as T
+    from ..runner.tpu_runner import TpuRunner
+    from ..sim import make_round_fn, make_scan_fn
+
+    node = WORKLOAD_NODES.get(workload)
+    if node is None:
+        raise ValueError(f"unknown workload {workload!r}; expected one of "
+                         f"{sorted(WORKLOAD_NODES)}")
+    opts = {"workload": workload, "node": node, "node_count": 5,
+            "time_limit": 1.0}
+    if mesh:
+        opts["mesh"] = mesh
+    with _force_donation(donate):
+        test = core.build_test(opts)
+        runner = TpuRunner(test)
+        inject = T.Msgs.empty(max(runner.concurrency, 1))
+        sh = runner._shardings
+        sim_sh, out0_sh = (sh[0], sh[0]) if sh is not None else (None, None)
+        tag = f"{workload}{'@mesh=' + mesh if mesh else ''}"
+        common = dict(donate_argnums=(0,) if donate else (),
+                      in_shardings=sim_sh, out_shardings=out0_sh)
+        specs = [
+            StepSpec(name=f"round_fn[{tag}]",
+                     fn=make_round_fn(runner.program, runner.cfg,
+                                      donate=donate, shardings=sh),
+                     args=(runner.sim, inject), **common),
+            StepSpec(name=f"scan_fn[{tag}]",
+                     fn=make_scan_fn(runner.program, runner.cfg,
+                                     reply_cap=runner.reply_log_cap,
+                                     donate=donate, shardings=sh),
+                     args=(runner.sim, inject, jnp.int32(8), True),
+                     **common),
+            StepSpec(name=f"scan_journal_fn[{tag}]",
+                     fn=make_scan_fn(runner.program, runner.cfg,
+                                     journal_cap=runner.journal_scan_cap,
+                                     reply_cap=runner.reply_log_cap,
+                                     donate=donate, shardings=sh),
+                     args=(runner.sim, inject, jnp.int32(8), True),
+                     **common),
+        ]
+    return specs
+
+
+def audit_production(programs=None, mesh: str | None = "auto"):
+    """Traces and audits the production step functions for each
+    workload. `mesh="auto"` adds `--mesh 1,2` variants for
+    DEFAULT_MESH_PROGRAMS when >= 2 devices are visible; an explicit
+    mesh spec is applied to every requested program; None disables mesh
+    variants. Returns (findings, entry_names, notes)."""
+    import jax
+    programs = list(programs or DEFAULT_PROGRAMS)
+    findings: list[Finding] = []
+    entries: list[str] = []
+    notes: list[str] = []
+
+    jobs: list[tuple[str, str | None]] = [(p, None) for p in programs]
+    if mesh == "auto":
+        if jax.device_count() >= 2:
+            jobs += [(p, "1,2") for p in DEFAULT_MESH_PROGRAMS
+                     if p in programs]
+        else:
+            notes.append("mesh variants skipped: < 2 visible devices "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=2 to audit them on CPU)")
+    elif mesh:
+        jobs += [(p, mesh) for p in programs]
+
+    for workload, mesh_spec in jobs:
+        for spec in production_step_specs(workload, mesh=mesh_spec):
+            findings += audit_step(spec)
+            entries.append(spec.name)
+    return findings, entries, notes
+
+
+def audit_runner_steps(runner):
+    """Self-report variant: audits a LIVE runner's own program/config
+    under its actual donation setting (no as-TPU forcing — the block
+    reports what this run really executed)."""
+    import jax.numpy as jnp
+
+    from ..net import tpu as T
+    from ..sim import donation_enabled, make_round_fn, make_scan_fn
+
+    donate = donation_enabled()
+    inject = T.Msgs.empty(max(runner.concurrency, 1))
+    sh = runner._shardings
+    sim_sh = sh[0] if sh is not None else None
+    tag = type(runner.program).__name__
+    common = dict(donate_argnums=(0,) if donate else (),
+                  in_shardings=sim_sh, out_shardings=sim_sh)
+    specs = [
+        StepSpec(name=f"round_fn[{tag}]",
+                 fn=make_round_fn(runner.program, runner.cfg,
+                                  donate=donate, shardings=sh),
+                 args=(runner.sim, inject), **common),
+        StepSpec(name=f"scan_fn[{tag}]",
+                 fn=make_scan_fn(runner.program, runner.cfg,
+                                 reply_cap=runner.reply_log_cap,
+                                 donate=donate, shardings=sh),
+                 args=(runner.sim, inject, jnp.int32(8), True), **common),
+    ]
+    findings: list[Finding] = []
+    for spec in specs:
+        findings += audit_step(spec)
+    return findings, [s.name for s in specs], []

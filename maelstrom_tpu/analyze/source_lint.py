@@ -1,0 +1,176 @@
+"""AST lint of the hot HOST modules for Python-level nondeterminism.
+
+The jaxpr audit covers the compiled step; this pass covers the host
+code wrapped around it — the paths that must replay byte-identically
+across checkpoint/resume and scan-vs-run equivalence:
+
+  - `np-unstable-sort`: module-form `np.argsort`/`np.sort` without
+    ``kind="stable"`` — numpy defaults to introsort, so equal keys land
+    in arbitrary order. Method-form sorts are deliberately exempt: jax
+    arrays' method sorts are stable by default (and device sorts are
+    the jaxpr pass's job), `list.sort` is stable.
+  - `set-iteration`: a `for` loop or comprehension iterating directly
+    over a set literal / `set(...)` / set comprehension (hash-seed
+    dependent order) without a `sorted(...)` wrapper.
+  - `wall-clock`: `time.time()`/`time.time_ns()`/`datetime.now()` —
+    replayed paths must read virtual time (`perf_counter`/`monotonic`
+    stay legal: they only ever feed duration accounting).
+  - `unseeded-random`: module-level `random.<draw>()` calls — the
+    process-global RNG is unseeded; deterministic paths draw from
+    seeded `random.Random` instances.
+
+Pure stdlib (`ast`), no imports of the linted modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+
+from . import Finding
+
+# The replay-critical host modules (relative to the package directory):
+# the runner loop, both network paths, the sim composition, nemesis
+# scheduling, and the history/analysis pairing + screening paths.
+DEFAULT_LINT_PATHS = (
+    "runner", "net", "sim.py", "nemesis.py", "history.py",
+    "checkers/pipeline.py", "checkers/linearizable.py",
+)
+
+_RANDOM_DRAWS = {"random", "randint", "randrange", "choice", "choices",
+                 "shuffle", "sample", "uniform", "gauss", "betavariate",
+                 "expovariate", "getrandbits", "triangular"}
+_WALL_CLOCK = {("time", "time"), ("time", "time_ns"),
+               ("datetime", "now"), ("datetime", "utcnow")}
+
+
+def _is_name(node, name: str) -> bool:
+    return isinstance(node, ast.Name) and node.id == name
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.findings: list[Finding] = []
+        self._func_stack: list[str] = []
+
+    # --- helpers ---
+
+    def _func(self) -> str:
+        return self._func_stack[-1] if self._func_stack else "<module>"
+
+    def _add(self, rule: str, node, detail: str):
+        line = getattr(node, "lineno", 0)
+        excerpt = ""
+        if 0 < line <= len(self.lines):
+            excerpt = self.lines[line - 1].strip()[:80]
+        self.findings.append(Finding(
+            rule=rule, entry="source-lint",
+            where=f"{self.relpath}:{line} ({self._func()})",
+            key=f"{self.relpath}:{self._func()}",
+            detail=detail or excerpt))
+
+    def _visit_func(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # --- rules ---
+
+    def _check_iterable(self, it):
+        """Direct iteration over an unordered set."""
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            self._add("set-iteration", it,
+                      "iterating a set literal/comprehension")
+        elif isinstance(it, ast.Call) and (
+                _is_name(it.func, "set") or _is_name(it.func, "frozenset")):
+            self._add("set-iteration", it,
+                      f"iterating {it.func.id}(...) directly")
+
+    def visit_For(self, node):
+        self._check_iterable(node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        self._check_iterable(node.iter)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            kind = next((kw for kw in node.keywords if kw.arg == "kind"),
+                        None)
+            stable = (kind is not None
+                      and isinstance(kind.value, ast.Constant)
+                      and kind.value.value == "stable")
+            if f.attr in ("argsort", "sort") and (
+                    _is_name(f.value, "np") or _is_name(f.value, "numpy")):
+                # module-form only: `x.argsort()` method calls are NOT
+                # flagged — jax arrays' method sorts are stable by
+                # default (device sorts are the jaxpr pass's job) and
+                # list.sort is stable, so a generic method rule would
+                # produce false errors on legitimate code
+                if not stable:
+                    self._add("np-unstable-sort", node,
+                              f"np.{f.attr} without kind=\"stable\"")
+            elif isinstance(f.value, ast.Name) and \
+                    (f.value.id, f.attr) in _WALL_CLOCK:
+                self._add("wall-clock", node, f"{f.value.id}.{f.attr}()")
+            elif _is_name(f.value, "random") and f.attr in _RANDOM_DRAWS:
+                self._add("unseeded-random", node, f"random.{f.attr}()")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, relpath: str) -> list[Finding]:
+    tree = ast.parse(source, filename=relpath)
+    v = _Visitor(relpath, source.splitlines())
+    v.visit(tree)
+    return v.findings
+
+
+def lint_file(path: str, relpath: str | None = None) -> list[Finding]:
+    with open(path) as f:
+        source = f.read()
+    return lint_source(source, relpath or path)
+
+
+def lint_paths(paths, package_dir: str | None = None) -> list[Finding]:
+    """Lints files/directories given relative to the package dir (or
+    absolute). Directories recurse over ``*.py``."""
+    if package_dir is None:                 # analyze/ -> maelstrom_tpu/
+        package_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    pkg_parent = os.path.dirname(package_dir)
+    findings: list[Finding] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(package_dir, p)
+        if os.path.isdir(full):
+            files = sorted(
+                os.path.join(r, fn)
+                for r, _dirs, fns in os.walk(full)
+                for fn in fns if fn.endswith(".py"))
+        else:
+            files = [full]
+        for fpath in files:
+            rel = os.path.relpath(fpath, pkg_parent)
+            findings += lint_file(fpath, rel)
+    return findings
+
+
+@functools.lru_cache(maxsize=1)
+def _lint_default_cached() -> tuple:
+    return tuple(lint_paths(DEFAULT_LINT_PATHS))
+
+
+def lint_default_paths() -> list[Finding]:
+    """Lint of the shipped hot modules. Cached for the process lifetime
+    — the sources cannot change under a running process, and the
+    self-report block would otherwise re-parse ~20 modules per run
+    config (callers only read; `dedupe_sites` copies before any
+    mutation)."""
+    return list(_lint_default_cached())

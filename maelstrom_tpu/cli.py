@@ -127,6 +127,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Write checkpoints synchronously on the main "
                         "thread instead of the background writer "
                         "(escape hatch; saves then block dispatching)")
+    t.add_argument("--no-audit", action="store_true",
+                   help="Skip the static-audit self-report block "
+                        "(TPU path: results.json normally carries rule "
+                        "counts from a trace-time hazard audit of this "
+                        "run's own step functions — doc/analyze.md)")
     t.add_argument("--on-preempt", choices=["checkpoint", "abort"],
                    default="checkpoint",
                    help="What SIGTERM/SIGINT does to a TPU-path run: "
@@ -164,6 +169,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "count (default 5)")
     f.add_argument("--values", type=int, default=32)
     f.add_argument("--seed", type=int, default=0)
+
+    az = sub.add_parser(
+        "analyze",
+        help="Static determinism & hot-path audit: trace the production "
+             "step functions and lint the hot host modules for "
+             "unstable-sort / host-transfer / dtype-promotion / "
+             "donation hazards, gated on analyze/baseline.json "
+             "(doc/analyze.md)")
+    from .analyze.cli import add_analyze_args
+    add_analyze_args(az)
 
     pa = sub.add_parser(
         "parity", help="Reproduce the reference's protocol-efficiency "
@@ -223,6 +238,13 @@ def opts_from_args(args) -> dict:
         "sync_checkpoint": args.sync_checkpoint,
         "on_preempt": args.on_preempt,
         "no_overlap": args.no_overlap,
+        # static-audit self-report (doc/analyze.md): CLI-driven runs
+        # trace their own step functions into a `static-audit` results
+        # block; --no-audit drops the block entirely (library/test
+        # callers get the cheap lint-only block unless they opt in to
+        # the trace via audit_trace)
+        "audit": not args.no_audit,
+        "audit_trace": not args.no_audit,
     }
     # TPU-path performance knobs: only forwarded when given, so the
     # runner's own defaults stay in one place
@@ -368,6 +390,10 @@ def main(argv=None) -> int:
         from .fuzz import main as fuzz_main
         return fuzz_main(args.nodes, args.values, args.seed,
                          program=args.program)
+
+    if args.cmd == "analyze":
+        from .analyze.cli import run_analyze
+        return run_analyze(args)
 
     if args.cmd == "parity":
         from .parity import main as parity_main

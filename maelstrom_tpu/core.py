@@ -55,6 +55,12 @@ DEFAULTS = dict(
     # exits EXIT_PREEMPTED for a supervised --resume relaunch)
     checkpoint_every=None, resume=None, sync_checkpoint=False,
     on_preempt="checkpoint",
+    # static-audit self-report (doc/analyze.md): TPU-path results carry
+    # a `static-audit` block (rule counts, baseline-suppressed count,
+    # audit wall time). `audit` gates the block entirely; `audit_trace`
+    # additionally traces this run's own step functions (the CLI turns
+    # it on; library/test callers keep the cheap lint+config-only block)
+    audit=True, audit_trace=False,
 )
 
 

@@ -385,7 +385,11 @@ def _deliver_due(cfg: NetConfig, net: NetState):
             (jnp.arange(P, dtype=I32),
              jnp.where(to_client, pool.due, INT32_MAX)))[:CC]
         client_msgs = pool.at_rows(corder).replace(valid=to_client[corder])
-        c_taken = jnp.zeros(P, bool).at[corder].set(client_msgs.valid)
+        # corder is a prefix of a permutation: indices are unique and
+        # in-bounds, so tell XLA (the scatter is otherwise flagged
+        # order-dependent by the static auditor, like `taken` above)
+        c_taken = jnp.zeros(P, bool).at[corder].set(client_msgs.valid,
+                                                    unique_indices=True)
     else:
         # count-only mode: consume client messages without materializing
         client_msgs = Msgs.empty(0)

@@ -251,6 +251,19 @@ class TpuNetStats(Checker):
             out.update(tr.as_dict())
         if journal is not None:
             out["journal"] = journal.counts()
+        # static-audit self-report (doc/analyze.md): rule counts from
+        # the trace-time hazard audit of this run's own configuration.
+        # Purely informational — the CI gate (`maelstrom_tpu analyze`)
+        # owns failing on new findings, a production run only REPORTS
+        # them — so it never flips `valid`. MAELSTROM_AUDIT=0 or
+        # `audit: False` disables the block; `audit_trace` (on for CLI
+        # runs) adds the per-config jaxpr trace of round_fn/scan_fn.
+        import os as _os
+        if test.get("audit", True) and \
+                _os.environ.get("MAELSTROM_AUDIT") != "0":
+            from ..analyze import audit_runner
+            out["static-audit"] = audit_runner(
+                self.runner, trace=bool(test.get("audit_trace")))
         out["valid"] = bool(ok)
         return out
 

@@ -1,0 +1,8 @@
+"""Test-support utilities shipped with the package.
+
+`minihyp` is a tiny, dependency-free property-testing fallback with a
+hypothesis-compatible surface (`given`/`settings`/`strategies`) for the
+subset the oracle suites use, so property tests run even on minimal
+installs. When the real `hypothesis` is importable it should always be
+preferred — it shrinks failures; this fallback only reports them.
+"""

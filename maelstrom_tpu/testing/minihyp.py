@@ -1,0 +1,177 @@
+"""A minimal, deterministic property-testing fallback.
+
+Implements the exact hypothesis subset the repo's oracle suites use —
+`@settings(max_examples=, deadline=)`, `@given(**strategies)`, and the
+`integers`/`booleans`/`tuples`/`lists`/`dictionaries` strategies — in
+~150 lines of stdlib Python, so `tests/test_edge_oracle.py` and
+`tests/test_tpu_net_oracle.py` run on images where `hypothesis` isn't
+baked in (the dev/test extra in pyproject.toml installs the real thing
+where pip is available).
+
+Differences from hypothesis, deliberately accepted:
+  - no shrinking: a failing example is re-raised with the generated
+    inputs attached, not minimized;
+  - examples are drawn from a PRNG seeded by the test's qualified name
+    (md5, not `hash()` — PYTHONHASHSEED-independent), so every run of a
+    given test sees the same schedule: failures reproduce exactly;
+  - example 0 is always the all-minimal draw (bounds' minimums, empty
+    collections) — the cheapest regression canary first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+
+__all__ = ["given", "settings", "strategies", "MiniHypFailure"]
+
+
+class Strategy:
+    def example(self, rng: random.Random):
+        raise NotImplementedError
+
+    def minimal(self):
+        raise NotImplementedError
+
+
+class _Integers(Strategy):
+    def __init__(self, min_value, max_value):
+        self.min_value, self.max_value = int(min_value), int(max_value)
+
+    def example(self, rng):
+        return rng.randint(self.min_value, self.max_value)
+
+    def minimal(self):
+        return self.min_value
+
+
+class _Booleans(Strategy):
+    def example(self, rng):
+        return rng.random() < 0.5
+
+    def minimal(self):
+        return False
+
+
+class _Tuples(Strategy):
+    def __init__(self, *strats):
+        self.strats = strats
+
+    def example(self, rng):
+        return tuple(s.example(rng) for s in self.strats)
+
+    def minimal(self):
+        return tuple(s.minimal() for s in self.strats)
+
+
+class _Lists(Strategy):
+    def __init__(self, elements, min_size=0, max_size=10):
+        self.elements = elements
+        self.min_size, self.max_size = int(min_size), int(max_size)
+
+    def example(self, rng):
+        n = rng.randint(self.min_size, self.max_size)
+        return [self.elements.example(rng) for _ in range(n)]
+
+    def minimal(self):
+        return [self.elements.minimal() for _ in range(self.min_size)]
+
+
+class _Dicts(Strategy):
+    def __init__(self, keys, values, min_size=0, max_size=10):
+        self.keys, self.values = keys, values
+        self.min_size, self.max_size = int(min_size), int(max_size)
+
+    def example(self, rng):
+        n = rng.randint(self.min_size, self.max_size)
+        out = {}
+        for _ in range(4 * n):          # bounded dedup attempts
+            if len(out) >= n:
+                break
+            out[self.keys.example(rng)] = self.values.example(rng)
+        return out
+
+    def minimal(self):
+        return {}
+
+
+class strategies:
+    """Namespace mirroring `hypothesis.strategies` for the used subset
+    (`from ... import strategies as st` keeps reading naturally)."""
+
+    @staticmethod
+    def integers(min_value, max_value):
+        return _Integers(min_value, max_value)
+
+    @staticmethod
+    def booleans():
+        return _Booleans()
+
+    @staticmethod
+    def tuples(*strats):
+        return _Tuples(*strats)
+
+    @staticmethod
+    def lists(elements, min_size=0, max_size=10):
+        return _Lists(elements, min_size=min_size, max_size=max_size)
+
+    @staticmethod
+    def dictionaries(keys, values, min_size=0, max_size=10):
+        return _Dicts(keys, values, min_size=min_size, max_size=max_size)
+
+
+class MiniHypFailure(AssertionError):
+    pass
+
+
+def settings(max_examples: int = 20, deadline=None, **_ignored):
+    """Stores the example budget on the (already `given`-wrapped)
+    function. `deadline` and unknown hypothesis knobs are accepted and
+    ignored."""
+    def deco(fn):
+        fn._minihyp_max_examples = int(max_examples)
+        return fn
+    return deco
+
+
+def _seed_for(qualname: str, i: int) -> random.Random:
+    digest = hashlib.md5(f"minihyp:{qualname}:{i}".encode()).hexdigest()
+    return random.Random(int(digest, 16))
+
+
+def given(**strats):
+    """Keyword-only `@given`: runs the test once per example with fresh
+    draws for every strategy. The wrapper takes no parameters, so pytest
+    never mistakes strategy names for fixtures."""
+    bad = [k for k, s in strats.items() if not isinstance(s, Strategy)]
+    if bad:
+        raise TypeError(f"given() expects minihyp strategies, got "
+                        f"non-strategies for {bad}")
+
+    def deco(fn):
+        def wrapper():
+            n = getattr(wrapper, "_minihyp_max_examples", 20)
+            cap = os.environ.get("MAELSTROM_MINIHYP_MAX_EXAMPLES")
+            if cap:
+                n = min(n, int(cap))
+            qual = getattr(fn, "__qualname__", fn.__name__)
+            for i in range(n):
+                if i == 0:
+                    kwargs = {k: s.minimal() for k, s in strats.items()}
+                else:
+                    rng = _seed_for(qual, i)
+                    kwargs = {k: s.example(rng) for k, s in strats.items()}
+                try:
+                    fn(**kwargs)
+                except Exception as e:
+                    shown = {k: repr(v)[:400] for k, v in kwargs.items()}
+                    raise MiniHypFailure(
+                        f"{qual} failed on example {i}/{n} (no "
+                        f"shrinking — minihyp fallback): {shown}") from e
+        wrapper.__name__ = fn.__name__
+        wrapper.__qualname__ = getattr(fn, "__qualname__", fn.__name__)
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__module__ = fn.__module__
+        return wrapper
+    return deco

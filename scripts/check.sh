@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Repo static gate, runnable outside pytest (CI wires this next to the
+# tier-1 suite):
+#
+#   1. `python -m maelstrom_tpu.analyze` — trace the production
+#      round_fn/scan_fn (plain + --mesh 1,2 on a forced 2-device CPU
+#      mesh) and lint the hot host modules; fails on any finding not in
+#      analyze/baseline.json (doc/analyze.md).
+#   2. `ruff check` — the generic-Python lint floor (pyproject.toml
+#      [tool.ruff]); skipped with a notice when ruff isn't installed
+#      (pip install -e .[dev]), since minimal images don't bake it in.
+#
+# Env knobs: ANALYZE_ARGS adds CLI flags (e.g. --programs lin-kv for a
+# quick pass), JAX_PLATFORMS/XLA_FLAGS override the defaults below.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# two virtual CPU devices so the --mesh variants are audited everywhere
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=2}"
+
+echo "== static audit: python -m maelstrom_tpu.analyze =="
+# shellcheck disable=SC2086
+python -m maelstrom_tpu.analyze --format "${ANALYZE_FORMAT:-text}" \
+    ${ANALYZE_ARGS:-}
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check =="
+    ruff check .
+else
+    echo "== ruff not installed: skipping (pip install -e .[dev]) =="
+fi
+
+echo "== static gate clean =="

@@ -14,19 +14,24 @@ from __future__ import annotations
 
 import pytest
 
-pytest.importorskip(
-    "hypothesis",
-    reason="property tests need hypothesis; skip where it isn't baked in")
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    # minimal installs: the vendored fallback backend (same surface, no
+    # shrinking) keeps the property suite running where hypothesis isn't
+    # baked in; importorskip still guards truly bare environments
+    minihyp = pytest.importorskip(
+        "maelstrom_tpu.testing.minihyp",
+        reason="property tests need hypothesis or the vendored fallback")
+    given, settings, st = (minihyp.given, minihyp.settings,
+                           minihyp.strategies)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
 
 from maelstrom_tpu.net import static as S
 from maelstrom_tpu.net.tpu import I32
-
-pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
 
 # a fixed 4-node line: n0 - n1 - n2 - n3
 NEIGHBORS = np.array([[1, -1], [0, 2], [1, 3], [2, -1]], np.int32)

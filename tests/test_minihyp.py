@@ -1,0 +1,98 @@
+"""The vendored property-testing fallback (maelstrom_tpu.testing.minihyp).
+
+The oracle suites run under real hypothesis when it's installed and
+under minihyp otherwise; these tests pin the fallback's contract —
+hypothesis-compatible surface, deterministic example schedules, failure
+reporting with the generated inputs attached."""
+
+from __future__ import annotations
+
+import pytest
+
+from maelstrom_tpu.testing import minihyp
+from maelstrom_tpu.testing.minihyp import (MiniHypFailure, given, settings,
+                                           strategies as st)
+
+
+def test_examples_are_deterministic_across_runs():
+    seen = []
+
+    @settings(max_examples=10, deadline=None)
+    @given(xs=st.lists(st.tuples(st.integers(0, 9), st.booleans()),
+                       max_size=6),
+           n=st.integers(-3, 3))
+    def collect(xs, n):
+        seen.append((tuple(xs), n))
+
+    collect()
+    first = list(seen)
+    seen.clear()
+    collect()
+    assert seen == first
+    assert len(first) == 10
+    assert len(set(first)) > 1, "examples never varied"
+
+
+def test_first_example_is_minimal():
+    seen = []
+
+    @settings(max_examples=3, deadline=None)
+    @given(xs=st.lists(st.integers(5, 9), min_size=2, max_size=6),
+           d=st.dictionaries(st.integers(0, 3), st.booleans(), max_size=4),
+           b=st.booleans())
+    def collect(xs, d, b):
+        seen.append((xs, d, b))
+
+    collect()
+    assert seen[0] == ([5, 5], {}, False)
+
+
+def test_bounds_respected():
+    @settings(max_examples=50, deadline=None)
+    @given(n=st.integers(2, 6),
+           xs=st.lists(st.integers(0, 5), min_size=16, max_size=16))
+    def check(n, xs):
+        assert 2 <= n <= 6
+        assert len(xs) == 16 and all(0 <= x <= 5 for x in xs)
+
+    check()
+
+
+def test_failure_reports_generated_inputs():
+    @settings(max_examples=20, deadline=None)
+    @given(n=st.integers(0, 100))
+    def boom(n):
+        assert n < 30
+
+    with pytest.raises(MiniHypFailure, match="failed on example"):
+        boom()
+
+
+def test_wrapper_hides_strategy_params_from_pytest():
+    """pytest must not mistake strategy names for fixtures: the wrapper
+    takes no parameters."""
+    import inspect
+
+    @given(n=st.integers(0, 1))
+    def t(n):
+        pass
+
+    assert inspect.signature(t).parameters == {}
+
+
+def test_example_cap_env(monkeypatch):
+    calls = []
+
+    @settings(max_examples=40, deadline=None)
+    @given(n=st.integers(0, 9))
+    def collect(n):
+        calls.append(n)
+
+    monkeypatch.setenv("MAELSTROM_MINIHYP_MAX_EXAMPLES", "7")
+    collect()
+    assert len(calls) == 7
+
+
+def test_given_rejects_non_strategies():
+    with pytest.raises(TypeError, match="non-strategies"):
+        minihyp.given(x=42)

@@ -253,8 +253,9 @@ def _run_pair(opts):
 
 
 def _comparable(res):
-    """Checker results minus wall-clock-dependent accounting."""
-    drop = {"host-blocked-s", "host-overlapped-s"}
+    """Checker results minus wall-clock-dependent accounting (the
+    static-audit self-report carries audit wall time + memo state)."""
+    drop = {"host-blocked-s", "host-overlapped-s", "static-audit"}
     return {name: ({k: v for k, v in r.items() if k not in drop}
                    if isinstance(r, dict) else r)
             for name, r in res.items()

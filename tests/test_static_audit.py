@@ -1,0 +1,391 @@
+"""The static determinism & hot-path auditor (maelstrom_tpu.analyze).
+
+Two halves, mirroring the acceptance contract:
+
+  - seeded-violation fixtures: for every rule, a minimal step function
+    (or source snippet) that CONTAINS the hazard, asserting the rule id
+    fires exactly once — including a regression fixture reproducing the
+    PR 2 unstable-delivery-sort-under-mesh bug shape;
+  - the zero-new-findings gate: the REAL production `round_fn`/`scan_fn`
+    (plain and 2-device `--mesh`) trace clean against the checked-in
+    `analyze/baseline.json`, and the hot host modules lint clean.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import maelstrom_tpu.analyze as analyze
+from maelstrom_tpu.analyze import (Baseline, Finding, apply_baseline,
+                                   dedupe_sites, jaxpr_audit, source_lint)
+from maelstrom_tpu.analyze.jaxpr_audit import StepSpec, audit_step
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# seeded-violation fixtures: each hazard fires its rule id exactly once
+# ---------------------------------------------------------------------------
+
+def test_fixture_unstable_sort_fires_once():
+    spec = StepSpec(name="fx", fn=lambda x: jnp.argsort(x, stable=False),
+                    args=(jnp.arange(8, dtype=jnp.int32),))
+    assert rules_of(audit_step(spec)) == ["unstable-sort"]
+
+
+def test_stable_and_tiebroken_sorts_pass():
+    """The two legal shapes: is_stable=True, and an explicit index
+    tiebreak operand (the PR 2 fix, num_keys >= 2)."""
+    def ok(x):
+        a = jnp.argsort(x)                       # stable by default
+        b = jnp.lexsort((jnp.arange(x.shape[0], dtype=jnp.int32), x))
+        return a, b
+    spec = StepSpec(name="fx", fn=ok,
+                    args=(jnp.arange(8, dtype=jnp.int32),))
+    assert rules_of(audit_step(spec)) == []
+
+
+def test_fixture_host_callback_fires_once():
+    def step(x):
+        return jax.pure_callback(
+            np.sin, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    spec = StepSpec(name="fx", fn=step, args=(jnp.ones(4, jnp.float32),))
+    assert rules_of(audit_step(spec)) == ["host-transfer"]
+
+
+def test_fixture_f64_promotion_fires_once():
+    with jax.experimental.enable_x64():
+        spec = StepSpec(name="fx", fn=lambda x: x * np.float64(2.0),
+                        args=(jnp.ones(4, jnp.float32),))
+        findings = audit_step(spec)
+    assert rules_of(findings) == ["dtype-widening"]
+    assert findings[0].detail == "float32 -> float64"
+
+
+def test_fixture_aliased_donated_carry_fires_once():
+    """The PR 2 dealias bug shape: one buffer appearing twice in a
+    donated tree (Msgs.empty fan-out / durable_view views)."""
+    a = jnp.zeros(8, jnp.int32)
+    spec = StepSpec(name="fx", fn=lambda t: t[0] + t[1], args=((a, a),),
+                    donate_argnums=(0,))
+    assert rules_of(audit_step(spec)) == ["donation-alias"]
+    # and the fix: a dealiased tree passes
+    from maelstrom_tpu.sim import dealias
+    spec2 = StepSpec(name="fx", fn=lambda t: t[0] + t[1],
+                     args=(dealias((a, a)),), donate_argnums=(0,))
+    assert rules_of(audit_step(spec2)) == []
+
+
+def test_fixture_overlapping_scatter_fires_once():
+    spec = StepSpec(
+        name="fx",
+        fn=lambda x: x.at[jnp.array([0, 0])].set(jnp.array([1, 2])),
+        args=(jnp.zeros(4, jnp.int32),))
+    assert rules_of(audit_step(spec)) == ["scatter-nonunique"]
+    # scatter-add is combiner-commutative over ints: not flagged
+    spec2 = StepSpec(
+        name="fx",
+        fn=lambda x: x.at[jnp.array([0, 0])].add(jnp.array([1, 2])),
+        args=(jnp.zeros(4, jnp.int32),))
+    assert rules_of(audit_step(spec2)) == []
+
+
+@pytest.mark.multichip
+def test_fixture_donation_reshard_fires_once():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maelstrom_tpu import parallel
+    mesh = parallel.mesh_for(2, dp=1)
+    sh_sp, sh_rep = NamedSharding(mesh, P("sp")), NamedSharding(mesh, P())
+    # spec-declared fallback: the caller SAYS its pins disagree
+    spec = StepSpec(name="fx", fn=lambda x: x + 1, args=(jnp.zeros(8),),
+                    donate_argnums=(0,), in_shardings=sh_sp,
+                    out_shardings=sh_rep)
+    assert rules_of(audit_step(spec)) == ["donation-reshard"]
+
+
+@pytest.mark.multichip
+def test_fixture_donation_reshard_read_off_real_pjit_pins():
+    """The strong form: the auditor reads donated_invars and the
+    RESOLVED in/out shardings off the traced pjit equation itself, so a
+    builder whose actual jit pins diverge is caught even when the spec
+    declares nothing (and a self-consistent jit proves the negative)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maelstrom_tpu import parallel
+    mesh = parallel.mesh_for(2, dp=1)
+    sh_sp, sh_rep = NamedSharding(mesh, P("sp")), NamedSharding(mesh, P())
+    x = jax.device_put(jnp.zeros(8), sh_sp)
+    bad = jax.jit(lambda v: v + 1, donate_argnums=(0,),
+                  in_shardings=(sh_sp,), out_shardings=sh_rep)
+    spec = StepSpec(name="fx", fn=bad, args=(x,))   # no declared pins
+    assert rules_of(audit_step(spec)) == ["donation-reshard"]
+    ok = jax.jit(lambda v: v + 1, donate_argnums=(0,),
+                 in_shardings=(sh_sp,), out_shardings=sh_sp)
+    assert rules_of(audit_step(
+        StepSpec(name="fx", fn=ok, args=(x,)))) == []
+
+
+@pytest.mark.multichip
+def test_pr2_regression_unstable_delivery_sort_under_mesh():
+    """The PR 2 incident, reduced: a delivery-order argsort over a
+    mesh-sharded due-round key with NO index tiebreak. Partitioned sorts
+    don't preserve stability across shard merges, so equal-key ties
+    diverged between --mesh and single-chip runs; the auditor must flag
+    this shape statically."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maelstrom_tpu import parallel
+    from maelstrom_tpu.net.tpu import INT32_MAX
+    mesh = parallel.mesh_for(2, dp=1)
+    sh = NamedSharding(mesh, P("sp"))
+
+    def delivery_order(due, valid):
+        key = jnp.where(valid, due, INT32_MAX)
+        return jnp.argsort(key, stable=False)   # the pre-PR-2 bug shape
+
+    fn = jax.jit(delivery_order, in_shardings=(sh, sh))
+    args = (jax.device_put(jnp.zeros(16, jnp.int32), sh),
+            jax.device_put(jnp.ones(16, bool), sh))
+    sites = dedupe_sites(audit_step(
+        StepSpec(name="pr2-regression", fn=fn, args=args)))
+    assert rules_of(sites) == ["unstable-sort"]
+
+    # and the shipped fix's shape — lexsort with the explicit index
+    # tiebreak operand — is clean
+    def fixed(due, valid):
+        key = jnp.where(valid, due, INT32_MAX)
+        return jnp.lexsort((jnp.arange(16, dtype=jnp.int32), key))
+    fn2 = jax.jit(fixed, in_shardings=(sh, sh))
+    assert rules_of(audit_step(
+        StepSpec(name="pr2-fixed", fn=fn2, args=args))) == []
+
+
+def test_fixture_donation_cpu_view_config_rule(monkeypatch):
+    """The PR 2/4 runtime-config hazard: donation forced on while the
+    backend is CPU (zero-copy device_get views + buffer recycling).
+    Reported by the production self-report block."""
+    monkeypatch.setenv("MAELSTROM_AUDIT", "")
+    monkeypatch.setenv("MAELSTROM_DONATE", "1")
+
+    class StubProgram:
+        pass
+
+    class StubRunner:
+        program = StubProgram()
+        cfg = "stub-cfg"
+        _shardings = None
+    block = analyze.audit_runner(StubRunner(), trace=False)
+    assert block["ok"] is False
+    assert [f["rule"] for f in block["new"]] == ["donation-cpu-view"]
+    # donation off (the CPU default): clean
+    monkeypatch.setenv("MAELSTROM_DONATE", "0")
+    block = analyze.audit_runner(StubRunner(), trace=False)
+    assert block["ok"] is True and block["new"] == []
+
+
+# ---------------------------------------------------------------------------
+# source-lint seeded violations
+# ---------------------------------------------------------------------------
+
+def test_lint_np_unstable_sort_fires():
+    src = ("import numpy as np\n"
+           "def pair(xs):\n"
+           "    return np.argsort(xs)\n")
+    assert rules_of(source_lint.lint_source(src, "fx.py")) == \
+        ["np-unstable-sort"]
+    ok = ("import numpy as np\n"
+          "def pair(xs):\n"
+          "    return np.argsort(xs, kind=\"stable\")\n")
+    assert source_lint.lint_source(ok, "fx.py") == []
+
+
+def test_lint_np_sort_fires_module_form_only():
+    src = ("import numpy as np\n"
+           "def f(xs):\n"
+           "    b = np.sort(xs)\n")
+    assert rules_of(source_lint.lint_source(src, "fx.py")) == \
+        ["np-unstable-sort"]
+    # method-form sorts are deliberately exempt: list.sort is stable,
+    # and jax arrays' method sorts are stable by default (device sorts
+    # are the jaxpr pass's job)
+    ok = ("def f(parts, key):\n"
+          "    parts.sort(key=repr)\n"
+          "    return key.argsort()\n")
+    assert source_lint.lint_source(ok, "fx.py") == []
+
+
+def test_lint_set_iteration_fires():
+    src = ("def f(pending, d):\n"
+           "    for p in set(pending):\n"
+           "        d[p] = 1\n"
+           "    xs = [k for k in {1, 2, 3}]\n")
+    assert rules_of(source_lint.lint_source(src, "fx.py")) == \
+        ["set-iteration", "set-iteration"]
+    ok = ("def f(pending):\n"
+          "    for p in sorted(set(pending)):\n"
+          "        pass\n")
+    assert source_lint.lint_source(ok, "fx.py") == []
+
+
+def test_lint_wall_clock_fires():
+    src = ("import time\n"
+           "def stamp():\n"
+           "    return time.time()\n")
+    assert rules_of(source_lint.lint_source(src, "fx.py")) == \
+        ["wall-clock"]
+    # duration accounting stays legal
+    ok = ("import time\n"
+          "def bench():\n"
+          "    return time.perf_counter()\n")
+    assert source_lint.lint_source(ok, "fx.py") == []
+
+
+def test_lint_unseeded_random_fires():
+    src = ("import random\n"
+           "def jitter():\n"
+           "    return random.random() + random.randint(0, 3)\n")
+    assert rules_of(source_lint.lint_source(src, "fx.py")) == \
+        ["unseeded-random", "unseeded-random"]
+    ok = ("import random\n"
+          "def jitter(seed):\n"
+          "    rng = random.Random(seed)\n"
+          "    return rng.random()\n")
+    assert source_lint.lint_source(ok, "fx.py") == []
+
+
+def test_lint_hot_modules_clean():
+    """The shipped hot host modules carry zero lint findings — there is
+    deliberately NO lint suppression in the baseline."""
+    findings = source_lint.lint_default_paths()
+    assert findings == [], [f.as_dict() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+def _site(rule, where, key):
+    return Finding(rule=rule, where=where, key=key, entry="t")
+
+
+def test_baseline_suppresses_up_to_max_sites_only():
+    bl = Baseline(suppressions=[
+        {"rule": "scatter-nonunique", "where": "m/x.py:f", "max_sites": 1,
+         "reason": "t"}])
+    one = [_site("scatter-nonunique", "m/x.py:3 (f)", "m/x.py:f")]
+    new, suppressed = apply_baseline(one, bl)
+    assert (len(new), len(suppressed)) == (0, 1)
+    # a SECOND site in the same function exceeds the budget: the whole
+    # group surfaces (re-baselining is an explicit, reviewed act)
+    two = one + [_site("scatter-nonunique", "m/x.py:9 (f)", "m/x.py:f")]
+    new, suppressed = apply_baseline(two, bl)
+    assert (len(new), len(suppressed)) == (2, 0)
+    assert all("exceeds baseline" in f.detail for f in new)
+
+
+def test_baseline_never_crosses_rules():
+    bl = Baseline(suppressions=[
+        {"rule": "scatter-nonunique", "where": "m/x.py:f", "max_sites": 9,
+         "reason": "t"}])
+    new, suppressed = apply_baseline(
+        [_site("unstable-sort", "m/x.py:3 (f)", "m/x.py:f")], bl)
+    assert (len(new), len(suppressed)) == (1, 0)
+
+
+def test_dedupe_merges_entries_across_variants():
+    a = Finding(rule="unstable-sort", where="m/x.py:3 (f)", key="m/x.py:f",
+                entry="round_fn")
+    b = Finding(rule="unstable-sort", where="m/x.py:3 (f)", key="m/x.py:f",
+                entry="scan_fn")
+    sites = dedupe_sites([a, b])
+    assert len(sites) == 1
+    assert sorted(sites[0].entries) == ["round_fn", "scan_fn"]
+
+
+# ---------------------------------------------------------------------------
+# the zero-new-findings gate over the REAL production step functions
+# ---------------------------------------------------------------------------
+
+def test_gate_production_plain_round_and_scan_fns():
+    """round_fn/scan_fn/scan_journal_fn for lin-kv (the raft-backed edge
+    path through the flight pool), traced with donation forced on — the
+    TPU configuration — must carry zero non-baselined findings, and the
+    baseline's deliberate exceptions must actually match."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["lin-kv"], mesh=None)
+    assert any(e.startswith("round_fn[") for e in entries)
+    assert any(e.startswith("scan_fn[") for e in entries)
+    new, suppressed = apply_baseline(dedupe_sites(findings),
+                                     Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+    assert suppressed, "baseline entries stopped matching: stale baseline"
+
+
+@pytest.mark.multichip
+def test_gate_production_mesh_round_and_scan_fns():
+    """The --mesh 1,2 variants: same zero-new-findings bar with the
+    sharding pins applied (in == out for the donated carry, so the
+    donation-reshard rule also proves a negative here)."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["lin-kv"], mesh="1,2")
+    assert any("@mesh=1,2" in e for e in entries)
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+
+
+def test_baseline_file_is_well_formed():
+    with open(analyze.baseline_path()) as f:
+        data = json.load(f)
+    assert data["version"] == 1
+    for s in data["suppressions"]:
+        assert s["rule"] in analyze.RULES
+        assert s["max_sites"] >= 1
+        # every deliberate exception records an actual justification
+        assert s["reason"] and "FIXME" not in s["reason"]
+
+
+# ---------------------------------------------------------------------------
+# CLI + results-block surfacing
+# ---------------------------------------------------------------------------
+
+def test_analyze_cli_json_lint_only(capsys):
+    """`--programs none` = lint-only: fast, structured, exit 0 on the
+    clean tree."""
+    from maelstrom_tpu.analyze.cli import main
+    rc = main(["--programs", "none", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    assert out["new"] == []
+    assert "source-lint" in out["entries"]
+    assert out["wall-s"] >= 0
+
+
+def test_results_carry_static_audit_block(tmp_path):
+    """A real (tiny) TPU-path run self-reports its hazard status in the
+    net results block: rule counts, suppressed count, audit wall time."""
+    from maelstrom_tpu import core
+    res = core.run({
+        "workload": "echo", "node": "tpu:echo", "node_count": 2,
+        "time_limit": 0.5, "rate": 10, "store_root": str(tmp_path),
+        "recovery_s": 0.1})
+    block = res["net"]["static-audit"]
+    assert block["ok"] is True
+    assert isinstance(block["rules"], dict)
+    assert "suppressed-count" in block
+    assert block["wall-s"] >= 0
+    # and the kill switch works
+    res2 = core.run({
+        "workload": "echo", "node": "tpu:echo", "node_count": 2,
+        "time_limit": 0.5, "rate": 10, "store_root": str(tmp_path),
+        "recovery_s": 0.1, "audit": False})
+    assert "static-audit" not in res2["net"]

@@ -17,18 +17,23 @@ from collections import defaultdict
 
 import pytest
 
-pytest.importorskip(
-    "hypothesis",
-    reason="property tests need hypothesis; skip where it isn't baked in")
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    # minimal installs: the vendored fallback backend (same surface, no
+    # shrinking) keeps the property suite running where hypothesis isn't
+    # baked in; importorskip still guards truly bare environments
+    minihyp = pytest.importorskip(
+        "maelstrom_tpu.testing.minihyp",
+        reason="property tests need hypothesis or the vendored fallback")
+    given, settings, st = (minihyp.given, minihyp.settings,
+                           minihyp.strategies)
 
 import jax
 import numpy as np
-from hypothesis import given, settings, strategies as st
 
 from maelstrom_tpu.net import tpu as T
 from test_tpu_net import mk
-
-pytestmark = pytest.mark.slow  # full-suite only; fast core runs -m 'not slow'
 
 
 def drive(cfg, schedule, rounds, seed=0):
