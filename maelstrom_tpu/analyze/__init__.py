@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 __all__ = [
     "RULES", "Finding", "AuditReport", "Baseline", "baseline_path",
     "dedupe_sites", "apply_baseline", "run_audit", "audit_runner",
+    "audit_fleet_runner",
 ]
 
 
@@ -321,10 +322,12 @@ class AuditReport:
 
 def run_audit(programs=None, mesh: str | None = "auto",
               jaxpr: bool = True, lint: bool = True,
-              baseline: str | None = None) -> AuditReport:
+              baseline: str | None = None,
+              fleet: bool = True) -> AuditReport:
     """The full gate: trace the production step functions for every
     requested workload (plus the `--mesh` variants when enough devices
-    are visible), lint the hot host modules, and split the deduped
+    are visible, plus the vmapped `--fleet` scan/round variants unless
+    `fleet=False`), lint the hot host modules, and split the deduped
     findings against the checked-in baseline."""
     t0 = time.perf_counter()
     report = AuditReport()
@@ -332,7 +335,7 @@ def run_audit(programs=None, mesh: str | None = "auto",
     if jaxpr:
         from . import jaxpr_audit
         fs, entries, notes = jaxpr_audit.audit_production(
-            programs=programs, mesh=mesh)
+            programs=programs, mesh=mesh, fleet=fleet)
         raw += fs
         report.entries += entries
         report.notes += notes
@@ -350,20 +353,21 @@ def run_audit(programs=None, mesh: str | None = "auto",
 _runner_audit_memo: dict = {}
 
 
-def audit_runner(runner, trace: bool = True) -> dict:
-    """The production self-report block (`static-audit` in results.json,
-    surfaced via TpuNetStats): audits the runner's OWN program/config —
-    jaxpr trace of its step functions under its actual donation/sharding
-    settings, source lint of the installed hot modules, and the runtime
-    config rules (donation-cpu-view). Memoized per config so repeated
-    runs in one process (test suites) pay the trace once. Never raises:
-    an audit failure must not fail a production run."""
+def _runner_audit(cfg_key_fn, steps_fn, trace: bool,
+                  extra_fn=lambda: {}) -> dict:
+    """Shared body of `audit_runner`/`audit_fleet_runner`: memoized per
+    config key, jaxpr-traces the runner's own step functions via
+    `steps_fn` when tracing is on, lints the installed hot modules,
+    applies the runtime config rule (donation-cpu-view — the PR 2/4 CPU
+    zero-copy hazard), and splits the deduped findings against the
+    checked-in baseline. Never raises: an audit failure must not fail a
+    production run (the callables are evaluated inside the guard)."""
     t0 = time.perf_counter()
     try:
+        import jax
+
         from ..sim import donation_enabled
-        cfg_key = (type(runner.program).__name__, repr(runner.cfg),
-                   runner._shardings is not None, bool(trace),
-                   donation_enabled())
+        cfg_key = cfg_key_fn()
         cached = _runner_audit_memo.get(cfg_key)
         if cached is not None:
             out = dict(cached)
@@ -373,13 +377,10 @@ def audit_runner(runner, trace: bool = True) -> dict:
         raw: list[Finding] = []
         notes: list[str] = []
         if trace:
-            from . import jaxpr_audit
-            fs, _entries, notes = jaxpr_audit.audit_runner_steps(runner)
+            fs, _entries, notes = steps_fn()
             raw += fs
         from . import source_lint
         raw += source_lint.lint_default_paths()
-        # runtime config rule: the PR 2/4 CPU zero-copy hazard
-        import jax
         if donation_enabled() and jax.default_backend() == "cpu":
             raw.append(Finding(
                 rule="donation-cpu-view", entry="runtime-config",
@@ -395,7 +396,8 @@ def audit_runner(runner, trace: bool = True) -> dict:
                "rules": dict(sorted(counts.items())),
                "new": [f.as_dict() for f in new],
                "suppressed-count": len(suppressed),
-               "traced": bool(trace)}
+               "traced": bool(trace),
+               **extra_fn()}
         if notes:
             out["notes"] = notes
         _runner_audit_memo[cfg_key] = dict(out)
@@ -404,3 +406,41 @@ def audit_runner(runner, trace: bool = True) -> dict:
     except Exception as e:       # the audit must never fail a real run
         return {"ok": None, "audit-error": repr(e),
                 "wall-s": round(time.perf_counter() - t0, 3)}
+
+
+def audit_runner(runner, trace: bool = True) -> dict:
+    """The production self-report block (`static-audit` in results.json,
+    surfaced via TpuNetStats): audits the runner's OWN program/config —
+    jaxpr trace of its step functions under its actual donation/sharding
+    settings, source lint of the installed hot modules, and the runtime
+    config rules (donation-cpu-view). Memoized per config so repeated
+    runs in one process (test suites) pay the trace once. Never raises:
+    an audit failure must not fail a production run."""
+    from ..sim import donation_enabled
+
+    def steps():
+        from . import jaxpr_audit
+        return jaxpr_audit.audit_runner_steps(runner)
+    return _runner_audit(
+        lambda: (type(runner.program).__name__, repr(runner.cfg),
+                 runner._shardings is not None, bool(trace),
+                 donation_enabled()),
+        steps, trace)
+
+
+def audit_fleet_runner(runner, trace: bool = True) -> dict:
+    """The fleet-level `static-audit` results block: ONE audit of the
+    vmapped fleet step functions shared by every cluster (per-cluster
+    blocks would repeat the identical trace F times). Same contract as
+    `audit_runner`: memoized per config, never raises."""
+    from ..sim import donation_enabled
+
+    def steps():
+        from . import jaxpr_audit
+        return jaxpr_audit.audit_fleet_runner_steps(runner)
+    return _runner_audit(
+        lambda: ("fleet", type(runner.program).__name__,
+                 repr(runner.cfg), runner.spec.fleet,
+                 runner._shardings is not None, bool(trace),
+                 donation_enabled()),
+        steps, trace, extra_fn=lambda: {"fleet": runner.spec.fleet})

@@ -27,6 +27,11 @@ def add_analyze_args(p: argparse.ArgumentParser) -> None:
                         "'none' disables mesh variants")
     p.add_argument("--no-lint", action="store_true",
                    help="Skip the host-module source lint pass")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="Skip the vmapped --fleet scan/round variants "
+                        "(traced by default: plain, plus --mesh 2,1 — "
+                        "the cluster axis sharded over dp — when >= 2 "
+                        "devices are visible)")
     p.add_argument("--baseline",
                    help="Alternate baseline.json (default: the "
                         "checked-in analyze/baseline.json)")
@@ -49,7 +54,8 @@ def run_analyze(args) -> int:
     mesh = None if args.mesh == "none" else args.mesh
     try:
         report = run_audit(programs=programs, mesh=mesh, jaxpr=jaxpr,
-                           lint=not args.no_lint, baseline=args.baseline)
+                           lint=not args.no_lint, baseline=args.baseline,
+                           fleet=not args.no_fleet)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

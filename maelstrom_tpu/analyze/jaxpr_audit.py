@@ -50,6 +50,12 @@ DEFAULT_PROGRAMS = tuple(WORKLOAD_NODES)
 # the sharding machinery is shared, so this covers the --mesh hot loop
 # without tripling the audit's wall time
 DEFAULT_MESH_PROGRAMS = ("lin-kv", "broadcast")
+# fleet variants likewise: the vmapped fleet scan re-batches every
+# scatter/sort in the round body, so one pool-path and one edge-path
+# program cover the whole --fleet hot loop (plain + --mesh 2,1, which
+# shards the cluster axis over dp)
+DEFAULT_FLEET_PROGRAMS = ("lin-kv", "broadcast")
+AUDIT_FLEET = 4                     # clusters in the traced fleet batch
 
 HOST_TRANSFER_PRIMS = ("io_callback", "pure_callback", "debug_callback",
                        "device_put")
@@ -358,12 +364,87 @@ def production_step_specs(workload: str, mesh: str | None = None,
     return specs
 
 
-def audit_production(programs=None, mesh: str | None = "auto"):
+def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
+                     mesh: str | None = None,
+                     donate: bool = True) -> list[StepSpec]:
+    """Builds the FLEET entry points — `make_fleet_scan_fn` (the vmapped
+    scan every `--fleet` dispatch runs) and the vmapped per-round
+    function — over a cluster-batched state tree built the way
+    `runner.fleet_runner` builds it, and returns them as auditable
+    StepSpecs. With `mesh` (e.g. "2,1"), the fleet axis shards over dp
+    exactly as `--fleet N --mesh dp,sp` runs it."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import core, parallel
+    from ..net import tpu as T
+    from ..runner.tpu_runner import TpuRunner
+    from ..sim import dealias, donation_enabled, make_fleet_scan_fn
+
+    node = WORKLOAD_NODES.get(workload)
+    if node is None:
+        raise ValueError(f"unknown workload {workload!r}; expected one of "
+                         f"{sorted(WORKLOAD_NODES)}")
+    opts = {"workload": workload, "node": node, "node_count": 5,
+            "time_limit": 1.0}
+    with _force_donation(donate):
+        test = core.build_test(opts)
+        runner = TpuRunner(test)
+        F = fleet
+        # the EXACT production construction (runner/fleet_runner.py):
+        # make_fleet_sims pins row i == make_sim(seed_i), dealiased
+        # before donation like the fleet runner does — so the audit
+        # traces the entry point `--fleet` actually runs
+        sim = parallel.make_fleet_sims(runner.program, runner.cfg,
+                                       seeds=range(F))
+        if donation_enabled():
+            sim = dealias(sim)
+        inject1 = T.Msgs.empty(max(runner.concurrency, 1))
+        inject = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (F,) + a.shape), inject1)
+        sh = None
+        if mesh:
+            m = parallel.mesh_from_spec(mesh)
+            if F % m.shape["dp"]:
+                raise ValueError(f"fleet audit: {F} % dp="
+                                 f"{m.shape['dp']} != 0")
+            sh = parallel.fleet_scan_shardings(m, sim, inject)
+            sim = jax.device_put(sim, sh[0])
+        kv = jnp.full((F,), 8, jnp.int32)
+        flags = jnp.ones((F,), bool)
+        tag = f"{workload}@fleet={F}" + (f"@mesh={mesh}" if mesh else "")
+        sim_sh = sh[0] if sh is not None else None
+        common = dict(donate_argnums=(0,) if donate else (),
+                      in_shardings=sim_sh, out_shardings=sim_sh)
+        specs = [
+            StepSpec(name=f"fleet_scan_fn[{tag}]",
+                     fn=make_fleet_scan_fn(runner.program, runner.cfg,
+                                           reply_cap=runner.reply_log_cap,
+                                           donate=donate, shardings=sh),
+                     args=(sim, inject, kv, flags, flags), **common),
+            StepSpec(name=f"fleet_round_fn[{tag}]",
+                     fn=parallel.make_cluster_round_fn(
+                         runner.program, runner.cfg,
+                         mesh=(parallel.mesh_from_spec(mesh)
+                               if mesh else None),
+                         example=sim, example_inject=inject),
+                     args=(sim, inject),
+                     donate_argnums=(), in_shardings=None,
+                     out_shardings=None),
+        ]
+    return specs
+
+
+def audit_production(programs=None, mesh: str | None = "auto",
+                     fleet: bool = True):
     """Traces and audits the production step functions for each
     workload. `mesh="auto"` adds `--mesh 1,2` variants for
     DEFAULT_MESH_PROGRAMS when >= 2 devices are visible; an explicit
     mesh spec is applied to every requested program; None disables mesh
-    variants. Returns (findings, entry_names, notes)."""
+    variants. `fleet` additionally traces the vmapped fleet scan/round
+    for DEFAULT_FLEET_PROGRAMS (plain, and sharded `--mesh 2,1` when
+    the devices are there — the dp>1 configuration only the fleet can
+    run). Returns (findings, entry_names, notes)."""
     import jax
     programs = list(programs or DEFAULT_PROGRAMS)
     findings: list[Finding] = []
@@ -386,7 +467,58 @@ def audit_production(programs=None, mesh: str | None = "auto"):
         for spec in production_step_specs(workload, mesh=mesh_spec):
             findings += audit_step(spec)
             entries.append(spec.name)
+
+    if fleet:
+        fleet_jobs: list[tuple[str, str | None]] = \
+            [(p, None) for p in DEFAULT_FLEET_PROGRAMS if p in programs]
+        if mesh == "auto":
+            if jax.device_count() >= 2:
+                fleet_jobs += [(p, "2,1") for p in DEFAULT_FLEET_PROGRAMS
+                               if p in programs]
+            else:
+                notes.append("fleet mesh variants skipped: < 2 visible "
+                             "devices")
+        elif mesh:
+            from .. import parallel
+            dp = parallel.mesh_from_spec(mesh).shape["dp"]
+            if AUDIT_FLEET % max(dp, 1) == 0:
+                fleet_jobs += [(p, mesh) for p in DEFAULT_FLEET_PROGRAMS
+                               if p in programs]
+        for workload, mesh_spec in fleet_jobs:
+            for spec in fleet_step_specs(workload, mesh=mesh_spec):
+                findings += audit_step(spec)
+                entries.append(spec.name)
     return findings, entries, notes
+
+
+def audit_fleet_runner_steps(runner):
+    """Self-report variant for a LIVE FleetRunner: audits the vmapped
+    fleet scan over the runner's own batched tree, shardings, and
+    donation setting (the exact dispatch every fleet wave runs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..sim import donation_enabled, make_fleet_scan_fn
+
+    donate = donation_enabled()
+    F = runner.spec.fleet
+    inject = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (F,) + a.shape),
+        runner._empty_inject)
+    sh = runner._shardings
+    sim_sh = sh[0] if sh is not None else None
+    kv = jnp.full((F,), 8, jnp.int32)
+    flags = jnp.ones((F,), bool)
+    tag = f"{type(runner.program).__name__}@fleet={F}"
+    spec = StepSpec(
+        name=f"fleet_scan_fn[{tag}]",
+        fn=make_fleet_scan_fn(runner.program, runner.cfg,
+                              reply_cap=runner.reply_log_cap,
+                              donate=donate, shardings=sh),
+        args=(runner.sim, inject, kv, flags, flags),
+        donate_argnums=(0,) if donate else (),
+        in_shardings=sim_sh, out_shardings=sim_sh)
+    return audit_step(spec), [spec.name], []
 
 
 def audit_runner_steps(runner):

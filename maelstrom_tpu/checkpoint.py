@@ -86,6 +86,10 @@ EXIT_PREEMPTED = 75
 #     fire at their deadline rounds either way (pinned by
 #     test_checkpoint_resume_identical_history, which compares a
 #     checkpointed run against an un-checkpointed baseline).
+#   - fleet/fleet_sweep/nemesis_seed: the fleet's cluster axis — they
+#     shape the batched state tree (leading cluster dimension), the
+#     per-cluster seed/schedule assignment, and the op stream itself; a
+#     fleet checkpoint only resumes into the same campaign.
 FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     "concurrency", "latency", "nemesis", "nemesis_interval",
                     "topology", "seed", "key_count", "max_txn_length",
@@ -93,7 +97,8 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     "p_loss", "timeout_ms", "ms_per_round", "recovery_s",
                     "journal_rows", "max_scan", "pool_cap", "gossip_fanout",
                     "mesh", "journal_scan_cap", "reply_log_cap",
-                    "collect_replies")
+                    "collect_replies", "fleet", "fleet_sweep",
+                    "nemesis_seed")
 
 
 class CheckpointError(RuntimeError):

@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(e.g. --nemesis kill,pause,partition,duplicate)")
     t.add_argument("--nemesis-interval", type=float, default=10.0,
                    help="Seconds between nemesis operations")
+    t.add_argument("--nemesis-seed", type=int, default=None,
+                   help="Decouple the fault-schedule RNG from --seed "
+                        "(default: follow --seed). This is how a single "
+                        "cluster of a --fleet-sweep nemesis campaign is "
+                        "reproduced standalone: --seed <base> "
+                        "--nemesis-seed <base + i>")
     t.add_argument("--client-retries", type=int, default=0,
                    help="Client RPC retry budget: failed/unavailable "
                         "RPCs re-issue up to N times under exponential "
@@ -84,12 +90,27 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--mesh",
                    help="Shard the TPU-path simulation over a dp,sp "
                         "device mesh (e.g. --mesh 1,4): dp = cluster/"
-                        "data-parallel axis (must be 1 for the "
-                        "single-cluster interactive runner), sp = "
-                        "node/pool axis. Same-seed runs stay "
-                        "bit-identical to single-chip. Requires "
+                        "data-parallel axis (carries the --fleet "
+                        "cluster dimension; must be 1 without a "
+                        "fleet), sp = node/pool axis. Same-seed runs "
+                        "stay bit-identical to single-chip. Requires "
                         "--node tpu:<program> and dp*sp visible "
                         "devices (see doc/perf.md)")
+    t.add_argument("--fleet", type=int,
+                   help="Run N independent cluster instances inside "
+                        "ONE compiled scan (the fleet runner): a "
+                        "seed/nemesis/capacity campaign becomes one "
+                        "device program, sharded ('dp','sp') under "
+                        "--mesh dp,sp with N %% dp == 0. Every "
+                        "cluster's history is bit-identical to its "
+                        "standalone run (doc/perf.md). TPU path only")
+    t.add_argument("--fleet-sweep", choices=["seed", "nemesis",
+                                             "capacity"],
+                   help="What the fleet varies per cluster (default "
+                        "seed): 'seed' offsets the whole seed (ops + "
+                        "faults), 'nemesis' fixes the op stream and "
+                        "varies only the fault schedules, 'capacity' "
+                        "ramps the offered load (rate x cluster-index)")
     t.add_argument("--max-scan", type=int,
                    help="Upper bound on rounds per compiled scan "
                         "dispatch (default 65536)")
@@ -249,7 +270,7 @@ def opts_from_args(args) -> dict:
     # TPU-path performance knobs: only forwarded when given, so the
     # runner's own defaults stay in one place
     for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
-              "check_workers"):
+              "check_workers", "fleet", "fleet_sweep", "nemesis_seed"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v
@@ -262,6 +283,11 @@ def opts_from_args(args) -> dict:
         raise SystemExit("--mesh needs the TPU path (--node tpu:<program>):"
                          " external --bin processes don't run on a device "
                          "mesh")
+    if (args.fleet or 1) > 1 and not (
+            args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--fleet needs the TPU path (--node "
+                         "tpu:<program>): the cluster axis is a vmapped "
+                         "dimension of the compiled scan")
     return opts
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+from dataclasses import dataclass
 
 from . import generators as g
 from . import nemesis as nem
@@ -61,7 +62,98 @@ DEFAULTS = dict(
     # additionally traces this run's own step functions (the CLI turns
     # it on; library/test callers keep the cheap lint+config-only block)
     audit=True, audit_trace=False,
+    # fleet execution (doc/perf.md): --fleet N runs N independent
+    # cluster instances inside ONE compiled scan (vmapped over a leading
+    # cluster axis, sharded dp under --mesh dp,sp); --fleet-sweep picks
+    # the dimension the campaign varies per cluster. nemesis_seed
+    # decouples the fault-schedule RNG from the workload seed (the
+    # `nemesis` sweep; None = follow seed).
+    fleet=1, fleet_sweep="seed", nemesis_seed=None,
 )
+
+# Keys build_test ADDS to a test dict (derived objects, not user
+# options): stripped when re-deriving per-cluster option sets for a
+# fleet run, so each cluster's test is rebuilt from scratch exactly as
+# a standalone run with those options would be.
+_BUILT_KEYS = frozenset({"net", "workload_map", "client", "generator",
+                         "checker", "nemesis_pkg", "store_dir",
+                         "analysis"})
+
+
+@dataclass(frozen=True)
+class FleetSpec:
+    """The fleet campaign described by `--fleet N --fleet-sweep <dim>`:
+    N independent cluster instances advancing in lockstep inside one
+    compiled scan, differing along ONE swept dimension:
+
+      - ``seed``:     cluster i runs with seed base + i — workload op
+                      stream, sim PRNG, and nemesis schedule all follow
+                      the seed (the fuzzing/soak campaign).
+      - ``nemesis``:  the workload/op stream is fixed (base seed); only
+                      the nemesis decision streams vary
+                      (nemesis_seed = base + i): same ops under N
+                      independent fault schedules.
+      - ``capacity``: seed fixed, offered load ramps — cluster i runs
+                      at rate * (i + 1): a capacity sweep in one
+                      dispatch.
+
+    Static shapes (node count, concurrency, capacities, fault packages)
+    stay uniform across the fleet: they define the ONE compiled program
+    every cluster shares. The per-cluster contract is bit-identity:
+    cluster i's history equals the standalone run of `cluster_opts(i)`
+    (pinned by tests/test_fleet_runner.py)."""
+
+    fleet: int = 1
+    sweep: str = "seed"
+
+    SWEEPS = ("seed", "nemesis", "capacity")
+
+    @classmethod
+    def from_test(cls, test: dict) -> "FleetSpec":
+        raw = test.get("fleet")
+        fleet = 1 if raw is None else int(raw)
+        sweep = str(test.get("fleet_sweep") or "seed")
+        if fleet < 1:
+            raise ValueError(f"--fleet must be >= 1, got {fleet}")
+        if sweep not in cls.SWEEPS:
+            raise ValueError(f"--fleet-sweep {sweep!r}: expected one of "
+                             f"{list(cls.SWEEPS)}")
+        return cls(fleet=fleet, sweep=sweep)
+
+    def cluster_opts(self, test: dict, i: int) -> dict:
+        """The option set whose STANDALONE run cluster i replays
+        bit-identically: the base test's buildable options plus this
+        cluster's swept value. Fleet-level mechanics — mesh placement,
+        checkpoint cadence/files, per-message journaling, the
+        static-audit block — are owned by the fleet and stripped or
+        forced here."""
+        opts = {k: v for k, v in test.items() if k not in _BUILT_KEYS}
+        opts.update(
+            # checkpoint_every is KEPT: each shell's dispatch loop
+            # requests snapshots at its own stretch boundaries and the
+            # fleet coalesces them into one checkpoint file (cadence is
+            # observationally neutral — see checkpoint.py)
+            fleet=1, fleet_sweep=self.sweep, mesh=None, resume=None,
+            # per-message journal rows are a small-run debugging aid;
+            # the fleet scan drains only the reply rings
+            journal_rows=False,
+            # ONE static-audit block at the fleet level (per-cluster
+            # blocks would repeat the identical trace F times)
+            audit=False, audit_trace=False)
+        if test.get("check_workers") is None and self.fleet > 16:
+            # one background analysis worker PER CLUSTER is the default
+            # for small fleets; past that the thread pool would dwarf
+            # the host — opt in explicitly with --check-workers
+            opts["no_overlap"] = True
+        base_seed = int(test.get("seed", 0) or 0)
+        if self.sweep == "seed":
+            opts["seed"] = base_seed + i
+        elif self.sweep == "nemesis":
+            opts["nemesis_seed"] = base_seed + i
+        else:   # capacity: offered-load ramp
+            opts["rate"] = float(test.get("rate", DEFAULTS["rate"])) \
+                * (i + 1)
+        return opts
 
 
 def parse_nodes(opts: dict) -> list[str]:

@@ -44,6 +44,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # latencies), validity — must match exactly.
 VOLATILE_RESULT_KEYS = ("net", "analysis-pipeline", "resumed-at-round")
 
+# Fleet results additionally inline the fleet-level TransferStats
+# accounting at the top level (one transfer ledger for the whole fleet)
+# and a static-audit block with wall time; both restart per launch.
+VOLATILE_FLEET_KEYS = VOLATILE_RESULT_KEYS + (
+    "drains", "host-bytes", "host-blocked-s", "host-overlapped-s",
+    "ckpt-saves", "ckpt-blocked-s", "ckpt-write-s", "static-audit")
+
 # A small but honest default config: raft-backed lin-kv (durable store,
 # so the kill nemesis is recoverable), the full combined fault soup, and
 # a checkpoint cadence short enough that every kill lands mid-stretch.
@@ -228,6 +235,13 @@ def run_with_kills(store_root: str, opts: dict, kills: int, rng,
 
 
 def _strip_volatile(results: dict) -> dict:
+    if "clusters" in results:
+        # fleet results: volatile accounting lives at the fleet level
+        # AND inside each per-cluster result block
+        out = {k: v for k, v in results.items()
+               if k not in VOLATILE_FLEET_KEYS}
+        out["clusters"] = [_strip_volatile(c) for c in results["clusters"]]
+        return out
     return {k: v for k, v in results.items()
             if k not in VOLATILE_RESULT_KEYS}
 
@@ -249,6 +263,27 @@ def compare_runs(dir_a: str, dir_b: str) -> dict:
     if not out["results_identical"]:
         out["results_diff_keys"] = sorted(
             k for k in set(sa) | set(sb) if sa.get(k) != sb.get(k))
+    # fleet runs: the per-cluster artifacts (cluster-XXXX/) must match
+    # too — the top-level fleet history is a merged re-encoding, so a
+    # bug confined to one cluster's stored rows would not show there
+    def _clusters(d):
+        return sorted(c for c in os.listdir(d) if c.startswith("cluster-")
+                      and os.path.isdir(os.path.join(d, c)))
+    ca, cb = _clusters(dir_a), _clusters(dir_b)
+    if ca or cb:
+        out["clusters_compared"] = len(set(ca) | set(cb))
+        if ca != cb:
+            out["history_identical"] = out["results_identical"] = False
+            out["cluster_dirs"] = (ca, cb)
+        for c in (ca if ca == cb else []):
+            sub = compare_runs(os.path.join(dir_a, c),
+                               os.path.join(dir_b, c))
+            out["history_identical"] &= sub["history_identical"]
+            if not sub["results_identical"]:
+                out["results_identical"] = False
+                out.setdefault("results_diff_keys", [])
+                out["results_diff_keys"] += [
+                    f"{c}:{k}" for k in sub.get("results_diff_keys", [])]
     return out
 
 

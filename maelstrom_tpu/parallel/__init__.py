@@ -155,12 +155,44 @@ def sim_shardings(mesh: Mesh, tree, batched: bool = True):
 def make_cluster_sims(program, cfg: T.NetConfig, n_clusters: int,
                       seed: int = 0) -> SimState:
     """A batch of independent cluster simulations: every array gains a
-    leading cluster axis; PRNG keys differ per cluster."""
+    leading cluster axis; PRNG keys differ per cluster (split from one
+    root key — the bench/fuzz fleets, where no standalone-run equivalence
+    is claimed)."""
     base = make_sim(program, cfg, seed=seed)
     batched = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (n_clusters,) + a.shape), base)
     keys = jax.random.split(jax.random.PRNGKey(seed), n_clusters)
     return batched.replace(key=keys)
+
+
+def make_fleet_sims(program, cfg: T.NetConfig, seeds,
+                    track_edge_send_round: bool = False) -> SimState:
+    """A cluster-batched SimState whose row i is BIT-IDENTICAL to
+    `make_sim(program, cfg, seed=seeds[i])`: the initial state tree is
+    seed-independent, so rows share the broadcast base, and each row's
+    PRNG key is `PRNGKey(seeds[i])` exactly (NOT a split of one root key
+    — the fleet runner's per-cluster equivalence contract is that every
+    cluster replays its standalone run)."""
+    base = make_sim(program, cfg, seed=0,
+                    track_edge_send_round=track_edge_send_round)
+    F = len(seeds)
+    batched = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (F,) + a.shape), base)
+    keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+    return batched.replace(key=keys)
+
+
+def fleet_scan_shardings(mesh: Mesh, sim: SimState, inject) -> tuple:
+    """The `(sim, inject, scalar)` sharding triple for the FLEET entry
+    points (`sim.make_fleet_scan_fn` and the fleet runner's batched
+    bump/restart): the cluster-batched SimState tree sharded dp over its
+    leading fleet axis and sp over the first big per-cluster axis, the
+    [F, C] inject batch likewise, per-cluster [F] vectors and scalars
+    replicated (they are tiny and about to leave for the host)."""
+    scalar = NamedSharding(mesh, P())
+    return (sim_shardings(mesh, sim, batched=True),
+            sim_shardings(mesh, inject, batched=True),
+            scalar)
 
 
 def make_cluster_round_fn(program, cfg: T.NetConfig, mesh: Mesh | None = None,

@@ -1,0 +1,749 @@
+"""Fleet execution: thousands of independent test instances in ONE
+compiled scan.
+
+The standalone `TpuRunner` simulates one cluster; a campaign — a
+seed x workload x nemesis-schedule x capacity sweep — is N independent
+clusters. `FleetRunner` gives the `("dp", "sp")` mesh's dp axis its
+meaning: every cluster's whole hot-loop tree (node state, flight pool,
+edge channels, durable store, freeze/nemesis masks, reply rings) gains a
+leading *cluster* axis, the compiled scan is vmapped over it
+(`sim.make_fleet_scan_fn`), and `--mesh dp,sp` shards that axis over dp
+while sp keeps sharding the per-cluster node/pool axes. One device
+program, N replicas, throughput in clusters/sec — the data-parallel
+scaling playbook (PAPERS.md: "Scale MLPerf-0.6 models on Google TPU-v3
+Pods", "Exploring the limits of Concurrency in ML Training on Google
+TPUs") applied to simulation.
+
+Architecture: each cluster is a full `TpuRunner` *shell* — its own
+generator tree, pending-RPC map, history, nemesis decision streams,
+intern tables — built from the option set its STANDALONE run would use
+(`core.FleetSpec.cluster_opts`). The shells' dispatch loops are the
+same `_loop_steps` coroutine the standalone runner drives; the fleet
+merely answers their yielded device requests in lockstep *waves*:
+
+    quiet probes  -> one vmapped probe over the batched tree
+    bumps         -> one batched round-counter add (k=0 holds a row)
+    scans         -> one vmapped `fleet_scan_fn` dispatch; clusters
+                     between stretches are held by the `active` mask
+
+Because the loop code and the per-row compiled math are identical to
+the standalone path, every cluster's history is **bit-identical** to
+running it alone with the same options (pinned by
+tests/test_fleet_runner.py) — the fleet changes batching, never
+semantics.
+
+Checkpointing is per-cluster-consistent: each shell snapshots itself at
+its own stretch boundaries (sim row + host meta, pickled immediately),
+and the fleet coalesces the freshest snapshots into one crash-consistent
+checkpoint file per wave (same framed format, `checkpoint.py`), so
+SIGKILL/SIGTERM + `--resume` recovers every cluster byte-identically.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import core as core_mod
+from .. import store
+from ..history import History
+from ..net import tpu as T
+from ..sim import dealias, donation_enabled
+from .tpu_runner import TpuNetStats, TpuRunner
+
+log = logging.getLogger("maelstrom.fleet")
+
+
+class _FleetClusterShell(TpuRunner):
+    """One cluster of a fleet: a full TpuRunner whose device
+    interactions are redirected to its row of the fleet's batched tree.
+    Its `_loop_steps` coroutine (inherited verbatim) is driven by the
+    FleetRunner; the overrides below cover every path that would
+    otherwise touch the shell's own (discarded) sim."""
+
+    def __init__(self, test: dict, fleet: "FleetRunner", idx: int):
+        self.fleet = fleet
+        self.idx = idx
+        super().__init__(test)
+
+    def _net_surgery(self, fn):
+        self.fleet.apply_net_row(self.idx, fn)
+
+    def restart_nodes(self, mask):
+        self.fleet.restart_row(self.idx, mask)
+        self._state_cache = None
+
+    def _read_state(self, node_idx: int):
+        return self.fleet.read_state(self.idx, node_idx)
+
+    def _init_next_mid(self):
+        self._next_mid = self.fleet.shell_next_mid(self.idx)
+
+    def _save_checkpoint(self, gen, history, pending, free, r,
+                         sync: bool = False):
+        # stretch-boundary snapshot: the fleet coalesces these into one
+        # checkpoint file per wave (the shell's own cadence fields drive
+        # WHEN this is called — same sites as the standalone runner)
+        self.fleet.snapshot_cluster(self.idx, gen, history, pending,
+                                    free, r)
+
+    def _build_sim(self):
+        # the fleet owns ONE batched tree (parallel.make_fleet_sims,
+        # row i == make_sim(seed_i) exactly); a per-shell device sim
+        # would allocate the whole fleet tree F times over
+        return None
+
+
+class FleetRunner:
+    """Drives `--fleet N`: N cluster shells in lockstep against one
+    cluster-batched SimState, scanned/bumped/probed in single vmapped
+    dispatches and sharded `("dp", "sp")` under `--mesh dp,sp`."""
+
+    def __init__(self, test: dict):
+        self.test = test
+        self.spec = core_mod.FleetSpec.from_test(test)
+        F = self.spec.fleet
+        self.mesh = None
+        self._shardings = None
+        mesh_spec = test.get("mesh")
+        if mesh_spec:
+            from .. import parallel
+            self.mesh = parallel.mesh_from_spec(mesh_spec)
+            dp = self.mesh.shape["dp"]
+            if F % dp:
+                raise ValueError(
+                    f"--fleet {F} with --mesh {mesh_spec}: the fleet "
+                    f"axis shards over dp, so fleet must be a multiple "
+                    f"of dp={dp}")
+            if dp > 1 and self.mesh.shape["sp"] > 1:
+                # the PR 2 hazard, one axis over: GSPMD scatter-set is
+                # not value-safe over a mesh axis the operands are
+                # replicated on (per-replica contributions combine
+                # additively), and with BOTH axes > 1 every in-scan
+                # scatter is replicated over one of them (observed:
+                # corrupted reply rows under --fleet 2 --mesh 2,2).
+                # Shard the fleet over ALL devices (dp,1) or the
+                # per-cluster axes over all devices (1,sp) instead.
+                raise ValueError(
+                    f"--fleet with --mesh {mesh_spec}: dp and sp cannot "
+                    f"both exceed 1 (GSPMD scatter-set is not value-safe "
+                    f"over the replicated axis); use --mesh "
+                    f"{self.mesh.size},1 or --mesh 1,{self.mesh.size}")
+        # one full runner shell per cluster, each built from the exact
+        # option set its standalone run would use
+        self.shells: list[_FleetClusterShell] = []
+        for i in range(F):
+            t_i = core_mod.build_test(self.spec.cluster_opts(test, i))
+            t_i["nemesis"] = (True if t_i["nemesis_pkg"]["generator"]
+                              is not None else None)
+            # shells never write files; the fleet's dir lets graceful
+            # preemption (Preempted.checkpoint_dir) name the right place
+            t_i["store_dir"] = test.get("store_dir")
+            self.shells.append(_FleetClusterShell(t_i, fleet=self, idx=i))
+        s0 = self.shells[0]
+        self.program, self.cfg = s0.program, s0.cfg
+        self.concurrency = s0.concurrency
+        self.reply_log_cap = s0.reply_log_cap
+        for sh in self.shells[1:]:
+            # the fleet shares ONE compiled program: every swept
+            # dimension must leave the static shapes untouched
+            if sh.cfg != s0.cfg:
+                raise ValueError(
+                    f"fleet clusters disagree on the compiled network "
+                    f"shape (cluster 0: {s0.cfg} vs cluster {sh.idx}: "
+                    f"{sh.cfg}); sweeps may only vary seeds/schedules/"
+                    f"rates")
+        # batched state: row i IS shell i's standalone initial state —
+        # parallel.make_fleet_sims pins row i == make_sim(seed_i)
+        # exactly (one broadcast seed-independent base + stacked PRNG
+        # keys, instead of F full per-shell device trees). The
+        # broadcast rows (and durable's view of nodes) alias the base
+        # buffers, so dealias before donation; p_loss is uniform across
+        # the fleet (sweeps only vary seeds/schedules/rates)
+        from .. import parallel
+        self.sim = parallel.make_fleet_sims(
+            self.program, self.cfg,
+            seeds=[sh.test.get("seed", 0) for sh in self.shells])
+        if donation_enabled():
+            self.sim = dealias(self.sim)
+        if test.get("p_loss"):
+            self.sim = self.sim.replace(
+                net=T.flaky(self.sim.net, float(test["p_loss"])))
+        if self.mesh is not None:
+            from .. import parallel
+            inject_ex = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (F,) + a.shape),
+                T.Msgs.empty(max(self.concurrency, 1)))
+            self._shardings = parallel.fleet_scan_shardings(
+                self.mesh, self.sim, inject_ex)
+            self.sim = jax.device_put(self.sim, self._shardings[0])
+            log.info("fleet mesh mode: %d clusters over dp=%d sp=%d "
+                     "(%d devices)", F, self.mesh.shape["dp"],
+                     self.mesh.shape["sp"], self.mesh.size)
+
+        from ..checkers.netstats import TransferStats
+        self.transfer = TransferStats()
+        self._state_cache = None     # host nodes cache (read_state)
+        self._sim_cache = None       # host full-tree cache (snapshots)
+        self._scan_fn = None
+        self._quiet_fn = None
+        self._restart_fn = None
+        self._pack = None
+        self._empty_inject = T.Msgs.empty(max(self.concurrency, 1))
+        donate = (0,) if donation_enabled() else ()
+        self._bump_fn = jax.jit(
+            lambda sim, ks: sim.replace(net=sim.net.replace(
+                round=sim.net.round + ks)),
+            donate_argnums=donate, **self._pins(n_args=2))
+        # fleet checkpointing (per-cluster snapshots coalesced per wave)
+        ck = test.get("checkpoint_every")
+        self.checkpoint_every = ck
+        self.sync_checkpoint = bool(test.get("sync_checkpoint"))
+        self.on_preempt = str(test.get("on_preempt") or "checkpoint")
+        self._snaps: list[dict | None] = [None] * F
+        self._snaps_dirty = False
+        self._ckpt_writer = None
+        self._preempt = threading.Event()
+        self._setup_mids = None
+        self._states: list[dict | None] = [None] * F
+        self.final_rounds = [0] * F
+
+    # --- device plumbing -------------------------------------------------
+
+    def _pins(self, n_args: int) -> dict:
+        if self._shardings is None:
+            return {}
+        sim_sh, _inj_sh, scalar_sh = self._shardings
+        return {"in_shardings": (sim_sh,) + (scalar_sh,) * (n_args - 1),
+                "out_shardings": sim_sh}
+
+    def _reshard(self):
+        if self._shardings is not None:
+            self.sim = jax.device_put(self.sim, self._shardings[0])
+
+    def _invalidate(self):
+        self._state_cache = None
+        self._sim_cache = None
+
+    def apply_net_row(self, i: int, fn):
+        """Nemesis mask surgery on ONE cluster's net row: extract row i,
+        apply the host-side update, scatter it back. Eager (outside
+        jit) like the standalone path — nemesis ops are rare."""
+        net = self.sim.net
+        row = jax.tree.map(lambda a: a[i], net)
+        new = fn(row)
+        self.sim = self.sim.replace(net=jax.tree.map(
+            lambda b, x: b.at[i].set(x), net, new))
+        self._reshard()
+        self._invalidate()
+
+    def restart_row(self, i: int, mask):
+        """Crash-restart (stop-kill) for one cluster: the vmapped
+        restore runs over the whole fleet with an all-False mask
+        everywhere but row i — restore under a False mask is the
+        identity, so other clusters' values are untouched."""
+        if self._restart_fn is None:
+            prog = self.program
+
+            def _one(sim, m):
+                nodes = prog.restore(prog.init_state(), sim.durable,
+                                     sim.nodes, m)
+                net = sim.net.replace(down=sim.net.down & ~m)
+                return sim.replace(nodes=nodes, net=net,
+                                   durable=prog.durable_view(nodes))
+            self._restart_fn = jax.jit(
+                jax.vmap(_one),
+                donate_argnums=(0,) if donation_enabled() else (),
+                **self._pins(n_args=2))
+        m = np.zeros((self.spec.fleet, self.cfg.n_nodes), bool)
+        m[i] = np.asarray(mask, bool)
+        self.sim = self._restart_fn(self.sim, jnp.asarray(m))
+        self._invalidate()
+
+    def read_state(self, i: int, node_idx: int):
+        if self._state_cache is None:
+            self._state_cache = self.transfer.fetch(self.sim.nodes)
+        # copy the row out (CPU device_get returns zero-copy views; see
+        # TpuRunner._read_state)
+        return jax.tree.map(lambda a: np.array(a[i, node_idx]),
+                            self._state_cache)
+
+    def shell_next_mid(self, i: int) -> int:
+        if self._setup_mids is None:
+            self._setup_mids = np.asarray(
+                self.transfer.fetch(self.sim.net.next_mid))
+        return int(self._setup_mids[i])
+
+    def _probe_quiet(self) -> np.ndarray:
+        if self._quiet_fn is None:
+            prog_q = getattr(self.program, "quiescent", None)
+
+            def quiet(sim):
+                q = ~sim.net.pool.valid.any()
+                if sim.channels is not None:
+                    q = q & ~sim.channels.valid.any()
+                if prog_q is not None:
+                    q = q & prog_q(sim.nodes)
+                return q
+            self._quiet_fn = jax.jit(jax.vmap(quiet))
+        return np.asarray(self.transfer.fetch(self._quiet_fn(self.sim)))
+
+    def _bump_rows(self, ks_by_idx: dict):
+        ks = np.zeros(self.spec.fleet, np.int32)
+        for i, k in ks_by_idx.items():
+            ks[i] = k
+        self.sim = self._bump_fn(self.sim, jnp.asarray(ks))
+        self._invalidate()
+
+    def _exec_fleet_scan(self, reqs: dict) -> dict:
+        """One vmapped dispatch covering every cluster with a pending
+        scan request; the rest are held by the active mask. Returns
+        {cluster: (k_executed, replies)}."""
+        F = self.spec.fleet
+        injects, kmax = [], np.ones(F, np.int32)
+        stop = np.ones(F, bool)
+        active = np.zeros(F, bool)
+        for i in range(F):
+            req = reqs.get(i)
+            if req is None:
+                injects.append(self._empty_inject)
+                continue
+            inject_rows, k_max, st, _hist, _r = req
+            injects.append(self.shells[i]._encode_inject(inject_rows))
+            kmax[i], stop[i], active[i] = k_max, st, True
+        inject = jax.tree.map(lambda *xs: jnp.stack(xs), *injects)
+        if self._scan_fn is None:
+            from ..sim import make_fleet_scan_fn
+            self._scan_fn = make_fleet_scan_fn(
+                self.program, self.cfg, reply_cap=self.reply_log_cap,
+                donate=True, shardings=self._shardings)
+        self.sim, _cm, k, rl = self._scan_fn(
+            self.sim, inject, jnp.asarray(kmax), jnp.asarray(stop),
+            jnp.asarray(active))
+        self._invalidate()
+        # the batched stretch is in flight: overlap each cluster's
+        # host-side analysis of its last segment with the device time
+        for i, req in sorted(reqs.items()):
+            self.shells[i]._overlap_feed(req[3])
+        if self._pack is None:
+            self._pack = TpuRunner._make_packer(
+                (rl, k, self.sim.net.next_mid))
+        pack, unpack = self._pack
+        # ONE fetched array for the whole fleet per wave
+        flat = self.transfer.fetch(pack((rl, k, self.sim.net.next_mid)))
+        (rlog, rounds, plog, rn), k, next_mid = unpack(flat)
+        W = int(getattr(self.program, "reply_payload_words", 0) or 0)
+        out = {}
+        for i in sorted(reqs):
+            sh = self.shells[i]
+            sh._next_mid = int(next_mid[i])
+            row_log = jax.tree.map(lambda a, i=i: a[i], rlog)
+            out[i] = (int(k[i]), sh._decode_replies(
+                row_log, rounds[i], plog[i] if W else (), int(rn[i])))
+        return out
+
+    # --- checkpoint / preemption ----------------------------------------
+
+    def _sim_host(self):
+        if self._sim_cache is None:
+            self._sim_cache = self.transfer.fetch(self.sim)
+        return self._sim_cache
+
+    def snapshot_cluster(self, i, gen, history, pending, free, r):
+        """A stretch-boundary snapshot of ONE cluster: its sim row
+        (device-sliced first, so the host pull is O(row) — not the
+        whole fleet tree per snapshot) and its mutable host state,
+        pickled immediately so later mutation can't tear it."""
+        sh = self.shells[i]
+        t0 = time.perf_counter()
+        row = jax.tree.map(np.array, self.transfer.fetch(
+            jax.tree.map(lambda a, i=i: a[i], self.sim)))
+        meta = {
+            "r": r,
+            "dispatches": sh._dispatches,
+            "gen": gen,
+            "pending": dict(pending),
+            "free": set(free),
+            "intern": sh.intern,
+            "nemesis_rng": (sh.nemesis.rng_state()
+                            if sh.nemesis else None),
+            "history_columns": history.snapshot_columns(),
+        }
+        self._snaps[i] = {
+            "r": r, "sim": row,
+            "blob": pickle.dumps(meta,
+                                 protocol=pickle.HIGHEST_PROTOCOL)}
+        self._snaps_dirty = True
+        self.transfer.ckpt_blocked_s += time.perf_counter() - t0
+
+    def _seed_initial_snaps(self):
+        """Before the first dispatch, every cluster's snapshot is its
+        initial state (blob None = resume starts it fresh), so a fleet
+        checkpoint written early still covers the whole fleet."""
+        host = self._sim_host()
+        for i in range(self.spec.fleet):
+            if self._snaps[i] is None:
+                self._snaps[i] = {
+                    "r": 0, "blob": None,
+                    "sim": jax.tree.map(lambda a, i=i: np.array(a[i]),
+                                        host)}
+
+    def _seed_resume_snaps(self, resume: dict, rounds: list):
+        """On --resume, every cluster's snapshot starts as exactly what
+        the checkpoint recorded (sim row + meta blob), so a fleet
+        checkpoint written before cluster i's next stretch boundary
+        still resumes i from its CHECKPOINTED state — never from
+        scratch with a mid-run sim row."""
+        metas = resume["clusters"]
+        for i in range(self.spec.fleet):
+            self._snaps[i] = {
+                "r": rounds[i], "blob": metas[i],
+                "sim": jax.tree.map(lambda a, i=i: np.array(a[i]),
+                                    resume["sim"])}
+
+    def _write_checkpoint(self, done, sync: bool = False):
+        """Coalesces the freshest per-cluster snapshots into one framed
+        checkpoint file (checkpoint.py): background writer unless
+        --sync-checkpoint/preemption forces the inline write."""
+        from .. import checkpoint as cp
+        if not self._snaps_dirty:
+            return
+        t0 = time.perf_counter()
+        rows = [s["sim"] for s in self._snaps]
+        state = {
+            "fingerprint": cp.fingerprint(self.test),
+            "r": min(s["r"] for s in self._snaps),
+            "sim": jax.tree.map(lambda *xs: np.stack(xs), *rows),
+            "meta_blob": pickle.dumps(
+                {"clusters": [s["blob"] for s in self._snaps],
+                 "done": list(done),
+                 "finals": list(self.final_rounds)},
+                protocol=pickle.HIGHEST_PROTOCOL),
+        }
+        store_dir = self.test["store_dir"]
+        if sync or self.sync_checkpoint:
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()
+            cp.save(store_dir, state)
+        else:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = cp.CheckpointWriter()
+            self._ckpt_writer.submit(store_dir, state)
+        self._snaps_dirty = False
+        self.transfer.ckpt_saves += 1
+        self.transfer.ckpt_blocked_s += time.perf_counter() - t0
+        log.info("fleet checkpoint (%d clusters) -> %s%s",
+                 self.spec.fleet, store_dir, " (sync)" if sync else "")
+
+    def _finish_checkpoints(self):
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+            self.transfer.ckpt_write_s = self._ckpt_writer.write_s
+
+    # --- the wave scheduler ----------------------------------------------
+
+    def run(self, resume: dict | None = None) -> list[History]:
+        """Runs the whole fleet to completion; returns one History per
+        cluster (index-aligned with the shells)."""
+        from .. import checkpoint as cp
+        F = self.spec.fleet
+        # `finished` = the cluster's loop COMPLETED (its history is
+        # final; a resume replays it from the checkpoint). Clusters this
+        # run stops early (preemption) are merely descheduled — the
+        # checkpoint must record them as unfinished so --resume
+        # continues them.
+        finished = [False] * F
+        cluster_resumes: list[dict | None] = [None] * F
+        if resume is not None:
+            metas = resume["clusters"]
+            finished = list(resume["done"])
+            self.final_rounds = list(resume["finals"])
+            self.sim = (dealias(resume["sim"]) if donation_enabled()
+                        else resume["sim"])
+            for i, blob in enumerate(metas):
+                if blob is None:
+                    continue
+                meta = pickle.loads(blob)
+                meta["history"] = History.from_columns(
+                    meta.pop("history_columns"))
+                cluster_resumes[i] = meta
+            # seed the coalesced-checkpoint state from the checkpoint
+            # itself BEFORE the device tree can move on
+            self._seed_resume_snaps(
+                resume, [m["r"] if m else 0 for m in cluster_resumes])
+            self._reshard()
+            self._invalidate()
+            live = [m["r"] for i, m in enumerate(cluster_resumes)
+                    if m and not finished[i]]
+            log.info("fleet resumed: %d/%d clusters done, live rounds "
+                     "%s..%s", sum(finished), F,
+                     min(live) if live else "-", max(live) if live else "-")
+
+        # per-shell host state + coroutines (finished clusters only
+        # replay their checkpointed history)
+        self._setup_mids = None
+        steps: list = [None] * F
+        for i, sh in enumerate(self.shells):
+            if finished[i]:
+                st = cluster_resumes[i] or {}
+                self._states[i] = {"history": st.get("history",
+                                                     History())}
+                continue
+            self._states[i] = sh._setup_run(cluster_resumes[i])
+            steps[i] = sh._loop_steps(**self._states[i])
+        if self.checkpoint_every:
+            self._seed_initial_snaps()
+
+        # graceful preemption: same contract as the standalone runner —
+        # finish in-flight work, checkpoint the fleet, exit 75
+        import signal as _signal
+        prev_handlers = {}
+        if self.on_preempt == "checkpoint" and \
+                threading.current_thread() is threading.main_thread():
+            def _on_signal(signum, frame):
+                if self._preempt.is_set():
+                    for s, h in prev_handlers.items():
+                        try:
+                            _signal.signal(s, h)
+                        except (ValueError, OSError):  # pragma: no cover
+                            pass
+                    raise KeyboardInterrupt
+                log.warning("received %s: draining the in-flight wave, "
+                            "then checkpointing the fleet (signal again "
+                            "to abort)", _signal.Signals(signum).name)
+                self._preempt.set()
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    prev_handlers[sig] = _signal.signal(sig, _on_signal)
+                except (ValueError, OSError):   # pragma: no cover
+                    pass
+        try:
+            self._waves(steps, finished)
+        except BaseException:
+            for sh in self.shells:
+                if sh.pipeline is not None:
+                    sh.pipeline.close()
+            try:
+                self._finish_checkpoints()
+            except Exception as e:
+                log.error("fleet checkpoint writer failed during "
+                          "unwind: %s", e)
+            raise
+        finally:
+            for sig, h in prev_handlers.items():
+                try:
+                    _signal.signal(sig, h)
+                except (ValueError, OSError):   # pragma: no cover
+                    pass
+        self._finish_checkpoints()
+        histories = []
+        for i, sh in enumerate(self.shells):
+            history = self._states[i]["history"]
+            sh.final_round = self.final_rounds[i]
+            if sh.pipeline is not None:
+                overlapped = sh.pipeline.busy_s
+                sh._overlap_feed(history)
+                sh.pipeline.finish()
+                self.transfer.overlapped_s += overlapped
+            histories.append(history)
+        log.info("fleet run finished: %d clusters, rounds %d..%d, "
+                 "%d history ops total, %d host drains (%d bytes)",
+                 F, min(self.final_rounds), max(self.final_rounds),
+                 sum(len(h) for h in histories), self.transfer.drains,
+                 self.transfer.host_bytes)
+        return histories
+
+    def _waves(self, steps, finished):
+        """Advances every live cluster's coroutine to its next scan
+        request (servicing quiet probes and bumps in batched
+        sub-waves), then answers all scans with ONE vmapped dispatch.
+        Repeats until the whole fleet is done (or every live cluster
+        has honored a preemption signal — `stopped` but not
+        `finished`, so a --resume continues them)."""
+        from .. import checkpoint as cp
+        F = self.spec.fleet
+        preempted = False
+        stopped = list(finished)
+        ready = [(i, None) for i in range(F) if not stopped[i]]
+        while True:
+            if self._preempt.is_set() and not preempted:
+                preempted = True
+                for i in range(F):
+                    if not stopped[i]:
+                        self.shells[i]._preempt.set()
+            scan_reqs: dict = {}
+            while ready:
+                quiet_wait, bump_wait = [], {}
+                for i, resp in ready:
+                    try:
+                        req = steps[i].send(resp)
+                    except StopIteration as e:
+                        finished[i] = stopped[i] = True
+                        self.final_rounds[i] = e.value
+                        if self.checkpoint_every:
+                            # final snapshot: a later checkpoint must
+                            # carry this cluster's complete history
+                            st = self._states[i]
+                            self.snapshot_cluster(
+                                i, self.shells[i]._gen_live,
+                                st["history"], st["pending"],
+                                st["free"], e.value)
+                        continue
+                    except cp.Preempted:
+                        # the shell wrote its boundary snapshot via
+                        # _save_checkpoint before unwinding; it is NOT
+                        # finished — a resume picks it back up
+                        stopped[i] = True
+                        self.final_rounds[i] = self.shells[i]._r_live
+                        continue
+                    kind = req[0]
+                    if kind == "quiet":
+                        quiet_wait.append(i)
+                    elif kind == "bump":
+                        bump_wait[i] = req[1]
+                    else:
+                        scan_reqs[i] = req[1:]
+                ready = []
+                if bump_wait:
+                    self._bump_rows(bump_wait)
+                    ready += [(i, None) for i in sorted(bump_wait)]
+                if quiet_wait:
+                    qs = self._probe_quiet()
+                    ready += [(i, bool(qs[i]))
+                              for i in sorted(quiet_wait)]
+            if scan_reqs:
+                results = self._exec_fleet_scan(scan_reqs)
+                ready = [(i, results[i]) for i in sorted(scan_reqs)]
+            if self.checkpoint_every:
+                self._write_checkpoint(finished)
+            if preempted and not ready:
+                live = [i for i in range(F) if not stopped[i]]
+                if not live:
+                    # the whole fleet has drained: one final sync
+                    # checkpoint covering every cluster's freshest
+                    # snapshot (finished clusters that never snapshotted
+                    # — no --checkpoint-every — snapshot now, so their
+                    # complete histories survive the resume)
+                    if not self.checkpoint_every:
+                        for i in range(F):
+                            if finished[i] and self._states[i] and \
+                                    "pending" in (self._states[i] or {}):
+                                st = self._states[i]
+                                self.snapshot_cluster(
+                                    i, self.shells[i]._gen_live,
+                                    st["history"], st["pending"],
+                                    st["free"], self.final_rounds[i])
+                        self._seed_initial_snaps()
+                    self._write_checkpoint(finished, sync=True)
+                    store_dir = self.test.get("store_dir")
+                    raise cp.Preempted(
+                        min(self.final_rounds[i] for i in range(F)
+                            if not finished[i]) if not all(finished)
+                        else max(self.final_rounds),
+                        store_dir or None)
+            if not ready:
+                return
+
+
+def run_fleet_test(test: dict, test_dir: str) -> dict:
+    """Executes a `--fleet N` TPU-path test end to end: run the fleet,
+    check every cluster with its own checker tree, store per-cluster
+    artifacts under `cluster-XXXX/`, and write a fleet-level results
+    summary. Routed from `run_tpu_test`."""
+    from .. import checkpoint as cp
+    test["store_dir"] = test_dir
+    # the fleet re-derives each cluster's option set from the ORIGINAL
+    # options (FleetSpec.cluster_opts), so the runner is built before
+    # run_tpu_test's usual nemesis truthiness rewrite
+    runner = FleetRunner(test)
+    test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
+        else None
+
+    resume = None
+    if test.get("resume"):
+        resume = cp.load(test["resume"])
+        cp.check_fingerprint(resume, test)
+
+    histories = runner.run(resume=resume)
+
+    F = runner.spec.fleet
+    cluster_results = []
+    all_valid = True
+    for i, sh in enumerate(runner.shells):
+        # give the shell its row back: the per-cluster checkers (device
+        # counters, invalid-state counters) read runner.sim
+        sh.sim = jax.tree.map(lambda a, i=i: a[i], runner.sim)
+        t_i = sh.test
+        cdir = os.path.join(test_dir, f"cluster-{i:04d}")
+        os.makedirs(cdir, exist_ok=True)
+        t_i["store_dir"] = cdir
+        t_i["checker"].checkers["net"] = TpuNetStats(sh)
+        if sh.pipeline is not None:
+            t_i["analysis"] = sh.pipeline
+        res_i = t_i["checker"].check(t_i, histories[i], {})
+        if sh.pipeline is not None:
+            # per-cluster rows only: each pipeline saw exactly its own
+            # cluster's history (no fleet-level double counting)
+            res_i["analysis-pipeline"] = sh.pipeline.report()
+        res_i["cluster"] = i
+        res_i["seed"] = t_i.get("seed")
+        if runner.spec.sweep == "nemesis":
+            res_i["nemesis-seed"] = t_i.get("nemesis_seed")
+        if runner.spec.sweep == "capacity":
+            res_i["rate"] = t_i.get("rate")
+        store.write_history(cdir, histories[i])
+        store.write_results(cdir, res_i)
+        all_valid = all_valid and bool(res_i.get("valid"))
+        cluster_results.append(res_i)
+
+    results = {
+        "fleet": F,
+        "fleet-sweep": runner.spec.sweep,
+        "mesh": str(test.get("mesh")) if test.get("mesh") else None,
+        "valid": all_valid,
+        "clusters": cluster_results,
+        "final-rounds": list(runner.final_rounds),
+        **runner.transfer.as_dict(),
+    }
+    if resume is not None:
+        results["resumed-at-round"] = resume["r"]
+    # ONE static-audit block for the whole fleet: the vmapped fleet
+    # step functions are shared by every cluster, so per-cluster blocks
+    # would repeat the identical trace F times
+    if test.get("audit", True) and \
+            os.environ.get("MAELSTROM_AUDIT") != "0":
+        from ..analyze import audit_fleet_runner
+        results["static-audit"] = audit_fleet_runner(
+            runner, trace=bool(test.get("audit_trace")))
+
+    store.write_history(test_dir, histories[0] if F == 1 else
+                        _merged_history(histories))
+    store.write_results(test_dir, results)
+    from ..core import DEFAULTS
+    store.write_test(test_dir, {k: str(test[k]) for k in DEFAULTS
+                                if k in test})
+    store.mark_complete(test_dir)
+    log.info("Fleet results valid? %s (%d clusters, store: %s)",
+             results["valid"], F, test_dir)
+    return results
+
+
+def _merged_history(histories) -> History:
+    """A fleet-level history view for the store dir: every cluster's
+    ops concatenated with the process tagged `c<cluster>:<process>` so
+    rows stay attributable. Checking always runs per cluster — this
+    exists only so `serve` has something to render at the top level."""
+    merged = History()
+    for i, h in enumerate(histories):
+        for o in h:
+            merged.append_row(o.type, o.f, o.value,
+                              f"c{i}:{o.process}", o.time, o.error,
+                              o.final)
+    return merged

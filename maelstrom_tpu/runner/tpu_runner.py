@@ -90,16 +90,20 @@ class TpuCombinedNemesis(NemesisDecisions):
         return m
 
     def invoke(self, op):
+        # All mask surgery routes through runner._net_surgery(net -> net')
+        # so the SAME executor serves the standalone runner (which swaps
+        # its own sim.net) and one cluster of a fleet (whose shell
+        # targets its row of the batched fleet tree).
         f = op["f"]
         r = self.runner
         if f == "start-partition":
             name, grudge = self.next_grudge()
             groups, matrix = _grudge_matrix(self.nodes, grudge)
-            r.sim = r.sim.replace(
-                net=T.partition_grudge(r.sim.net, groups, matrix))
+            r._net_surgery(
+                lambda net: T.partition_grudge(net, groups, matrix))
             return {**op, "type": "info", "value": name}
         if f == "stop-partition":
-            r.sim = r.sim.replace(net=T.heal(r.sim.net))
+            r._net_surgery(T.heal)
             return {**op, "type": "info", "value": "healed"}
         if f == "start-kill":
             # targets come straight from the kill decision stream — no
@@ -108,8 +112,8 @@ class TpuCombinedNemesis(NemesisDecisions):
             # paused and killed is simply down until both faults lift.
             targets = self.next_kill_targets()
             self.killed = sorted(set(self.killed) | set(targets))
-            r.sim = r.sim.replace(
-                net=T.set_down(r.sim.net, self._mask(self.killed)))
+            mask = self._mask(self.killed)
+            r._net_surgery(lambda net: T.set_down(net, mask))
             r._state_cache = None
             return {**op, "type": "info", "value": f"killed {targets}"}
         if f == "stop-kill":
@@ -121,21 +125,20 @@ class TpuCombinedNemesis(NemesisDecisions):
             targets = self.next_pause_targets()
             self.paused_nodes = sorted(set(self.paused_nodes)
                                        | set(targets))
-            r.sim = r.sim.replace(
-                net=T.set_paused(r.sim.net,
-                                 self._mask(self.paused_nodes)))
+            mask = self._mask(self.paused_nodes)
+            r._net_surgery(lambda net: T.set_paused(net, mask))
             return {**op, "type": "info", "value": f"paused {targets}"}
         if f == "stop-pause":
             resumed, self.paused_nodes = self.paused_nodes, []
-            r.sim = r.sim.replace(
-                net=T.set_paused(r.sim.net, self._mask([])))
+            mask = self._mask([])
+            r._net_surgery(lambda net: T.set_paused(net, mask))
             return {**op, "type": "info", "value": f"resumed {resumed}"}
         if f == "start-duplicate":
             p = self.next_dup_prob()
-            r.sim = r.sim.replace(net=T.set_duplication(r.sim.net, p))
+            r._net_surgery(lambda net: T.set_duplication(net, p))
             return {**op, "type": "info", "value": f"duplicate p={p}"}
         if f == "stop-duplicate":
-            r.sim = r.sim.replace(net=T.set_duplication(r.sim.net, 0.0))
+            r._net_surgery(lambda net: T.set_duplication(net, 0.0))
             return {**op, "type": "info", "value": "duplicate off"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
@@ -316,14 +319,7 @@ class TpuRunner:
         # dealias: the runner's compiled dispatches donate their sim
         # carry, and a donated tree may not contain one buffer twice
         # (skipped when donation is off — it's a one-time full-tree copy)
-        self.sim = make_sim(self.program, self.cfg,
-                            seed=test.get("seed", 0),
-                            track_edge_send_round=self.journal_rows)
-        if donation_enabled():
-            self.sim = dealias(self.sim)
-        if test.get("p_loss"):
-            self.sim = self.sim.replace(
-                net=T.flaky(self.sim.net, float(test["p_loss"])))
+        self.sim = self._build_sim()
         # host-transfer accounting: every device->host drain is booked
         # here, so tests and benches can assert extraction stays off the
         # hot path (drains ~ dispatches, not ~ simulated rounds)
@@ -357,19 +353,22 @@ class TpuRunner:
             from .. import parallel
             self.mesh = parallel.mesh_from_spec(mesh_spec)
             if self.mesh.shape["dp"] != 1:
-                # dp shards a CLUSTER axis; the interactive runner
-                # simulates exactly one cluster, so dp > 1 would merely
-                # replicate state over dp — and GSPMD's scatter
-                # partitioning is not value-safe for replicated
+                # dp shards the fleet's CLUSTER axis; a standalone
+                # TpuRunner simulates exactly one cluster, so dp > 1
+                # would merely replicate state over dp — and GSPMD's
+                # scatter partitioning is not value-safe for replicated
                 # scatter-set operands (observed: per-replica
                 # contributions combined additively, doubling inbox
-                # rows). The cluster-batched entry points
-                # (parallel.make_cluster_*) own the dp axis.
+                # rows). The fleet runner (--fleet N with N a multiple
+                # of dp) owns the dp axis.
                 raise ValueError(
-                    f"--mesh {mesh_spec}: the interactive runner "
-                    f"simulates one cluster, so the cluster axis must "
-                    f"be 1 (use --mesh 1,{self.mesh.size}; dp > 1 "
-                    f"belongs to the cluster-batched bench paths)")
+                    f"--mesh {mesh_spec}: this run simulates one "
+                    f"cluster, so the cluster axis must be 1 (use "
+                    f"--mesh 1,{self.mesh.size}, or give dp a fleet to "
+                    f"shard: --fleet N --mesh "
+                    f"{self.mesh.shape['dp']},"
+                    f"{self.mesh.shape['sp']} runs N independent "
+                    f"cluster instances, N % dp == 0)")
             inject_ex = T.Msgs.empty(max(self.concurrency, 1))
             self._shardings = parallel.scan_shardings(
                 self.mesh, self.sim, inject_ex)
@@ -457,7 +456,37 @@ class TpuRunner:
         if self._shardings is not None:
             self.sim = jax.device_put(self.sim, self._shardings[0])
 
+    def _net_surgery(self, fn):
+        """Applies a host-side fault update `net -> net'` (partition
+        grudges, down/paused masks, duplication probability) to this
+        runner's simulation. A fleet cluster shell overrides this to
+        target its own row of the batched fleet tree
+        (runner/fleet_runner.py)."""
+        self.sim = self.sim.replace(net=fn(self.sim.net))
+
+    def _init_next_mid(self):
+        """Primes the host mirror of the device message-id counter
+        (refreshed by every dispatch's combined fetch). The fleet shell
+        overrides this to read its row of the batched counter."""
+        self._next_mid = int(self.transfer.fetch(self.sim.net.next_mid))
+
     # --- helpers ---
+
+    def _build_sim(self):
+        """This runner's INITIAL simulation state (seeded PRNG key,
+        loss probability installed, dealiased when donation is on).
+        Factored out of __init__ so the fleet runner can rebuild a
+        cluster's pristine row on demand (checkpointing a cluster that
+        has not reached its first stretch boundary yet)."""
+        sim = make_sim(self.program, self.cfg,
+                       seed=self.test.get("seed", 0),
+                       track_edge_send_round=self.journal_rows)
+        if donation_enabled():
+            sim = dealias(sim)
+        if self.test.get("p_loss"):
+            sim = sim.replace(
+                net=T.flaky(sim.net, float(self.test["p_loss"])))
+        return sim
 
     @staticmethod
     def _fault_set(test: dict) -> set:
@@ -678,11 +707,24 @@ class TpuRunner:
 
     # --- main loop ---
 
-    def run(self, resume: dict | None = None) -> History:
-        test, cfg, program = self.test, self.cfg, self.program
-        N, C = cfg.n_nodes, self.concurrency
+    def _setup_run(self, resume: dict | None = None) -> dict:
+        """Builds the host-side run state the dispatch loop consumes —
+        generator tree, nemesis executor, worker bookkeeping, history —
+        applying a resume checkpoint when given. Returns the keyword
+        dict `_loop_steps` takes. Shared by the standalone `run()` and
+        the fleet runner (which calls it on every cluster shell; a
+        shell's resume meta carries no "sim" entry — the fleet restores
+        the batched tree itself)."""
+        test = self.test
+        C = self.concurrency
         gen = g.to_gen(test["generator"])
-        nemesis = (TpuCombinedNemesis(self, self.nodes, test.get("seed", 0))
+        # per-cluster nemesis decision streams: a fleet's `nemesis`
+        # sweep varies only the fault schedule across clusters, so the
+        # nemesis seed is independently overridable
+        nem_seed = test.get("nemesis_seed")
+        if nem_seed is None:
+            nem_seed = test.get("seed", 0)
+        nemesis = (TpuCombinedNemesis(self, self.nodes, nem_seed)
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
         self.nemesis = nemesis
@@ -696,9 +738,10 @@ class TpuRunner:
         if resume is not None:
             r = resume["r"]
             self._dispatches = resume["dispatches"]
-            self.sim = (dealias(resume["sim"]) if donation_enabled()
-                        else resume["sim"])
-            self._reshard()
+            if "sim" in resume:
+                self.sim = (dealias(resume["sim"]) if donation_enabled()
+                            else resume["sim"])
+                self._reshard()
             self._state_cache = None
             gen = resume["gen"]
             rh = resume["history"]
@@ -729,13 +772,24 @@ class TpuRunner:
             # whole stitched history and the checkers keep their fast
             # path (a partial pipeline would fail the check-time
             # row-count match and decline service, silently losing the
-            # overlap on every resumed run)
+            # overlap on every resumed run). In fleet mode each shell
+            # seeds its OWN pipeline with its own rows — per-cluster
+            # blocks never double-count another cluster's history.
             self.pipeline.seed_resumed(history, len(history))
             self._fed_upto = len(history)
-        # host mirror of the device message-id counter (refreshed by every
-        # dispatch's combined fetch) — read BEFORE the signal handlers
-        # install: a transfer failure here must not leak them
-        self._next_mid = int(self.transfer.fetch(self.sim.net.next_mid))
+        # host mirror of the device message-id counter (refreshed by
+        # every dispatch's combined fetch)
+        self._init_next_mid()
+        return dict(test=test, cfg=self.cfg, program=self.program,
+                    gen=gen, nemesis=nemesis, processes=processes,
+                    free=free, pending=pending, history=history,
+                    max_rounds=max_rounds, next_ckpt=next_ckpt, r=r)
+
+    def run(self, resume: dict | None = None) -> History:
+        # read state BEFORE the signal handlers install: a transfer
+        # failure in setup must not leak them
+        st = self._setup_run(resume)
+        history = st["history"]
         # graceful preemption (doc/checkpoint.md): SIGTERM/SIGINT set a
         # flag; the loop finishes the in-flight compiled stretch, writes
         # a final checkpoint, and unwinds with Preempted so the CLI can
@@ -767,9 +821,7 @@ class TpuRunner:
                 except (ValueError, OSError):   # pragma: no cover
                     pass
         try:
-            r = self._run_loop(test, cfg, program, gen, nemesis,
-                               processes, free, pending, history,
-                               max_rounds, next_ckpt, r)
+            r = self._drive(self._loop_steps(**st))
         except BaseException:
             # don't leak the analysis worker (and its history refs) on
             # generator/client errors or KeyboardInterrupt; land (or
@@ -795,8 +847,8 @@ class TpuRunner:
             if self.pipeline is not None:
                 self.pipeline.close()
             raise
-        if r >= max_rounds:
-            log.warning("TPU runner hit max_rounds=%d", max_rounds)
+        if r >= st["max_rounds"]:
+            log.warning("TPU runner hit max_rounds=%d", st["max_rounds"])
         self.final_round = r
         if self.pipeline is not None:
             # overlapped_s counts only worker time that ran while the
@@ -814,12 +866,51 @@ class TpuRunner:
                  self.transfer.blocked_s, self.transfer.overlapped_s)
         return history
 
-    def _run_loop(self, test, cfg, program, gen, nemesis, processes,
-                  free, pending, history, max_rounds, next_ckpt,
-                  r) -> int:
+    def _drive(self, steps) -> int:
+        """Standalone device driver for the `_loop_steps` coroutine:
+        answers quiet probes with this runner's own jitted probe,
+        performs bumps on self.sim, and executes scan requests as single
+        compiled dispatches. The fleet runner drives MANY clusters'
+        coroutines against one batched fleet tree instead
+        (runner/fleet_runner.py), batching their requests into vmapped
+        dispatches — the loop itself is identical, which is what makes
+        fleet clusters bit-identical to standalone runs."""
+        resp = None
+        while True:
+            try:
+                req = steps.send(resp)
+            except StopIteration as e:
+                return e.value
+            kind = req[0]
+            if kind == "scan":
+                resp = self._exec_scan(*req[1:])
+            elif kind == "bump":
+                self.sim = self._bump(self.sim, jnp.int32(req[1]))
+                resp = None
+            else:                   # "quiet"
+                resp = self._quiet()
+
+    def _loop_steps(self, test, cfg, program, gen, nemesis, processes,
+                    free, pending, history, max_rounds, next_ckpt, r):
+        """The host-side dispatch loop as a device-agnostic coroutine.
+
+        All device interaction happens through three yielded request
+        kinds — ``("quiet",) -> bool``, ``("bump", k) -> None``, and
+        ``("scan", inject_rows, k_max, stop, history, r) ->
+        (k_executed, replies)`` — so the SAME loop code drives a
+        standalone runner (`_drive` answers against self.sim) and one
+        cluster of a fleet (`FleetRunner` coalesces many loops' requests
+        into single vmapped dispatches over the batched cluster axis).
+        Returns the final virtual round.
+
+        `self._gen_live`/`self._r_live` expose the (rebound) generator
+        tree and round at every stretch boundary: the fleet's coalesced
+        checkpointing snapshots them — everything else it needs
+        (pending/free/history/intern/nemesis) is shared mutable state."""
         N, C = cfg.n_nodes, self.concurrency
         exhausted = False
         while r < max_rounds:
+            self._gen_live, self._r_live = gen, r
             # stretch boundary: the previous dispatch has landed and its
             # replies are in the history, so this is the graceful spot
             # to honor a pending SIGTERM/SIGINT
@@ -903,40 +994,17 @@ class TpuRunner:
             # side-effect-free, so skipping them is equivalent). Jumping
             # the full bound matters on remote devices, where every bump
             # is a host<->device round trip.
-            if not inject_rows and not pending and self._quiet():
+            if not inject_rows and not pending and (yield ("quiet",)):
                 k = self._scan_bound(gen, ctx, pending, r, next_ckpt,
                                      max_rounds)
-                self.sim = self._bump(self.sim, jnp.int32(k))
+                yield ("bump", k)
                 r += k
                 if next_ckpt is not None and r >= next_ckpt:
                     self._save_checkpoint(gen, history, pending, free, r)
                     next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
-            # one fused dispatch: this round's injections (possibly none)
-            # plus the scan to the next host-relevant round, with every
-            # reply collected into a compact log. On remote backends each
-            # dispatch is a full round trip, so op count per dispatch is
-            # the whole performance story.
-            inject = T.Msgs.empty(max(C, 1))
             if inject_rows:
-                M = len(inject_rows)
-                proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
-                inject = inject.replace(
-                    valid=jnp.arange(max(C, 1)) < M,
-                    src=jnp.asarray(
-                        list(np.array(proc) + N) + [0] * (max(C, 1) - M),
-                        T.I32),
-                    dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
-                                     T.I32),
-                    type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
-                                     T.I32),
-                    a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M),
-                                  T.I32),
-                    b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M),
-                                  T.I32),
-                    c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
-                                  T.I32))
                 # next_mid is mirrored on the host (refreshed in every
                 # dispatch's combined fetch) — reading it from the
                 # device here would cost a round trip per injection
@@ -945,80 +1013,18 @@ class TpuRunner:
                     pending[base_mid + j] = (p, o, ni,
                                              r + self.timeout_rounds)
 
-            # bound computed with the just-injected ops already pending,
-            # so their timeout deadlines cap the stretch
+            # one fused dispatch: this round's injections (possibly none)
+            # plus the scan to the next host-relevant round, with every
+            # reply collected into a compact log. On remote backends each
+            # dispatch is a full round trip, so op count per dispatch is
+            # the whole performance story. The bound is computed with the
+            # just-injected ops already pending, so their timeout
+            # deadlines cap the stretch.
             k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
                                      max_rounds)
             stop = self._stop_on_reply(gen, ctx, pending, free)
-            if self.journal is not None:
-                if self._scan_journal_fn is None:
-                    from ..sim import make_scan_fn
-                    self._scan_journal_fn = make_scan_fn(
-                        program, cfg, journal_cap=self.journal_scan_cap,
-                        reply_cap=self.reply_log_cap, donate=True,
-                        shardings=self._shardings)
-                self.sim, _cm, k, rl, buf = self._scan_journal_fn(
-                    self.sim, inject, jnp.int32(k_max), stop)
-                self._state_cache = None
-                # stretch N+1 is in flight: overlap the host-side
-                # analysis of segment N with its device time
-                self._overlap_feed(history)
-                if self._pack_buf is None:
-                    self._pack_buf = self._make_packer(
-                        (buf, rl, k, self.sim.net.next_mid))
-                pack, unpack = self._pack_buf
-                # ONE fetched array per dispatch: k and next_mid ride the
-                # packed buffer (every separately fetched array is its own
-                # round trip on remote backends)
-                packed = pack((buf, rl, k, self.sim.net.next_mid))
-                flat = self.transfer.fetch(packed)
-                buf, (rlog, rounds, plog, rn), k, self._next_mid = \
-                    unpack(flat)
-                k, self._next_mid = int(k), int(self._next_mid)
-                quiet_cm = jax.tree.map(
-                    lambda a: np.zeros_like(a[:max(C, 1)]), rlog)
-                for i in range(k):
-                    io_i = jax.tree.map(lambda b, i=i: b[i], buf)
-                    self._journal_round(io_i, quiet_cm, r + i)
-                rn = int(rn)
-                if rn:
-                    # reply recv rows at their true rounds (stamps are
-                    # post-round: the producing round is stamp-1)
-                    self.journal.log_batch(
-                        "recv", rlog.mid[:rn],
-                        np.asarray([self._time_ns(int(s) - 1)
-                                    for s in rounds[:rn]]),
-                        rlog.src[:rn], rlog.dest[:rn],
-                        node_names=self.node_names)
-            else:
-                if self._scan_fn is None:
-                    from ..sim import make_scan_fn
-                    self._scan_fn = make_scan_fn(
-                        program, cfg, reply_cap=self.reply_log_cap,
-                        donate=True, shardings=self._shardings)
-                self.sim, _cm, k, rl = self._scan_fn(
-                    self.sim, inject, jnp.int32(k_max), stop)
-                self._state_cache = None
-                # stretch N+1 is in flight: overlap the host-side
-                # analysis of segment N with its device time
-                self._overlap_feed(history)
-                if self._pack_replies is None:
-                    self._pack_replies = self._make_packer(
-                        (rl, k, self.sim.net.next_mid))
-                pack, unpack = self._pack_replies
-                # ONE fetched array per dispatch (see journal branch)
-                packed = pack((rl, k, self.sim.net.next_mid))
-                flat = self.transfer.fetch(packed)
-                (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
-                k, self._next_mid = int(k), int(self._next_mid)
-                rn = int(rn)
-            use_payload = getattr(self.program,
-                                  "reply_payload_words", 0) > 0
-            replies = [(int(rounds[j]), int(rlog.type[j]),
-                        int(rlog.a[j]), int(rlog.b[j]),
-                        int(rlog.c[j]), int(rlog.reply_to[j]),
-                        plog[j] if use_payload else None)
-                       for j in range(rn)]
+            k, replies = yield ("scan", inject_rows, k_max, stop,
+                                history, r)
             r += k
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
@@ -1064,7 +1070,119 @@ class TpuRunner:
                 self._save_checkpoint(gen, history, pending, free, r)
                 next_ckpt = r + self.checkpoint_every_rounds
 
+        self._gen_live, self._r_live = gen, r
         return r
+
+    def _encode_inject(self, inject_rows) -> "T.Msgs":
+        """Encodes this stretch's pending client ops into the [C] inject
+        batch the scan takes (an all-invalid batch when there are
+        none)."""
+        C, N = self.concurrency, self.cfg.n_nodes
+        inject = T.Msgs.empty(max(C, 1))
+        if not inject_rows:
+            return inject
+        M = len(inject_rows)
+        proc, _, nidx, ts, as_, bs, cs = zip(*inject_rows)
+        return inject.replace(
+            valid=jnp.arange(max(C, 1)) < M,
+            src=jnp.asarray(
+                list(np.array(proc) + N) + [0] * (max(C, 1) - M),
+                T.I32),
+            dest=jnp.asarray(list(nidx) + [0] * (max(C, 1) - M),
+                             T.I32),
+            type=jnp.asarray(list(ts) + [0] * (max(C, 1) - M),
+                             T.I32),
+            a=jnp.asarray(list(as_) + [0] * (max(C, 1) - M),
+                          T.I32),
+            b=jnp.asarray(list(bs) + [0] * (max(C, 1) - M),
+                          T.I32),
+            c=jnp.asarray(list(cs) + [0] * (max(C, 1) - M),
+                          T.I32))
+
+    def _exec_scan(self, inject_rows, k_max, stop, history, r):
+        """One fused compiled dispatch: encode the injections, run the
+        scan (journal-collecting when journaling), drain the
+        device-resident rings as ONE packed fetch, and decode the reply
+        rows. Returns (k_executed, replies), replies rows being
+        (round_stamp, type, a, b, c, reply_to, payload-or-None)."""
+        C = self.concurrency
+        program, cfg = self.program, self.cfg
+        inject = self._encode_inject(inject_rows)
+        if self.journal is not None:
+            if self._scan_journal_fn is None:
+                from ..sim import make_scan_fn
+                self._scan_journal_fn = make_scan_fn(
+                    program, cfg, journal_cap=self.journal_scan_cap,
+                    reply_cap=self.reply_log_cap, donate=True,
+                    shardings=self._shardings)
+            self.sim, _cm, k, rl, buf = self._scan_journal_fn(
+                self.sim, inject, jnp.int32(k_max), stop)
+            self._state_cache = None
+            # stretch N+1 is in flight: overlap the host-side
+            # analysis of segment N with its device time
+            self._overlap_feed(history)
+            if self._pack_buf is None:
+                self._pack_buf = self._make_packer(
+                    (buf, rl, k, self.sim.net.next_mid))
+            pack, unpack = self._pack_buf
+            # ONE fetched array per dispatch: k and next_mid ride the
+            # packed buffer (every separately fetched array is its own
+            # round trip on remote backends)
+            packed = pack((buf, rl, k, self.sim.net.next_mid))
+            flat = self.transfer.fetch(packed)
+            buf, (rlog, rounds, plog, rn), k, self._next_mid = \
+                unpack(flat)
+            k, self._next_mid = int(k), int(self._next_mid)
+            quiet_cm = jax.tree.map(
+                lambda a: np.zeros_like(a[:max(C, 1)]), rlog)
+            for i in range(k):
+                io_i = jax.tree.map(lambda b, i=i: b[i], buf)
+                self._journal_round(io_i, quiet_cm, r + i)
+            rn = int(rn)
+            if rn:
+                # reply recv rows at their true rounds (stamps are
+                # post-round: the producing round is stamp-1)
+                self.journal.log_batch(
+                    "recv", rlog.mid[:rn],
+                    np.asarray([self._time_ns(int(s) - 1)
+                                for s in rounds[:rn]]),
+                    rlog.src[:rn], rlog.dest[:rn],
+                    node_names=self.node_names)
+        else:
+            if self._scan_fn is None:
+                from ..sim import make_scan_fn
+                self._scan_fn = make_scan_fn(
+                    program, cfg, reply_cap=self.reply_log_cap,
+                    donate=True, shardings=self._shardings)
+            self.sim, _cm, k, rl = self._scan_fn(
+                self.sim, inject, jnp.int32(k_max), stop)
+            self._state_cache = None
+            # stretch N+1 is in flight: overlap the host-side
+            # analysis of segment N with its device time
+            self._overlap_feed(history)
+            if self._pack_replies is None:
+                self._pack_replies = self._make_packer(
+                    (rl, k, self.sim.net.next_mid))
+            pack, unpack = self._pack_replies
+            # ONE fetched array per dispatch (see journal branch)
+            packed = pack((rl, k, self.sim.net.next_mid))
+            flat = self.transfer.fetch(packed)
+            (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
+            k, self._next_mid = int(k), int(self._next_mid)
+            rn = int(rn)
+        return k, self._decode_replies(rlog, rounds, plog, rn)
+
+    def _decode_replies(self, rlog, rounds, plog, rn: int) -> list:
+        """Materializes the drained reply-log rows as plain tuples for
+        the loop's completion pass (shared with the fleet driver, which
+        feeds each cluster its own row of the batched log)."""
+        use_payload = getattr(self.program,
+                              "reply_payload_words", 0) > 0
+        return [(int(rounds[j]), int(rlog.type[j]),
+                 int(rlog.a[j]), int(rlog.b[j]),
+                 int(rlog.c[j]), int(rlog.reply_to[j]),
+                 plog[j] if use_payload else None)
+                for j in range(rn)]
 
     def _journal_round(self, io, client_msgs, r: int):
         """Materializes this round's device messages as journal rows
@@ -1156,7 +1274,13 @@ class TpuRunner:
 
 def run_tpu_test(test: dict, test_dir: str) -> dict:
     """Executes a full TPU-path test: run, check, store. The drop-in
-    equivalent of the bin path in `core.run` (reference jepsen.core/run!)."""
+    equivalent of the bin path in `core.run` (reference jepsen.core/run!).
+    `--fleet N` (N > 1) routes to the fleet runner: N independent
+    cluster instances inside one compiled scan, each checked and stored
+    per cluster."""
+    if int(test.get("fleet") or 1) > 1:
+        from .fleet_runner import run_fleet_test
+        return run_fleet_test(test, test_dir)
     runner = TpuRunner(test)
     test["store_dir"] = test_dir
     # swap the host-net stats checker for the device-counter one

@@ -320,10 +320,13 @@ def make_round_fn(program, cfg: NetConfig, donate: bool = False,
                    **_jit_kwargs(donate, shardings, 2, 3))
 
 
-def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
-                 reply_cap: int | None = None, donate: bool = False,
-                 shardings=None):
-    """Jitted scan-ahead: runs up to k_max injection-free rounds in ONE
+def _build_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
+                   reply_cap: int | None = None):
+    """The un-jitted scan-ahead body shared by `make_scan_fn` (which jits
+    it directly) and `make_fleet_scan_fn` (which vmaps it over a leading
+    cluster axis first). Returns (scan_fn, n_outs).
+
+    The scan runs up to k_max injection-free rounds in ONE
     dispatch (lax.while_loop). The interactive runner uses this to cross
     the idle stretches between generator events — e.g. at rate 5/s and
     1 ms rounds, ~200 rounds separate client ops; per-round dispatch
@@ -356,11 +359,7 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
     production runner drains: replies/io accumulate on device across the
     whole scanned stretch and reach the host as ONE batched fetch per
     dispatch, so host transfers scale with host-relevant rounds (ops,
-    timeouts, nemesis boundaries), not simulated rounds. `donate=True`
-    additionally donates the SimState carry so those rings and the state
-    tree are reused in place instead of reallocated every dispatch;
-    `shardings` pins the input placement for mesh (`--mesh`) execution
-    (see `_jit_kwargs`)."""
+    timeouts, nemesis boundaries), not simulated rounds."""
 
     CC = max(cfg.n_clients, 1)
     empty = Msgs.empty(CC)
@@ -453,7 +452,72 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
         return out
 
     n_outs = 3 + (rcap_req is not None) + (cap is not None)
+    return scan_fn, n_outs
+
+
+def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
+                 reply_cap: int | None = None, donate: bool = False,
+                 shardings=None):
+    """Jitted scan-ahead over one cluster (see `_build_scan_fn` for the
+    full semantics). `donate=True` donates the SimState carry so the
+    reply/io rings and the state tree are reused in place instead of
+    reallocated every dispatch; `shardings` pins the input placement for
+    mesh (`--mesh`) execution (see `_jit_kwargs`)."""
+    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap)
     return jax.jit(scan_fn, **_jit_kwargs(donate, shardings, 4, n_outs))
+
+
+def make_fleet_scan_fn(program, cfg: NetConfig,
+                       journal_cap: int | None = None,
+                       reply_cap: int | None = None, donate: bool = False,
+                       shardings=None):
+    """Jitted FLEET scan: the single-cluster scan body vmapped over a
+    leading cluster axis, so N independent cluster instances advance
+    inside one compiled dispatch.
+
+    fleet_fn(sim, inject, k_max, stop_on_reply, active) takes
+    cluster-batched trees (`sim` leaves lead with the fleet axis F,
+    `inject` is a [F, C] Msgs batch) and per-cluster [F] vectors for
+    k_max / stop_on_reply / active. Each cluster executes exactly the
+    rounds its own (k_max, stop) bounds permit — `lax.while_loop` under
+    vmap masks finished lanes with selects, so a cluster's PRNG stream,
+    reply rounds, and state trajectory are BIT-IDENTICAL to running it
+    standalone with the same seed (pinned by tests/test_fleet_runner.py).
+
+    `active=False` holds a cluster entirely: the lane still computes its
+    mandatory first round (vmap executes all lanes), but the result is
+    discarded — the returned state row equals the input row, k comes
+    back 0, and the reply log reports 0 rows. The fleet runner uses this
+    to keep clusters whose host loop is between dispatches (or finished)
+    frozen while others scan.
+
+    `shardings` pins the cluster-batched placement for `--mesh dp,sp`
+    execution: the fleet axis shards over dp, per-cluster node/pool axes
+    over sp (`parallel.fleet_scan_shardings`)."""
+    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap)
+    vscan = jax.vmap(scan_fn, in_axes=(0, 0, 0, 0))
+    has_replies = reply_cap is not None
+
+    def fleet_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply,
+                 active):
+        out = vscan(sim, inject, jnp.asarray(k_max, jnp.int32),
+                    jnp.asarray(stop_on_reply, bool))
+        sim2, cm, k = out[0], out[1], out[2]
+        act = jnp.asarray(active, bool)
+
+        def keep(new, old):
+            m = act.reshape(act.shape + (1,) * (new.ndim - 1))
+            return jnp.where(m, new, old)
+        sim2 = jax.tree.map(keep, sim2, sim)
+        k = jnp.where(act, k, 0)
+        extra = out[3:]
+        if has_replies:
+            rlog, rounds, plog, rn = extra[0]
+            extra = ((rlog, rounds, plog, jnp.where(act, rn, 0)),) \
+                + extra[1:]
+        return (sim2, cm, k) + extra
+
+    return jax.jit(fleet_fn, **_jit_kwargs(donate, shardings, 5, n_outs))
 
 
 def make_run_fn(program, cfg: NetConfig, collect_client_msgs: bool = False,

@@ -26,8 +26,16 @@ step() {  # step <artifact> <timeout_s> <cmd...>
 #    `value` is the efficient (send-once-plus-retry) 2.11M claim and
 #    `eager_msgs_per_sec` the 4.10M eager-flood stress figure
 #    (bench.py runs the efficient pass after the eager one when
-#    BENCH_EAGER=1, the default)
+#    BENCH_EAGER=1, the default). Since ISSUE 6 the same record also
+#    carries the `fleet` section (clusters/sec + aggregate msgs/sec at
+#    fleet sizes 1/8/64/512) — old and new metric land in one run
 step artifacts/bench-r5-broadcast.json 2400 python bench.py
+
+# 1b. fleet scaling as its own artifact (BENCH_MODE=fleet): headline
+#     `value` = aggregate msgs/sec at the largest fleet size,
+#     `vs_baseline` = the fleet-64/512 over fleet-1 speedup — the
+#     ISSUE 6 clusters/sec lever measured on real TPU hardware
+step artifacts/bench-fleet-r6.json 2400 env BENCH_MODE=fleet python bench.py
 
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)

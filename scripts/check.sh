@@ -4,7 +4,9 @@
 #
 #   1. `python -m maelstrom_tpu.analyze` — trace the production
 #      round_fn/scan_fn (plain + --mesh 1,2 on a forced 2-device CPU
-#      mesh) and lint the hot host modules; fails on any finding not in
+#      mesh) AND the vmapped fleet scan/round variants (`--fleet`:
+#      plain + --mesh 2,1, the cluster axis sharded over dp) and lint
+#      the hot host modules; fails on any finding not in
 #      analyze/baseline.json (doc/analyze.md).
 #   2. `ruff check` — the generic-Python lint floor (pyproject.toml
 #      [tool.ruff]); skipped with a notice when ruff isn't installed

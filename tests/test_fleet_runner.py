@@ -1,0 +1,408 @@
+"""Fleet execution (`--fleet N`): N independent cluster instances inside
+ONE compiled scan, vmapped over a leading cluster axis and sharded
+`("dp", "sp")` under `--mesh dp,sp`.
+
+The contract under test is **bit-identity**: every cluster of a fleet
+replays the standalone run of its own option set (seed / nemesis
+schedule / offered load, depending on `--fleet-sweep`) op for op —
+types, values, processes, virtual times, errors. The fleet changes
+batching, never semantics. On top of that: the coalesced fleet
+checkpoint resumes every cluster byte-identically (graceful preemption
+in-process here; the SIGKILL subprocess soak is slow-marked), and the
+`--mesh 2,1` dp=2 configuration — the one PR 2 had to reject — runs on
+the 2 virtual CPU devices (multichip marker).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from conftest import ops_projection as _ops
+from maelstrom_tpu import checkpoint as cp
+from maelstrom_tpu import core
+from maelstrom_tpu.core import FleetSpec
+from maelstrom_tpu.runner.fleet_runner import FleetRunner
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+
+BROADCAST = {"workload": "broadcast", "node": "tpu:broadcast",
+             "topology": "grid", "node_count": 5, "rate": 10.0,
+             "time_limit": 1.0, "recovery_s": 0.5, "seed": 7,
+             "audit": False}
+LIN_KV = {"workload": "lin-kv", "node": "tpu:lin-kv", "node_count": 3,
+          "rate": 10.0, "time_limit": 1.5, "recovery_s": 0.5, "seed": 11,
+          "audit": False}
+KAFKA = {"workload": "kafka", "node": "tpu:kafka", "node_count": 4,
+         "rate": 10.0, "time_limit": 1.5, "recovery_s": 0.5, "seed": 5,
+         "audit": False}
+SOUP = {"nemesis": ["kill", "pause", "partition", "duplicate"],
+        "nemesis_interval": 0.4}
+
+
+_SOLO_CACHE: dict = {}
+
+
+def _solo(opts):
+    # several tests compare against the same standalone runs (e.g. the
+    # BROADCAST seed 7/8 solos anchor both the seed sweep and the dp=2
+    # mesh smoke) — memoize them; runs are deterministic by contract
+    key = repr(sorted(opts.items(), key=lambda kv: kv[0]))
+    if key not in _SOLO_CACHE:
+        test = core.build_test(dict(opts))
+        test["nemesis"] = (True if test["nemesis_pkg"]["generator"]
+                           is not None else None)
+        runner = TpuRunner(test)
+        _SOLO_CACHE[key] = runner.run()
+    return _SOLO_CACHE[key]
+
+
+def _fleet(opts, **fleet_over):
+    test = core.build_test({**opts, **fleet_over})
+    runner = FleetRunner(test)
+    return runner, runner.run()
+
+
+# ---------------------------------------------------------------------------
+# FleetSpec: the campaign description (pure host logic, no device work)
+# ---------------------------------------------------------------------------
+
+def test_fleet_spec_validation():
+    assert FleetSpec.from_test({}) == FleetSpec(1, "seed")
+    assert FleetSpec.from_test({"fleet": 8, "fleet_sweep": "capacity"}) \
+        == FleetSpec(8, "capacity")
+    with pytest.raises(ValueError, match="--fleet must be >= 1"):
+        FleetSpec.from_test({"fleet": 0})
+    with pytest.raises(ValueError, match="--fleet-sweep"):
+        FleetSpec.from_test({"fleet": 2, "fleet_sweep": "chaos"})
+
+
+def test_cluster_opts_sweeps():
+    """cluster_opts(i) is the option set whose STANDALONE run cluster i
+    replays: seed sweep offsets the whole seed, nemesis sweep pins the
+    op stream and moves only the fault RNG, capacity sweep ramps the
+    offered load; fleet-level mechanics (mesh, resume, journaling,
+    audit) are stripped or forced off."""
+    base = core.build_test({**LIN_KV, "fleet": 3, "mesh": "2,1",
+                            "journal_rows": True})
+    spec = FleetSpec.from_test(base)
+    for i in range(3):
+        o = spec.cluster_opts(base, i)
+        assert o["fleet"] == 1 and o["mesh"] is None
+        assert o["resume"] is None and o["journal_rows"] is False
+        assert o["audit"] is False
+        assert "generator" not in o and "checker" not in o \
+            and "nemesis_pkg" not in o and "net" not in o
+    assert [spec.cluster_opts(base, i)["seed"] for i in range(3)] == \
+        [11, 12, 13]
+
+    nem = FleetSpec(3, "nemesis")
+    assert [nem.cluster_opts(base, i)["nemesis_seed"]
+            for i in range(3)] == [11, 12, 13]
+    assert all(nem.cluster_opts(base, i)["seed"] == 11 for i in range(3))
+
+    cap = FleetSpec(3, "capacity")
+    assert [cap.cluster_opts(base, i)["rate"] for i in range(3)] == \
+        [10.0, 20.0, 30.0]
+    assert all(cap.cluster_opts(base, i)["seed"] == 11 for i in range(3))
+
+
+def test_fleet_requires_dp_divisor():
+    test = core.build_test({**BROADCAST, "fleet": 3, "mesh": "2,1"})
+    with pytest.raises(ValueError, match="multiple of dp"):
+        FleetRunner(test)
+
+
+def test_standalone_dp_error_names_fleet(tmp_path):
+    """The PR 2 hard rejection is now a signpost: dp > 1 without a
+    fleet tells the user to give dp a fleet to shard."""
+    test = core.build_test({**BROADCAST, "mesh": "2,1"})
+    with pytest.raises(ValueError, match="--fleet N --mesh"):
+        TpuRunner(test)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: every cluster == its standalone run
+# ---------------------------------------------------------------------------
+
+def test_fleet_seed_sweep_bit_identical():
+    """The core contract, cheapest config: a 2-cluster broadcast fleet
+    equals the standalone runs of seeds 7 and 8 op for op, and the
+    whole fleet drains O(dispatches), not O(rounds)."""
+    solos = [_solo({**BROADCAST, "seed": s}) for s in (7, 8)]
+    runner, hs = _fleet(BROADCAST, fleet=2)
+    assert len(hs[0]) > 20
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+    assert max(runner.final_rounds) > 1000
+    assert 0 < runner.transfer.drains < max(runner.final_rounds) // 4
+
+
+@pytest.mark.slow
+def test_fleet_combined_nemesis_bit_identical():
+    """Under the full fault soup (kill/pause/partition/duplicate),
+    per-cluster nemesis decision streams stay independent and every
+    cluster still replays its standalone run exactly. Slow-marked for
+    wall time (the kill package's durable-store restarts dominate);
+    tier-1 keeps the partition-nemesis sweep test, and the slow trio
+    covers the soup on all three workloads."""
+    opts = {**BROADCAST, **SOUP, "time_limit": 1.2}
+    solos = [_solo({**opts, "seed": s}) for s in (7, 8)]
+    _, hs = _fleet(opts, fleet=2)
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+def test_fleet_nemesis_sweep_fixed_ops_varied_faults():
+    """`--fleet-sweep nemesis`: same workload seed (same op stream),
+    per-cluster fault schedules. Cluster i == standalone with
+    nemesis_seed = seed + i; the invoked client-op streams agree across
+    clusters while the nemesis streams differ."""
+    opts = {**LIN_KV, "nemesis": ["partition"], "nemesis_interval": 0.5}
+    solos = [_solo({**opts, "nemesis_seed": 11 + i}) for i in range(2)]
+    _, hs = _fleet(opts, fleet=2, fleet_sweep="nemesis")
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+    def client_invokes(h):
+        return [(o.f, o.value) for o in h
+                if o.type == "invoke" and o.process != "nemesis"]
+
+    def nemesis_rows(h):
+        # the fault choice lands in the completion values
+        # ("isolated n1" vs "halves ..."), not the invoke rows
+        return [(o.type, o.f, o.value, o.time) for o in h
+                if o.process == "nemesis"]
+    assert client_invokes(hs[0]) == client_invokes(hs[1])
+    assert nemesis_rows(hs[0]) != nemesis_rows(hs[1])
+
+
+def test_fleet_capacity_sweep_ramps_load():
+    """`--fleet-sweep capacity`: cluster i runs at rate * (i + 1);
+    cluster i == the standalone run at that rate, and the op count
+    grows with the offered load."""
+    opts = {**BROADCAST, "time_limit": 1.0}
+    solos = [_solo({**opts, "rate": 10.0 * (i + 1)}) for i in range(2)]
+    _, hs = _fleet(opts, fleet=2, fleet_sweep="capacity")
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+    assert len(hs[1]) > len(hs[0])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("opts,seeds", [
+    ({**BROADCAST, **SOUP, "time_limit": 1.5}, (7, 8, 9, 10)),
+    ({**LIN_KV, **SOUP, "time_limit": 2.0}, (11, 12, 13, 14)),
+    ({**KAFKA, **SOUP, "time_limit": 2.0}, (5, 6, 7, 8)),
+])
+def test_fleet_soup_bit_identical_all_workloads(opts, seeds):
+    """Acceptance trio: broadcast, raft-backed lin-kv, and kafka fleets
+    under the combined nemesis, each cluster bit-identical to its
+    standalone run."""
+    solos = [_solo({**opts, "seed": s}) for s in seeds]
+    _, hs = _fleet({**opts, "seed": seeds[0]}, fleet=len(seeds))
+    for i in range(len(seeds)):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# Mesh: dp finally means something
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_fleet_mesh_dp2_bit_identical():
+    """`--fleet 2 --mesh 2,1`: the cluster axis shards over dp=2 (the
+    configuration PR 2 had to reject) and every cluster still equals
+    its standalone run (one cluster per dp shard; the solos are shared
+    with the seed-sweep test's cache)."""
+    solos = [_solo({**BROADCAST, "seed": 7 + i}) for i in range(2)]
+    runner, hs = _fleet(BROADCAST, fleet=2, mesh="2,1")
+    assert runner.mesh is not None and runner.mesh.shape["dp"] == 2
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+@pytest.mark.multichip
+def test_fleet_mesh_dp2_sp2_rejected():
+    """Mixed dp x sp sharding is rejected up front: with both axes > 1
+    every in-scan scatter-set is replicated over one of them, which
+    GSPMD does not partition value-safely (the PR 2 hazard class —
+    observed as corrupted reply rows under --fleet 2 --mesh 2,2).
+    Pure shapes (dp,1 / 1,sp) are the supported layouts."""
+    test = core.build_test({**BROADCAST, "fleet": 2, "mesh": "2,2"})
+    with pytest.raises(ValueError, match="dp and sp cannot both"):
+        FleetRunner(test)
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_fleet_mesh_sp2_bit_identical():
+    """`--fleet 2 --mesh 1,2`: the per-cluster node/pool axes sharded
+    over sp under a fleet, every cluster equal to its standalone run
+    (the PR 2 regime, vmapped). Slow-marked for wall time (the soup +
+    8-node sp-sharded scan dominates); tier-1 keeps mesh coverage via
+    the dp=2 smoke."""
+    opts = {**BROADCAST, **SOUP, "node_count": 8, "time_limit": 1.0}
+    solos = [_solo({**opts, "seed": 7 + i}) for i in range(2)]
+    _, hs = _fleet(opts, fleet=2, mesh="1,2")
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / preemption / resume
+# ---------------------------------------------------------------------------
+
+def test_fleet_preempt_checkpoint_resume_bit_identical(tmp_path):
+    """Graceful preemption mid-run: the coalesced fleet checkpoint
+    (one framed file covering every cluster's freshest snapshot)
+    resumes ALL clusters to histories bit-identical to the
+    uninterrupted fleet — including clusters that were mid-stretch and
+    clusters that had already finished."""
+    opts = {**LIN_KV, "nemesis": ["partition"], "nemesis_interval": 0.8,
+            "time_limit": 2.0}
+
+    a_dir = tmp_path / "a"
+    a_dir.mkdir()
+    t = core.build_test({**opts, "fleet": 2})
+    t["store_dir"] = str(a_dir)
+    hs_a = FleetRunner(t).run()
+    assert len(hs_a[0]) > 20
+
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    t2 = core.build_test({**opts, "fleet": 2, "checkpoint_every": 0.25})
+    t2["store_dir"] = str(b_dir)
+    fr2 = FleetRunner(t2)
+
+    def preempt_after_first_checkpoint():
+        # deterministic mid-run preemption: fire as soon as the first
+        # coalesced checkpoint has been submitted (~round 250 of ~5000+)
+        deadline = time.time() + 300
+        while time.time() < deadline and not fr2._preempt.is_set():
+            if fr2.transfer.ckpt_saves >= 1:
+                fr2._preempt.set()
+                return
+            time.sleep(0.01)
+    threading.Thread(target=preempt_after_first_checkpoint,
+                     daemon=True).start()
+    with pytest.raises(cp.Preempted):
+        fr2.run()
+
+    ck = cp.load(str(b_dir))
+    assert ck["fingerprint"]["fleet"] == 2
+    t3 = core.build_test({**opts, "fleet": 2, "checkpoint_every": 0.25})
+    t3["store_dir"] = str(b_dir)
+    fr3 = FleetRunner(t3)
+    cp.check_fingerprint(ck, t3)
+    hs_c = fr3.run(resume=ck)
+    for i in range(2):
+        assert _ops(hs_c[i]) == _ops(hs_a[i]), \
+            f"cluster {i} diverged after resume"
+
+
+def test_fleet_checkpoint_rejects_other_fleet(tmp_path):
+    """fleet/fleet_sweep are fingerprinted: a fleet checkpoint only
+    resumes into the same campaign."""
+    opts = {**LIN_KV, "time_limit": 1.0, "checkpoint_every": 0.25}
+    t = core.build_test({**opts, "fleet": 2})
+    t["store_dir"] = str(tmp_path)
+    FleetRunner(t).run()
+    ck = cp.load(str(tmp_path))
+    bad = core.build_test({**opts, "fleet": 4})
+    with pytest.raises(ValueError, match="fleet"):
+        cp.check_fingerprint(ck, bad)
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_resume_byte_identical(tmp_path):
+    """Real SIGKILL, real subprocess: a --fleet 2 run killed after its
+    first coalesced checkpoint and resumed with --resume lands
+    byte-identical history.jsonl and verdict-identical results.json
+    against the uninterrupted fleet baseline."""
+    import os
+    import random
+
+    from maelstrom_tpu import crash_soak
+
+    # seed 16: fleet seeds (16, 17) both grade valid under this config
+    # (the soak launches the real CLI, whose exit code encodes validity)
+    opts = {"-w": "lin-kv", "--node": "tpu:lin-kv", "--node-count": "3",
+            "--rate": "10", "--time-limit": "4", "--seed": "16",
+            "--nemesis": "partition", "--nemesis-interval": "1",
+            "--checkpoint-every": "0.5", "--fleet": "2"}
+    root = str(tmp_path / "baseline")
+    baseline = crash_soak.run_once(root, opts,
+                                   os.path.join(str(tmp_path),
+                                                "baseline.log"))
+    res = crash_soak.run_with_kills(str(tmp_path / "killed"), opts,
+                                    kills=1, rng=random.Random(5),
+                                    kill_jitter_s=0.2)
+    assert len(res["kills"]) == 1, res
+    verdict = crash_soak.compare_runs(baseline, res["dir"])
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+
+
+# ---------------------------------------------------------------------------
+# run_fleet_test: per-cluster checking, storage, reporting
+# ---------------------------------------------------------------------------
+
+def test_run_fleet_test_per_cluster_results(tmp_path):
+    """The end-to-end entry point: per-cluster artifacts under
+    cluster-XXXX/, per-cluster verdicts (each checker fed ONLY its own
+    cluster's history — no double counting), one fleet-level summary
+    with ONE static-audit block, and a seed column per cluster."""
+    import json
+    import os
+
+    from maelstrom_tpu.runner.tpu_runner import run_tpu_test
+
+    # seed 16: the cheapest consecutive pair (16, 17) whose standalone
+    # runs BOTH grade valid (seed 12's cas ops legitimately all fail
+    # the stats rule, which would make the fleet verdict False)
+    test = core.build_test({**LIN_KV, "seed": 16, "fleet": 2,
+                            "audit": False})
+    res = run_tpu_test(test, str(tmp_path))
+    assert res["fleet"] == 2 and res["fleet-sweep"] == "seed"
+    assert res["valid"] is True
+    assert [c["seed"] for c in res["clusters"]] == [16, 17]
+    for i in range(2):
+        cdir = os.path.join(str(tmp_path), f"cluster-{i:04d}")
+        assert os.path.exists(os.path.join(cdir, "history.jsonl"))
+        stored = json.load(open(os.path.join(cdir, "results.json")))
+        assert stored["cluster"] == i
+        # the workload checker graded exactly this cluster's history:
+        # op counts in the stats block match the stored history rows
+        rows = [json.loads(line) for line in
+                open(os.path.join(cdir, "history.jsonl")) if line.strip()]
+        n_completions = sum(1 for r in rows
+                            if r["type"] in ("ok", "fail", "info")
+                            and r["process"] != "nemesis")
+        assert stored["stats"]["count"] == n_completions
+        ap = stored.get("analysis-pipeline")
+        if ap is not None:
+            # the pipeline saw exactly this cluster's rows, not the
+            # fleet's (no double counting)
+            assert ap["rows"] == len(rows)
+    # fleet-level history.jsonl tags each row with its cluster
+    merged = open(os.path.join(str(tmp_path), "history.jsonl")).read()
+    assert '"c0:' in merged and '"c1:' in merged
+
+
+def test_run_fleet_test_audit_block(tmp_path):
+    """One fleet-level static-audit block (the vmapped step functions
+    are shared — per-cluster blocks would repeat the trace F times),
+    and it is clean against the checked-in baseline."""
+    from maelstrom_tpu.runner.tpu_runner import run_tpu_test
+
+    test = core.build_test({**BROADCAST, "fleet": 2, "time_limit": 0.5,
+                            "audit": True})
+    res = run_tpu_test(test, str(tmp_path))
+    audit = res["static-audit"]
+    assert audit["ok"] is True, audit
+    assert audit["fleet"] == 2
+    assert all("static-audit" not in c.get("net", {})
+               for c in res["clusters"])
