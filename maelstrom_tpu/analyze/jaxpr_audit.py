@@ -360,6 +360,20 @@ def production_step_specs(workload: str, mesh: str | None = None,
                                      donate=donate, shardings=sh),
                      args=(runner.sim, inject, jnp.int32(8), True),
                      **common),
+            # the continuous-mode (--continuous) injection path: the
+            # sched-inject scan masks the inject batch per round and
+            # drains per-row assigned mids — a distinct compiled entry
+            # point, so the gate traces it like the others
+            StepSpec(name=f"cscan_fn[{tag}]",
+                     fn=make_scan_fn(runner.program, runner.cfg,
+                                     reply_cap=runner.reply_log_cap,
+                                     donate=donate, shardings=sh,
+                                     sched_inject=True),
+                     args=(runner.sim, inject,
+                           jnp.zeros(max(runner.concurrency, 1),
+                                     jnp.int32),
+                           jnp.int32(8), True),
+                     **common),
         ]
     return specs
 
@@ -548,6 +562,18 @@ def audit_runner_steps(runner):
                                  donate=donate, shardings=sh),
                  args=(runner.sim, inject, jnp.int32(8), True), **common),
     ]
+    if getattr(runner, "continuous", False):
+        # a continuous run's replies come off the sched-inject scan:
+        # that is the entry point to self-report, not the plain one
+        specs.append(StepSpec(
+            name=f"cscan_fn[{tag}]",
+            fn=make_scan_fn(runner.program, runner.cfg,
+                            reply_cap=runner.reply_log_cap,
+                            donate=donate, shardings=sh,
+                            sched_inject=True),
+            args=(runner.sim, inject,
+                  jnp.zeros(max(runner.concurrency, 1), jnp.int32),
+                  jnp.int32(8), True), **common))
     findings: list[Finding] = []
     for spec in specs:
         findings += audit_step(spec)

@@ -20,6 +20,17 @@ class Checker:
     def check(self, test: dict, history, opts: dict | None = None) -> dict:
         raise NotImplementedError
 
+    def make_stream_observer(self, test: dict):
+        """An incremental observer for the overlapped analysis pipeline
+        (doc/streams.md), or None. An observer is fed each completed
+        (invoke, completion) pair as drained segments are analyzed —
+        ``observe(invoke_row, invoke, complete)`` — and asked for a
+        per-window early-warning verdict at each segment boundary
+        (``window_close() -> dict``). Check time then consumes its
+        carried state instead of re-scanning the history; verdicts must
+        stay bit-identical to the history-only path."""
+        return None
+
 
 def merge_valid(vs) -> bool | str:
     """Jepsen semantics for composing validity: false dominates, then

@@ -1,36 +1,48 @@
 """Kafka-style replicated-log checker (classic Maelstrom's `kafka`
 workload, beyond the reference's seven; jepsen.tests.kafka's core
-invariants, restated for full-prefix polls).
+invariants) — for BOTH protocol modes (doc/streams.md):
 
-History value conventions (see workloads/kafka.py):
+Classic (full-prefix polls, `kafka_groups` unset):
   send ok:   [key, msg, offset]
-  poll ok:   {key: [[offset, msg], ...]}    (server returns the full
-                                             prefix, from offset 0)
+  poll ok:   {key: [[offset, msg], ...]}    (full prefix from offset 0)
   commit ok: {key: offset}
   list ok:   {key: offset}
 
+Streaming (consumer groups, `kafka_groups` > 0):
+  poll ok:   {key: [[offset, msg], ...]}    (cursor fetch: a CONTIGUOUS
+                                             run from the member's
+                                             cursor — not a prefix)
+  commit ok: {"group": g, "offsets": {key: offset}}
+  list ok:   {"group": g, "offsets": {key: offset}}
+  subscribe ok / rebalanced fails constrain nothing.
+
 Checked invariants:
   1. **No divergence**: (key, offset) maps to one msg across every ok
-     send and every poll, ever.
-  2. **Order**: within a single poll, each key's offsets are strictly
-     increasing AND start at the log head (offset 0) — the poll RPC's
-     contract is a full prefix, so a truncated head is an order
-     violation, not lag.
-  3. **No lost writes**: a send acked at offset o must appear in every
-     poll that *begins after the ack completes* and observes any offset
-     >= o for that key (reading past a hole means the hole is a loss,
-     not lag).
-  4. **Committed-offset monotonicity**: the stored committed offset of
-     a key only advances. Observable as: a `list` that *begins after* a
-     `commit` completed must report at least the committed offset, and
-     a `list` that begins after another `list` completed must never
-     report less. (A commit *requesting* a lower offset is legal — the
-     server clamps — so commit requests are lower bounds, not
-     observations.)
+     send and every poll observation, ever.
+  2. **Order**: classic polls are strictly increasing full prefixes
+     (a truncated head is a violation, not lag); streaming fetches are
+     contiguous ascending runs (a gap inside a fetch is a violation).
+  3. **No lost writes**: classic — a send acked at offset o must appear
+     in every poll that *begins after the ack completes* and reads past
+     a hole at o. Streaming — consumers advance contiguous cursors, so
+     an acked offset that is NEVER observed while later offsets of the
+     same key are observed by polls beginning after the ack is lost.
+  4. **Committed-offset monotonicity**, per (group, key) in streaming
+     mode (group None classic): a `list` that *begins after* a commit
+     (or an earlier list) *completed* must report at least that offset.
+     Commit REQUESTS for lower offsets are legal (the server clamps).
 
-Indeterminate (`info`) sends constrain nothing (their offset was never
-observed); indeterminate commits may or may not advance the committed
-offset, so they widen what a later list may legally return.
+Indeterminate (`info`) ops constrain nothing; `fail` ops (misrouted,
+fenced/rebalanced commits) definitely did not happen.
+
+Structure: `extract_observation` compresses one (invoke, completion)
+pair into a compact record; `grade` folds an invoke-ordered record list
+into the verdict. The post-hoc path extracts from `history.pairs()`;
+the overlapped pipeline (`checkers/pipeline.py`) extracts the SAME
+records incrementally per drained window (with per-window early-warning
+verdicts and a checker-lag metric) and re-sorts them by invoke row at
+finish — so the two final verdicts are equal by construction (pinned
+bit-equal in tests/test_pipeline_windows.py and test_continuous.py).
 """
 
 from __future__ import annotations
@@ -39,73 +51,140 @@ from . import Checker
 from ..history import coerce_history
 
 
-class KafkaChecker(Checker):
-    name = "kafka"
+def _commit_shape(v: dict):
+    """(group_or_None, {key: offset}) from a commit/list ok value —
+    streaming values are {"group": g, "offsets": {...}}, classic ones
+    are the flat offsets map."""
+    if "offsets" in v and "group" in v:
+        return (int(v["group"]),
+                {str(k): int(o) for k, o in v["offsets"].items()})
+    return None, {str(k): int(o) for k, o in v.items()}
 
-    def check(self, test, history, opts=None):
-        history = coerce_history(history)
-        assign: dict = {}        # (key, offset) -> msg (first observer)
-        divergent = []
-        order_violations = []
-        lost = []
-        commit_regressions = []
 
-        def observe(k, o, m, where):
-            cur = assign.get((k, o))
-            if cur is None:
-                assign[(k, o)] = m
-            elif cur != m:
-                divergent.append({"key": k, "offset": o,
-                                  "values": [cur, m], "in": where})
+def extract_observation(invoke, complete):
+    """One (invoke, completion-or-None) pair -> a compact observation
+    tuple, or None when the pair constrains nothing (unpaired, info,
+    fail, malformed). Pure; shared by the post-hoc and windowed paths.
 
-        acked_sends = []         # (ack_time, key, offset, msg)
-        polls = []               # (invoke_time, {key: [[o, m], ...]})
-        commits = []             # (complete_time, {key: offset})
-        lists = []               # (invoke_time, complete_time, {k: o})
+      ("send", ack_time, key, offset, msg)
+      ("poll", invoke_time, {key: [[offset, msg], ...]})
+      ("commit", complete_time, group_or_None, {key: offset})
+      ("list", invoke_time, complete_time, group_or_None, {key: offset})
+    """
+    if complete is None or not complete.is_ok():
+        return None
+    f = invoke.f
+    v = complete.value
+    if f == "send":
+        k, m, o = v
+        return ("send", complete.time, str(k), int(o), m)
+    if f == "poll" and isinstance(v, dict):
+        return ("poll", invoke.time, v)
+    if f == "commit" and isinstance(v, dict):
+        grp, offs = _commit_shape(v)
+        return ("commit", complete.time, grp, offs)
+    if f == "list" and isinstance(v, dict):
+        grp, offs = _commit_shape(v)
+        return ("list", invoke.time, complete.time, grp, offs)
+    return None
 
-        for invoke, complete in history.pairs():
-            ok = complete is not None and complete.is_ok()
-            if invoke.f == "send":
-                if ok:
-                    k, m, o = complete.value
-                    observe(str(k), int(o), m, "send_ok")
-                    acked_sends.append((complete.time, str(k), int(o), m))
-            elif invoke.f == "poll":
-                if ok and isinstance(complete.value, dict):
-                    polls.append((invoke.time, complete.value))
-                    for k, pairs in complete.value.items():
-                        if pairs and int(pairs[0][0]) != 0:
+
+def grade(observations, streaming: bool = False) -> dict:
+    """Folds observation records IN INVOKE ORDER into the whole-history
+    verdict — the single grading implementation behind both checker
+    paths (bit-equality of the windowed path is by construction)."""
+    assign: dict = {}        # (key, offset) -> msg (first observer)
+    divergent = []
+    order_violations = []
+    lost = []
+    commit_regressions = []
+
+    def observe(k, o, m, where):
+        cur = assign.get((k, o))
+        if cur is None:
+            assign[(k, o)] = m
+        elif cur != m:
+            divergent.append({"key": k, "offset": o,
+                              "values": [cur, m], "in": where})
+
+    acked_sends = []         # (ack_time, key, offset, msg)
+    polls = []               # (invoke_time, {key: [[o, m], ...]})
+    commits = []             # (complete_time, group, {key: offset})
+    lists = []               # (inv_t, complete_t, group, {key: offset})
+
+    for rec in observations:
+        tag = rec[0]
+        if tag == "send":
+            _, t, k, o, m = rec
+            observe(k, o, m, "send_ok")
+            acked_sends.append((t, k, o, m))
+        elif tag == "poll":
+            _, inv_t, value = rec
+            polls.append((inv_t, value))
+            for k, pairs in value.items():
+                if streaming:
+                    # cursor-fetch contract: one CONTIGUOUS ascending
+                    # run (the server slices [start, start+n))
+                    last = None
+                    for o, m in pairs:
+                        if last is not None and int(o) != last + 1:
                             order_violations.append(
-                                {"key": k, "head-offset": int(pairs[0][0]),
-                                 "error": "full-prefix poll must start "
-                                          "at offset 0"})
-                        last = -1
-                        for o, m in pairs:
-                            if int(o) <= last:
-                                order_violations.append(
-                                    {"key": k, "offsets": [last, int(o)]})
-                            last = int(o)
-                            observe(str(k), int(o), m, "poll_ok")
-            elif invoke.f == "commit":
-                if ok and isinstance(complete.value, dict):
-                    commits.append(
-                        (complete.time,
-                         {str(k): int(v) for k, v in
-                          complete.value.items()}))
-            elif invoke.f == "list":
-                if ok and isinstance(complete.value, dict):
-                    lists.append(
-                        (invoke.time, complete.time,
-                         {str(k): int(v) for k, v in
-                          complete.value.items()}))
+                                {"key": k, "offsets": [last, int(o)],
+                                 "error": "fetch entries must be "
+                                          "contiguous"})
+                        last = int(o)
+                        observe(str(k), int(o), m, "poll_ok")
+                else:
+                    if pairs and int(pairs[0][0]) != 0:
+                        order_violations.append(
+                            {"key": k, "head-offset": int(pairs[0][0]),
+                             "error": "full-prefix poll must start "
+                                      "at offset 0"})
+                    last = -1
+                    for o, m in pairs:
+                        if int(o) <= last:
+                            order_violations.append(
+                                {"key": k, "offsets": [last, int(o)]})
+                        last = int(o)
+                        observe(str(k), int(o), m, "poll_ok")
+        elif tag == "commit":
+            _, t, grp, offs = rec
+            commits.append((t, grp, offs))
+        else:   # list
+            _, inv_t, t, grp, offs = rec
+            lists.append((inv_t, t, grp, offs))
 
-        # 3. lost writes. Polls are full prefixes, so a poll's "holes"
-        # (offsets below its max that it does NOT contain) are the only
-        # places a loss can show — and a correct server has none, which
-        # makes this sweep effectively linear: enumerate each poll's
-        # holes once, then check acked sends only against the (rare)
-        # holey polls that started after their ack.
-        holes_by_key: dict = {}     # key -> [(poll_t, max_o, holes set)]
+    # 3. lost writes.
+    if streaming:
+        # Consumers advance contiguous per-group cursors from the log
+        # head, so the union of observed offsets per key has no holes on
+        # a correct server. An acked offset never observed while a poll
+        # that BEGAN after the ack observed a later offset of the same
+        # key marks a loss (the cursor stream read past it).
+        union: dict = {}         # key -> set of observed offsets
+        per_key_polls: dict = {}  # key -> [(inv_t, max observed o)]
+        for inv_t, value in polls:
+            for k, pairs in value.items():
+                if not pairs:
+                    continue
+                offs = {int(p[0]) for p in pairs}
+                union.setdefault(str(k), set()).update(offs)
+                per_key_polls.setdefault(str(k), []).append(
+                    (inv_t, max(offs)))
+        for ack_t, k, o, m in acked_sends:
+            if o in union.get(k, ()):
+                continue
+            later = [mx for t2, mx in per_key_polls.get(k, ())
+                     if t2 > ack_t and mx > o]
+            if later:
+                lost.append({"key": k, "offset": o, "msg": m,
+                             "poll-max-offset": max(later)})
+    else:
+        # Polls are full prefixes, so a poll's "holes" (offsets below
+        # its max that it does NOT contain) are the only places a loss
+        # can show — and a correct server has none, which makes this
+        # sweep effectively linear.
+        holes_by_key: dict = {}  # key -> [(poll_t, max_o, holes set)]
         for poll_t, value in polls:
             for k, pairs in value.items():
                 if not pairs:
@@ -123,47 +202,233 @@ class KafkaChecker(Checker):
                                  "poll-max-offset": max_o})
                     break
 
-        # 4. the stored committed mark only advances: every list that
-        # BEGAN after a commit (or an earlier list) COMPLETED must
-        # observe at least that offset per key. One time-sorted sweep
-        # with a running per-key floor; at equal timestamps checks run
-        # before floor-raises (lenient toward concurrency).
-        events = ([(c_t, 1, None, offs) for c_t, offs in commits]
-                  + [(c2, 1, None, offs) for _i, c2, offs in lists]
-                  + [(li_inv, 0, offs, None) for li_inv, _c, offs in lists])
-        floor: dict = {}
-        for _t, _kind, check_offs, raise_offs in sorted(
-                events, key=lambda e: (e[0], e[1])):
-            if check_offs is not None:
-                for k, lo in floor.items():
-                    if check_offs.get(k, -1) < lo:
-                        commit_regressions.append(
-                            {"key": k, "committed": lo,
-                             "observed": check_offs.get(k, -1)})
-            else:
-                for k, o in raise_offs.items():
-                    floor[k] = max(floor.get(k, -1), o)
+    # 4. the stored committed mark only advances, per (group, key):
+    # every list that BEGAN after a commit (or an earlier list)
+    # COMPLETED must observe at least that offset. One time-sorted sweep
+    # with running per-(group, key) floors; at equal timestamps checks
+    # run before floor-raises (lenient toward concurrency).
+    events = ([(c_t, 1, None, offs, grp) for c_t, grp, offs in commits]
+              + [(c2, 1, None, offs, grp)
+                 for _i, c2, grp, offs in lists]
+              + [(li_inv, 0, offs, None, grp)
+                 for li_inv, _c, grp, offs in lists])
+    floor: dict = {}             # (group, key) -> offset
+    for _t, _kind, check_offs, raise_offs, grp in sorted(
+            events, key=lambda e: (e[0], e[1])):
+        if check_offs is not None:
+            for (g2, k), lo in floor.items():
+                if g2 != grp:
+                    continue
+                if check_offs.get(k, -1) < lo:
+                    rec = {"key": k, "committed": lo,
+                           "observed": check_offs.get(k, -1)}
+                    if g2 is not None:
+                        rec["group"] = g2
+                    commit_regressions.append(rec)
+        else:
+            for k, o in raise_offs.items():
+                key = (grp, k)
+                floor[key] = max(floor.get(key, -1), o)
 
-        problems = {}
-        if divergent:
-            problems["divergent"] = divergent[:16]
-        if order_violations:
-            problems["poll-order"] = order_violations[:16]
-        if lost:
-            problems["lost-writes"] = lost[:16]
-        if commit_regressions:
-            problems["commit-regressions"] = commit_regressions[:16]
-        out = {
-            "valid": not problems,
-            "acked-sends": len(acked_sends),
-            "polls": len(polls),
-            "distinct-offsets": len(assign),
-        }
-        out.update(problems)
-        # a run with no certifiable observations can't certify anything
-        # — but found anomalies always dominate (false beats unknown)
-        if not problems and not acked_sends and not polls and not lists:
-            out["valid"] = "unknown"
-            out["error"] = ("no certifiable kafka observation (send/poll/"
-                            "list) ever succeeded")
-        return out
+    problems = {}
+    if divergent:
+        problems["divergent"] = divergent[:16]
+    if order_violations:
+        problems["poll-order"] = order_violations[:16]
+    if lost:
+        problems["lost-writes"] = lost[:16]
+    if commit_regressions:
+        problems["commit-regressions"] = commit_regressions[:16]
+    out = {
+        "valid": not problems,
+        "acked-sends": len(acked_sends),
+        "polls": len(polls),
+        "distinct-offsets": len(assign),
+    }
+    out.update(problems)
+    # a run with no certifiable observations can't certify anything
+    # — but found anomalies always dominate (false beats unknown)
+    if not problems and not acked_sends and not polls and not lists:
+        out["valid"] = "unknown"
+        out["error"] = ("no certifiable kafka observation (send/poll/"
+                        "list) ever succeeded")
+    return out
+
+
+class KafkaStreamObserver:
+    """The pipeline-side incremental grader (doc/streams.md): fed one
+    (invoke, completion) pair at a time in COMPLETION order by the
+    analysis worker, it extracts the same compact records `grade`
+    consumes, carries cross-window state (assignment map, pending acked
+    sends, committed floors with their raise times — the open-
+    subscription state), and reports per-window verdicts:
+
+      - divergence / order violations: exact (order-independent /
+        poll-local), detected in the window whose fetch exposes them;
+      - lost-acked-writes: detected in the window whose poll reads past
+        the loss (classic rule exact — every binding ack precedes the
+        poll in completion order; streaming rule conservative the same
+        way `grade`'s is);
+      - commit regressions: exact including the equal-timestamp
+        leniency — floors are kept as (raise_time, cummax) runs, and a
+        list checks only floors raised strictly before its invoke.
+
+    The FINAL verdict never comes from this running state: at check
+    time the records re-sort by invoke row and go through the same
+    `grade` fold as the post-hoc path, so the two verdicts are equal by
+    construction."""
+
+    name = "kafka"
+
+    def __init__(self, test=None):
+        self.streaming = bool((test or {}).get("kafka_groups"))
+        self.obs: list = []      # (invoke_row, record), completion order
+        self._assign: dict = {}
+        self._acked: list = []   # (ack_t, key, offset, msg), unobserved
+        self._union: dict = {}   # streaming: key -> observed offsets
+        self._raises: dict = {}  # (grp, key) -> [(raise_t, cummax)]
+        self._win_new = {"divergent": 0, "poll-order": 0,
+                         "lost-writes": 0, "commit-regressions": 0}
+        self._win_ops = 0
+
+    # --- feeding (analysis worker thread) ---
+
+    def observe(self, inv_row: int, invoke, complete):
+        rec = extract_observation(invoke, complete)
+        if rec is None:
+            return
+        self.obs.append((inv_row, rec))
+        self._win_ops += 1
+        self._fold(rec)
+
+    def _bump(self, which: str, n: int = 1):
+        if n:
+            self._win_new[which] += n
+
+    def _observe_assign(self, k, o, m):
+        cur = self._assign.get((k, o))
+        if cur is None:
+            self._assign[(k, o)] = m
+        elif cur != m:
+            self._bump("divergent")
+
+    def _fold(self, rec):
+        tag = rec[0]
+        if tag == "send":
+            _, t, k, o, m = rec
+            self._observe_assign(k, o, m)
+            # classic mode keeps every ack (any later poll may hole it);
+            # streaming prunes observed offsets (the union never
+            # un-observes, so they can't be lost anymore)
+            if not (self.streaming and o in self._union.get(k, ())):
+                self._acked.append((t, k, o, m))
+        elif tag == "poll":
+            _, inv_t, value = rec
+            for k, pairs in value.items():
+                k = str(k)
+                if not pairs:
+                    continue
+                offs = {int(p[0]) for p in pairs}
+                last = None
+                for o, m in pairs:
+                    o = int(o)
+                    if last is None:
+                        if not self.streaming and o != 0:
+                            self._bump("poll-order")
+                    elif (o != last + 1 if self.streaming
+                          else o <= last):
+                        self._bump("poll-order")
+                    last = o
+                    self._observe_assign(k, o, m)
+                u = self._union.setdefault(k, set())
+                u.update(offs)
+                max_o = max(offs)
+                if self.streaming:
+                    self._bump("lost-writes", sum(
+                        1 for t2, k2, o2, _m in self._acked
+                        if k2 == k and o2 not in u and inv_t > t2
+                        and max_o > o2))
+                    self._acked = [a for a in self._acked
+                                   if a[1] != k or a[2] not in u]
+                else:
+                    holes = set(range(max_o + 1)) - offs
+                    if holes:
+                        self._bump("lost-writes", sum(
+                            1 for t2, k2, o2, _m in self._acked
+                            if k2 == k and inv_t > t2 and o2 in holes))
+        elif tag == "commit":
+            _, t, grp, offs = rec
+            for k, o in offs.items():
+                self._raise_floor(grp, k, t, o)
+        else:   # list
+            _, inv_t, t, grp, offs = rec
+            for (g2, k), runs in self._raises.items():
+                if g2 != grp:
+                    continue
+                # binding floor: highest raise STRICTLY before the
+                # list's invoke (equal-timestamp leniency of `grade`)
+                lo = -1
+                for rt, cm in reversed(runs):
+                    if rt < inv_t:
+                        lo = cm
+                        break
+                if lo >= 0 and offs.get(k, -1) < lo:
+                    self._bump("commit-regressions")
+            for k, o in offs.items():
+                self._raise_floor(grp, k, t, o)
+
+    def _raise_floor(self, grp, k, t, o):
+        runs = self._raises.setdefault((grp, k), [])
+        cur = runs[-1][1] if runs else -1
+        runs.append((t, max(cur, o)))
+
+    # --- window close (analysis worker thread) ---
+
+    def window_close(self) -> dict:
+        v = {"ops": self._win_ops,
+             "ok": not any(self._win_new.values())}
+        v.update({k: n for k, n in self._win_new.items() if n})
+        self._win_ops = 0
+        self._win_new = dict.fromkeys(self._win_new, 0)
+        return v
+
+    # --- finish (check time) ---
+
+    def records_in_invoke_order(self) -> list:
+        return [rec for _row, rec in
+                sorted(self.obs, key=lambda t: t[0])]
+
+
+class KafkaChecker(Checker):
+    name = "kafka"
+    # the overlapped pipeline feeds this checker's stream observer
+    # (windowed incremental grading); verdicts stay bit-identical to
+    # the post-hoc path either way
+    consumes_analysis = True
+
+    def make_stream_observer(self, test):
+        return KafkaStreamObserver(test)
+
+    def check(self, test, history, opts=None):
+        streaming = bool(test.get("kafka_groups")) \
+            if isinstance(test, dict) else False
+        pipe = test.get("analysis") if isinstance(test, dict) else None
+        if pipe is not None and hasattr(pipe, "stream_results"):
+            served = pipe.stream_results("kafka", len(history))
+            if served is not None:
+                observer, windows = served
+                out = grade(observer.records_in_invoke_order(),
+                            streaming)
+                lags = [w.get("lag-rounds") for w in windows
+                        if w.get("lag-rounds") is not None]
+                out["windows"] = windows
+                out["checker-lag"] = {
+                    "windows": len(windows),
+                    "max-lag-rounds": max(lags) if lags else 0,
+                    "mean-lag-rounds": (round(sum(lags) / len(lags), 1)
+                                        if lags else 0.0),
+                }
+                return out
+        history = coerce_history(history)
+        obs = [extract_observation(i, c) for i, c in history.pairs()]
+        return grade([r for r in obs if r is not None], streaming)

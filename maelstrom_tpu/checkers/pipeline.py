@@ -9,6 +9,12 @@ sequential checker path would otherwise serialize behind the run:
   - per-key partitioning of register ops (P-compositionality),
   - an incremental per-key linearizability screen (the running replay
     of `screen_register_arrays`' decidable class),
+  - stream observers (doc/streams.md): checkers may register an
+    incremental grader (`Checker.make_stream_observer`) that is fed
+    every completed pair as segments land, grades each drained segment
+    as a WINDOW at its close (per-window early-warning verdict +
+    checker lag in rounds behind the scan head), and serves its carried
+    observation state to the checker at finish,
   - completion stats by :f.
 
 While the TPU executes stretch N+1, the worker chews stretch N. At
@@ -17,7 +23,9 @@ partitions (and short-circuits keys whose incremental screen stayed
 clean), falling back to the full WGL search only on undecided keys —
 verdicts are bit-identical to the sequential path because the screen is
 sound and fallback partitions carry identical op lists (pinned by
-tests/test_overlap_equivalence.py).
+tests/test_overlap_equivalence.py). `KafkaChecker` likewise consumes
+its observer's records (re-sorted to invoke order) through the same
+`grade` fold the post-hoc path uses — equal by construction.
 
 The pipeline is strictly an accelerator: any internal error marks it
 unusable and the checker silently recomputes from the history."""
@@ -104,7 +112,8 @@ class AnalysisPipeline:
     the queue; afterwards `register_partitions(n)` serves the columnar
     partitions to the checker and `report()` summarizes overlap."""
 
-    def __init__(self, workers: int = 1):
+    def __init__(self, workers: int = 1, observers: dict | None = None,
+                 ns_per_round: float | None = None, head_round=None):
         self.workers = max(1, int(workers))
         self.busy_s = 0.0           # worker seconds (compute-overlapped)
         self.segments = 0
@@ -114,6 +123,14 @@ class AnalysisPipeline:
         self._parts: dict = {}      # key -> _KeyPart
         self._stats = {"ok": 0, "fail": 0, "info": 0}
         self.resumed_rows = 0       # rows seeded from a resume checkpoint
+        # stream observers (doc/streams.md): {name: observer}; each fed
+        # completed pairs in segment order, each segment graded as a
+        # window at its close. head_round() reads the runner's live scan
+        # head so window records carry the checker-lag metric.
+        self._observers: dict = dict(observers or {})
+        self._ns_per_round = ns_per_round
+        self._head_round = head_round
+        self.windows: list = []
         self._finished = False
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
@@ -156,9 +173,9 @@ class AnalysisPipeline:
         self._thread.join()
         self._finished = True
         try:
-            for rec in self._open.values():
-                if rec is not _NONREG:
-                    self._add_pair(rec, None, None, None)
+            for _row, reg in self._open.values():
+                if reg is not _NONREG:
+                    self._add_pair(reg, None, None, None)
         except Exception as e:          # pragma: no cover - defensive
             self.error = repr(e)
         return self
@@ -192,6 +209,27 @@ class AnalysisPipeline:
                     parts[i] = (parts[i][0], parts[i][1], verdict)
         return parts
 
+    def stream_results(self, name: str, n_rows: int):
+        """(observer, windows) for the named stream observer, or None
+        when this pipeline cannot vouch for the given history (analysis
+        error, not finished, a row-count mismatch — e.g. a history it
+        never saw — or no such observer). `windows` carries the named
+        observer's per-window verdict next to each window's row range,
+        end round, and checker-lag."""
+        if self.error or not self._finished or self.rows != n_rows:
+            return None
+        ob = self._observers.get(name)
+        if ob is None:
+            return None
+        windows = []
+        for w in self.windows:
+            rec = {k: v for k, v in w.items() if k != "verdicts"}
+            v = (w.get("verdicts") or {}).get(name)
+            if v is not None:
+                rec["verdict"] = v
+            windows.append(rec)
+        return ob, windows
+
     def report(self) -> dict:
         screened = sum(1 for p in self._parts.values() if p.clean)
         out = {"workers": self.workers,
@@ -201,6 +239,12 @@ class AnalysisPipeline:
                "register-keys": len(self._parts),
                "screened-clean-keys": screened,
                "completions": dict(self._stats)}
+        if self.windows:
+            out["windows"] = len(self.windows)
+            lags = [w.get("lag-rounds") for w in self.windows
+                    if w.get("lag-rounds") is not None]
+            if lags:
+                out["max-lag-rounds"] = max(lags)
         if self.resumed_rows:
             out["resumed-rows"] = self.resumed_rows
         if self.error:
@@ -236,20 +280,27 @@ class AnalysisPipeline:
         types, fs, procs = soa.type, soa.f, soa.process
         times, values = soa.time, soa.value
         opens = self._open
+        observers = self._observers
         for i in range(lo, hi):
             p = procs[i]
             t = types[i]
             if t == inv_code:
                 old = opens.pop(p, None)
-                if old is not None and old is not _NONREG:
-                    self._add_pair(old, None, None, None)
+                if old is not None:
+                    row0, reg = old
+                    if reg is not _NONREG:
+                        self._add_pair(reg, None, None, None)
+                    if observers:
+                        inv = history[row0]
+                        for ob in observers.values():
+                            ob.observe(row0, inv, None)
                 f01 = freg[fs[i]] if fs[i] < len(freg) else None
                 v = values[i]
                 if f01 is not None and isinstance(v, (list, tuple)) \
                         and len(v) == 2:
-                    opens[p] = (i, f01, v[0], v[1], int(times[i]))
+                    opens[p] = (i, (i, f01, v[0], v[1], int(times[i])))
                 else:
-                    opens[p] = _NONREG
+                    opens[p] = (i, _NONREG)
             else:
                 if t == ok_code:
                     self._stats["ok"] += 1
@@ -258,19 +309,55 @@ class AnalysisPipeline:
                 else:
                     self._stats["info"] += 1
                 rec = opens.pop(p, None)
-                if rec is None or rec is _NONREG:
+                if rec is None:
+                    continue
+                row0, reg = rec
+                if observers:
+                    inv, comp = history[row0], history[i]
+                    for ob in observers.values():
+                        ob.observe(row0, inv, comp)
+                if reg is _NONREG:
                     continue
                 if t == fail_code:
                     # definitely didn't happen — excluded from the
                     # partition, but the KEY still counts (the
                     # sequential path's by_key holds it with zero ops)
-                    if rec[2] not in self._parts:
-                        self._parts[rec[2]] = _KeyPart()
+                    if reg[2] not in self._parts:
+                        self._parts[reg[2]] = _KeyPart()
                     continue
-                self._add_pair(rec, t == ok_code, values[i],
+                self._add_pair(reg, t == ok_code, values[i],
                                int(times[i]))
         self.segments += 1
         self.rows = hi
+        if observers:
+            self._close_window(lo, hi, times)
+
+    def _close_window(self, lo: int, hi: int, times):
+        """Grades the just-analyzed segment as one WINDOW: each stream
+        observer reports what the segment newly exposed, and the record
+        carries the checker-lag metric — how many rounds the scan head
+        had advanced past this window's last event by the time its
+        analysis closed (bounded lag = the grader keeps up)."""
+        head = None
+        if self._head_round is not None:
+            try:
+                head = int(self._head_round())
+            except Exception:       # pragma: no cover - defensive
+                head = None
+        end_round = None
+        lag = None
+        if self._ns_per_round and hi > lo:
+            end_round = int(round(float(times[hi - 1])
+                                  / self._ns_per_round))
+            if head is not None:
+                lag = max(head - end_round, 0)
+        rec = {"window": len(self.windows), "rows": [lo, hi],
+               "end-round": end_round, "lag-rounds": lag}
+        for name, ob in self._observers.items():
+            close = getattr(ob, "window_close", None)
+            if close is not None:
+                rec.setdefault("verdicts", {})[name] = close()
+        self.windows.append(rec)
 
     def _add_pair(self, rec, ok, cval, ctime):
         """Appends one (invoke, completion-or-None) register pair to its

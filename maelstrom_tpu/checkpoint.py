@@ -98,7 +98,13 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     "journal_rows", "max_scan", "pool_cap", "gossip_fanout",
                     "mesh", "journal_scan_cap", "reply_log_cap",
                     "collect_replies", "fleet", "fleet_sweep",
-                    "nemesis_seed")
+                    "nemesis_seed",
+                    # open-world streams (doc/streams.md): injection
+                    # mode and the consumer-group protocol shape both
+                    # change the op stream, so a resume must match
+                    "continuous", "continuous_window_ms",
+                    "latency_scale", "kafka_groups",
+                    "session_timeout_ms", "poll_batch")
 
 
 class CheckpointError(RuntimeError):
@@ -123,8 +129,16 @@ class Preempted(RuntimeError):
 
 
 def fingerprint(test: dict) -> dict:
-    return {k: sorted(v) if isinstance(v, set) else v
-            for k, v in ((k, test.get(k)) for k in FINGERPRINT_KEYS)}
+    fp = {k: sorted(v) if isinstance(v, set) else v
+          for k, v in ((k, test.get(k)) for k in FINGERPRINT_KEYS)}
+    # checkpoint cadence stays OUT of the round-synchronous fingerprint
+    # (cadence neutrality is pinned — a resume may change it freely),
+    # but continuous-mode op timing depends on window boundaries and
+    # checkpoints ARE boundaries: a continuous resume must match
+    # (doc/streams.md)
+    if test.get("continuous"):
+        fp["checkpoint_every"] = test.get("checkpoint_every")
+    return fp
 
 
 def _encode(state: dict) -> bytes:

@@ -46,10 +46,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Latency distribution shape")
     t.add_argument("--p-loss", type=float, default=0.0,
                    help="Probability each message is lost in transit")
+    t.add_argument("--latency-scale", type=float, default=1.0,
+                   help="Baseline latency scale factor (the slow!/fast! "
+                        "knob), applied identically on the host and TPU "
+                        "network paths; the weather nemesis toggles it "
+                        "mid-run and restores this baseline")
     t.add_argument("--nemesis", default="",
                    help="Comma-separated fault packages to compose: "
-                        "partition, kill, pause, duplicate "
-                        "(e.g. --nemesis kill,pause,partition,duplicate)")
+                        "partition, kill, pause, duplicate, weather "
+                        "(e.g. --nemesis "
+                        "kill,pause,partition,duplicate,weather)")
     t.add_argument("--nemesis-interval", type=float, default=10.0,
                    help="Seconds between nemesis operations")
     t.add_argument("--nemesis-seed", type=int, default=None,
@@ -133,6 +139,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Disable the overlapped analysis pipeline and "
                         "run all checking sequentially after the run "
                         "(verdicts are bit-identical either way)")
+    t.add_argument("--continuous", action="store_true",
+                   help="Continuous generator mode (TPU path only): "
+                        "client ops are injected at their seeded "
+                        "offered-rate rounds INSIDE the compiled scan "
+                        "window — traffic lands while nemeses are "
+                        "mid-fault — instead of one dispatch per op. "
+                        "Same seed => byte-identical history, plain and "
+                        "--mesh (doc/streams.md)")
+    t.add_argument("--continuous-window-ms", type=float,
+                   help="Continuous-mode stream stride in virtual ms "
+                        "(default 250): windows cross replies, and the "
+                        "stride bounds how stale a freed worker can get "
+                        "before the generator is polled again")
+    t.add_argument("--kafka-groups", type=int,
+                   help="Streaming kafka consumer groups (kafka "
+                        "workload, TPU path): N > 0 switches polls to "
+                        "long-lived group subscriptions with "
+                        "cursor-based fetches, coordinator rebalancing, "
+                        "and per-group offset commits (doc/streams.md)")
+    t.add_argument("--session-timeout-ms", type=float,
+                   help="Consumer-group session timeout: a member "
+                        "silent (no commit/subscribe heartbeat) this "
+                        "long is evicted and its keys rebalance "
+                        "(default 2500)")
+    t.add_argument("--poll-batch", type=int,
+                   help="Max entries per streaming kafka fetch "
+                        "(default 8)")
     t.add_argument("--ms-per-round", type=float, default=1.0,
                    help="Virtual milliseconds per simulation round "
                         "(TPU path; coarser = faster, less latency "
@@ -238,6 +271,8 @@ def opts_from_args(args) -> dict:
         "concurrency": args.concurrency,
         "latency": {"mean": args.latency, "dist": args.latency_dist},
         "p_loss": args.p_loss,
+        "latency_scale": args.latency_scale,
+        "continuous": args.continuous,
         "nemesis": set(filter(None, args.nemesis.split(","))),
         "nemesis_interval": args.nemesis_interval,
         "client_retries": args.client_retries,
@@ -270,7 +305,9 @@ def opts_from_args(args) -> dict:
     # TPU-path performance knobs: only forwarded when given, so the
     # runner's own defaults stay in one place
     for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
-              "check_workers", "fleet", "fleet_sweep", "nemesis_seed"):
+              "check_workers", "fleet", "fleet_sweep", "nemesis_seed",
+              "kafka_groups", "session_timeout_ms", "poll_batch",
+              "continuous_window_ms"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v
@@ -288,6 +325,17 @@ def opts_from_args(args) -> dict:
         raise SystemExit("--fleet needs the TPU path (--node "
                          "tpu:<program>): the cluster axis is a vmapped "
                          "dimension of the compiled scan")
+    if args.continuous and not (
+            args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--continuous needs the TPU path (--node "
+                         "tpu:<program>): scheduled in-scan injection "
+                         "is a compiled-scan feature (the host path is "
+                         "already real-time-continuous)")
+    if (args.kafka_groups or 0) > 0 and not (
+            args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--kafka-groups needs the TPU path (--node "
+                         "tpu:kafka): the bin-path client speaks the "
+                         "classic full-prefix kafka workload only")
     return opts
 
 

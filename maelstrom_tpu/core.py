@@ -69,6 +69,23 @@ DEFAULTS = dict(
     # decouples the fault-schedule RNG from the workload seed (the
     # `nemesis` sweep; None = follow seed).
     fleet=1, fleet_sweep="seed", nemesis_seed=None,
+    # network weather baseline (doc/streams.md): loss probability and
+    # ABSOLUTE latency scale (the slow!/fast! knob) applied identically
+    # to the host net and the TPU NetState — the `weather` nemesis
+    # toggles both mid-run and stop-weather restores these baselines
+    p_loss=None, latency_scale=1.0,
+    # continuous generator mode (doc/streams.md): client ops are
+    # injected at their seeded offered-rate rounds INSIDE the compiled
+    # scan window (open-world stream) instead of one dispatch per op;
+    # TPU path only, same-seed runs byte-identical plain and --mesh.
+    # continuous_window_ms is the stream stride: windows cross replies,
+    # and the stride bounds a backlogged op's emission delay
+    continuous=False, continuous_window_ms=250.0,
+    # streaming kafka (doc/streams.md): kafka_groups > 0 switches the
+    # kafka workload to consumer groups — long-lived subscriptions,
+    # cursor-based fetches (no O(prefix) replies), coordinator-driven
+    # rebalancing on membership change, per-group offset commits
+    kafka_groups=0, session_timeout_ms=2500.0, poll_batch=8,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user
@@ -174,8 +191,16 @@ def build_test(opts: dict) -> dict:
 
     net = HostNet(latency=opts["latency"], log_send=opts["log_net_send"],
                   log_recv=opts["log_net_recv"], seed=opts["seed"])
-    if opts.get("p_loss"):
+    # p_loss/latency_scale flow SYMMETRICALLY to both network paths:
+    # the host net here, the TPU NetState in TpuRunner._build_sim —
+    # same option keys, same values, so --p-loss/--latency-scale runs
+    # are path-equivalent (an explicit 0.0 is installed too, not
+    # truthiness-skipped). The weather nemesis restores exactly these.
+    if opts.get("p_loss") is not None:
         net.p_loss = float(opts["p_loss"])
+    if opts.get("latency_scale") is not None:
+        net.latency_dist = net.latency_dist.unscaled().scaled(
+            float(opts["latency_scale"]))
     opts["net"] = net
     workload = registry()[opts["workload"]](opts)
 

@@ -44,6 +44,12 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # latencies), validity — must match exactly.
 VOLATILE_RESULT_KEYS = ("net", "analysis-pipeline", "resumed-at-round")
 
+# Wall-clock blocks nested inside a checker's own result (the windowed
+# stream grading carries checker lag, and the window layout depends on
+# drain cadence — doc/streams.md); the verdict fields beside them must
+# still match bit-for-bit.
+VOLATILE_SUBRESULT_KEYS = ("windows", "checker-lag")
+
 # Fleet results additionally inline the fleet-level TransferStats
 # accounting at the top level (one transfer ledger for the whole fleet)
 # and a static-audit block with wall time; both restart per launch.
@@ -242,8 +248,15 @@ def _strip_volatile(results: dict) -> dict:
                if k not in VOLATILE_FLEET_KEYS}
         out["clusters"] = [_strip_volatile(c) for c in results["clusters"]]
         return out
-    return {k: v for k, v in results.items()
-            if k not in VOLATILE_RESULT_KEYS}
+    out = {}
+    for k, v in results.items():
+        if k in VOLATILE_RESULT_KEYS:
+            continue
+        if isinstance(v, dict):
+            v = {k2: v2 for k2, v2 in v.items()
+                 if k2 not in VOLATILE_SUBRESULT_KEYS}
+        out[k] = v
+    return out
 
 
 def compare_runs(dir_a: str, dir_b: str) -> dict:

@@ -560,3 +560,62 @@ def each_thread(op_map: dict):
 
 def phases(*gens):
     return Phases(*gens)
+
+
+def schedule_ahead(gen, processes, free, r0: int, horizon_r: int,
+                   ns_per_round: float, dispatch_count: int):
+    """Continuous-mode pre-scheduler (doc/streams.md): polls `gen`
+    forward through VIRTUAL time — no simulation rounds execute — and
+    collects the client ops it emits, each stamped with the round it is
+    due, so one compiled scan can inject them at their exact offered-rate
+    rounds inside the window [r0, horizon_r).
+
+    Time advances along the generator's own `next_interesting_time`
+    contract: a PENDING answer with a finite next time jumps the virtual
+    clock there (the same bound the round-synchronous scan path stops
+    at, so an op lands on the identical round either way); PENDING with
+    +inf means only a completion event can unblock the generator — the
+    window ends there ("starved"). Emitted client ops RESERVE their
+    worker for the rest of the window (the host can't see mid-window
+    completions), which bounds the events list by len(free).
+
+    A NEMESIS op is a window boundary: its fault surgery is host-side
+    state the scan cannot apply mid-flight. One emitted at r0 before any
+    client op is returned for immediate execution (end == r0, no
+    events); one emitted later ends the window at its round and is
+    carried to the caller. Generators are advanced functionally but may
+    share mutable RNGs between successor states, so a drawn op is never
+    "un-polled" — the caller must execute or carry everything returned.
+
+    Returns (gen', events, nem, end_r, end_kind) where events is
+    [(round, op), ...] in nondecreasing round order, nem is (round, op)
+    or None, end_r the exclusive window bound, and end_kind one of
+    "horizon" | "starved" | "exhausted" | "nemesis"."""
+    free = set(free)
+    events: list = []
+    r_v = r0
+    while True:
+        ctx = {"time": int(r_v * ns_per_round),
+               "free": rotate_free(free, dispatch_count),
+               "processes": list(processes)}
+        res, gen = gen.op(ctx)
+        if res is None:
+            # exhausted forever (the Gen contract): the window may still
+            # run to the horizon to drain in-flight ops
+            return gen, events, None, horizon_r, "exhausted"
+        if res == PENDING:
+            nt = gen.next_interesting_time(ctx)
+            if nt == math.inf:
+                return gen, events, None, horizon_r, "starved"
+            nr = int(math.ceil(nt / ns_per_round))
+            if nr <= r_v:
+                nr = r_v + 1        # same one-round floor as _scan_bound
+            if nr >= horizon_r:
+                return gen, events, None, horizon_r, "horizon"
+            r_v = nr
+            continue
+        if res["process"] == NEMESIS:
+            return gen, events, (r_v, res), max(r_v, r0), "nemesis"
+        free.discard(res["process"])
+        dispatch_count += 1
+        events.append((r_v, res))

@@ -19,6 +19,13 @@ bidirectional partitions (`core.clj:40-42`), this module is a registry of
     (GC/VM stalls; SIGSTOP/SIGCONT on the host path).
   - ``duplicate``  — at-least-once delivery: inter-server messages are
     re-enqueued with an independent latency draw with probability p.
+  - ``weather``    — network weather fronts: seeded mid-run toggling of
+    the net's loss probability (`p_loss`) and latency scale (the
+    slow!/fast! knob, `net/tpu.py NetState.latency_scale` /
+    `net/host.py LatencyDist.scale`). start-weather installs a drawn
+    front (drizzle/storm/monsoon); stop-weather restores the run's
+    BASELINE values (--p-loss / --latency-scale), so the final heal
+    leaves the network exactly as configured.
 
 Each package runs its own on/off generator schedule (offset so packages
 interleave within the interval), built from the same ``g.Seq``/``cycle``
@@ -40,10 +47,20 @@ import random
 
 from . import generators as g
 
-FAULTS = ("partition", "kill", "pause", "duplicate")
+FAULTS = ("partition", "kill", "pause", "duplicate", "weather")
 
 # duplication probabilities the duplicate package cycles through
 DUP_PROBS = (0.1, 0.25, 0.5)
+
+# weather fronts the weather package draws from: (name, p_loss,
+# latency_scale). Scales stay within the edge-ring headroom budget
+# (`nodes.edge_timing` sizes rings for max_latency_scale, default 10);
+# loss stays moderate because it also eats CLIENT RPCs (like the
+# reference's flaky!, net.clj:213-214) and each lost client message
+# parks a worker for the full RPC timeout
+WEATHER_FRONTS = (("drizzle", 0.02, 2.0),
+                  ("storm", 0.1, 5.0),
+                  ("monsoon", 0.25, 10.0))
 
 
 # --- partition grudges -----------------------------------------------------
@@ -188,6 +205,10 @@ class NemesisDecisions:
     def next_dup_prob(self) -> float:
         return self.rngs["duplicate"].choice(DUP_PROBS)
 
+    def next_weather(self) -> tuple:
+        """(name, p_loss, latency_scale) for the next weather front."""
+        return self.rngs["weather"].choice(WEATHER_FRONTS)
+
     # checkpoint/resume: the decision streams plus the active-fault
     # bookkeeping must survive together
     def rng_state(self):
@@ -222,6 +243,11 @@ class CombinedNemesis(NemesisDecisions):
         self.db = db
         self.killed: list = []
         self.paused_nodes: list = []
+        # weather baseline: the run's CONFIGURED loss/latency-scale (the
+        # net carries them by the time the nemesis is built), restored
+        # verbatim by stop-weather so the final heal is exact
+        self._base_p_loss = float(net.p_loss)
+        self._base_lat_scale = float(net.latency_dist.scale)
 
     def _need_db(self, f):
         if self.db is None:
@@ -287,6 +313,18 @@ class CombinedNemesis(NemesisDecisions):
         if f == "stop-duplicate":
             self.net.duplicate(0.0)
             return {**op, "type": "info", "value": "duplicate off"}
+        if f == "start-weather":
+            name, p, scale = self.next_weather()
+            self.net.p_loss = p
+            self.net.latency_dist = \
+                self.net.latency_dist.unscaled().scaled(scale)
+            return {**op, "type": "info",
+                    "value": f"weather {name} p_loss={p} scale={scale}"}
+        if f == "stop-weather":
+            self.net.p_loss = self._base_p_loss
+            self.net.latency_dist = self.net.latency_dist.unscaled() \
+                .scaled(self._base_lat_scale)
+            return {**op, "type": "info", "value": "weather cleared"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
 

@@ -488,6 +488,22 @@ def flaky(net: NetState, p: float = 0.5) -> NetState:
     return net.replace(p_loss=jnp.full_like(net.p_loss, p))
 
 
+def set_latency_scale(net: NetState, scale: float) -> NetState:
+    """Absolute latency-scale install (slow!/fast! are multiplicative;
+    the weather nemesis and --latency-scale need idempotent installs)."""
+    return net.replace(
+        latency_scale=jnp.full_like(net.latency_scale, scale))
+
+
+def set_weather(net: NetState, p_loss: float, scale: float) -> NetState:
+    """One weather-front install: loss probability + latency scale in a
+    single surgery (the `weather` nemesis package; stop-weather restores
+    the run's baseline values through the same call)."""
+    return net.replace(p_loss=jnp.full_like(net.p_loss, p_loss),
+                       latency_scale=jnp.full_like(net.latency_scale,
+                                                   scale))
+
+
 def stats_dict(net: NetState, transfer=None) -> dict:
     """Pull the on-device counters to host, in the shape the net-stats
     checker reports (`net/checker.clj:43-70`). On a cluster-batched net

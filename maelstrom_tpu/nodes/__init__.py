@@ -192,6 +192,18 @@ class NodeProgram:
         """Completes a HOST-routed op from device state."""
         raise NotImplementedError
 
+    # --- checkpointable host-side session state ---
+
+    def host_state(self):
+        """Picklable host-side bookkeeping this program keeps between
+        ops (kafka: consumer-group sessions + polled-offset tracking),
+        carried in checkpoints so a resumed run replays identically.
+        None = stateless."""
+        return None
+
+    def set_host_state(self, st):
+        """Restores what `host_state` returned (no-op for None)."""
+
     # --- durable store (kill/restart fault package) ---
 
     def durable_view(self, state):

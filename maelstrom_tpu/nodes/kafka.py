@@ -48,8 +48,30 @@ T_LIST_OK = 17     # a|b|c = packed committed offsets (+1)
 # T_ERROR (= 1) comes from the shared reply vocabulary in nodes/__init__
 T_REPL = 20        # edge lane k: a = sender len, b = offset, c = msg
 
+# --- consumer-group streaming protocol (kafka_groups > 0, doc/streams.md)
+T_SUB = 30         # a = group<<10 | member
+T_SUB_OK = 31      # a = generation, b|c = packed key->member assignment
+T_FETCH = 32       # a = group<<10 | member, b = key<<16 | (cursor+1),
+                   # c = max batch — the cursor poll: NO full-prefix reply
+T_FETCH_OK = 33    # a = key<<16 | (start+1), b = n entries (host slices
+                   # the replica log [start, start+n), state_reads_final)
+T_GCOMMIT = 34     # a = group<<26 | member<<16 | gen16, b|c = packed
+                   # offsets (keys 0..3; group mode caps key_count at 4)
+T_GCOMMIT_OK = 35  # a = generation, b|c echo the applied offsets
+T_REBAL = 36       # fenced commit: a = NEW generation, b|c = packed
+                   # assignment — the member was evicted/staled and has
+                   # been rejoined; it must re-fetch from committed
+T_GLIST = 37       # a = group
+T_GLIST_OK = 38    # a = generation, b|c = packed committed offsets (+1)
+
 MAX_PACK_KEYS = 6  # 2 x 16-bit fields per wire word, 3 words
+MAX_GROUPS = 8     # group id must fit the packed gcommit header
+# member ids ride two field widths: 10 bits in the sub/fetch/gcommit
+# request headers AND 8-bit member+1 fields in the packed ASSIGNMENT
+# replies (_pack_assign/_unpack_assign) — the tighter one binds
+MAX_MEMBERS = 254
 COORDINATOR = 0    # node holding the authoritative committed-offset row
+                   # AND the consumer-group coordinator state
 
 
 def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
@@ -83,6 +105,16 @@ def _unpack_offsets(a: int, b: int, c: int, keys: int) -> dict:
         v = ((a, b, c)[k // 2] >> (16 * (k % 2))) & 0xFFFF
         if v:
             out[str(k)] = v - 1
+    return out
+
+
+def _unpack_assign(b: int, c: int, keys: int) -> dict:
+    """Two packed assignment words -> {key: member or None}: 8-bit
+    member+1 fields, four per word (keys 0..3 in b, 4..5 in c)."""
+    out = {}
+    for k in range(keys):
+        v = ((b, c)[k // 4] >> (8 * (k % 4))) & 0xFF
+        out[k] = (v - 1) if v else None
     return out
 
 
@@ -137,6 +169,31 @@ class KafkaProgram(NodeProgram):
                              "spill must be off")
         self._host_polled: dict = {}   # key -> max offset seen by polls
         self.beat_rounds = int(opts.get("beat_rounds", 64))
+        # consumer-group streaming mode (doc/streams.md): G > 0 switches
+        # the workload's polls to long-lived subscriptions with
+        # cursor-based fetches; the coordinator row owns membership,
+        # generations, and per-group committed offsets
+        self.G = int(opts.get("kafka_groups") or 0)
+        if self.G:
+            if self.G > MAX_GROUPS:
+                raise ValueError(f"kafka_groups {self.G} exceeds the "
+                                 f"packed header width ({MAX_GROUPS})")
+            if self.K > 4:
+                raise ValueError(
+                    f"group mode packs commit offsets into two wire "
+                    f"words: key_count must be <= 4 (got {self.K})")
+            self.M = int(opts.get("concurrency") or len(nodes))
+            if self.M > MAX_MEMBERS:
+                raise ValueError(f"{self.M} workers exceed the member "
+                                 f"field width ({MAX_MEMBERS})")
+            ms_pr = float(opts.get("ms_per_round", 1.0))
+            self.session_rounds = max(2, int(
+                float(opts.get("session_timeout_ms", 2500.0)) / ms_pr))
+            self.poll_batch = max(1, int(opts.get("poll_batch", 8)))
+            # per-worker subscription sessions (host side of the
+            # consumer protocol): generation, assigned keys, fetch
+            # cursors, last-known committed floors, fetch round-robin
+            self._subs: dict = {}
         self.edge_cfg = EdgeConfig(n_nodes=self.n_nodes, degree=self.D,
                                    lanes=self.lanes, ring=self.ring,
                                    uniform_arrival=uniform)
@@ -144,13 +201,24 @@ class KafkaProgram(NodeProgram):
     def init_state(self):
         N, K, C = self.n_nodes, self.K, self.cap
         z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
-        return {
+        s = {
             "log": z(N, K, C),           # interned msg per offset
             "log_len": z(N, K),
             "peer_len": z(N, self.D, K),  # neighbor's last advertised len
             "committed": jnp.full((N, K), -1, I32),   # node 0's row rules
             "log_overflow": z(N),
         }
+        if self.G:
+            G, M = self.G, self.M
+            # group state: every node carries the arrays for shape
+            # uniformity, but only the coordinator row ever changes or
+            # is read (group RPCs are coordinator-routed); it is durable
+            # like the logs (kafka persists __consumer_offsets)
+            s["gactive"] = jnp.zeros((N, G, M), bool)
+            s["gseen"] = z(N, G, M)       # last heartbeat round
+            s["ggen"] = z(N, G)           # rebalance generation
+            s["gcommitted"] = jnp.full((N, G, K), -1, I32)
+        return s
 
     def invalid_counters(self, state):
         return {"log-overflow": state["log_overflow"]}
@@ -180,6 +248,19 @@ class KafkaProgram(NodeProgram):
         s["log_len"] = s["log_len"] + any_offer.astype(I32)
         changed = any_offer                            # [N, K] len grew
 
+        # ---------------- consumer-group maintenance (group mode)
+        if self.G:
+            # evict members whose heartbeat (commit/subscribe arrival at
+            # the coordinator) is older than the session timeout: the
+            # kill/pause nemesis parks a member's worker on RPC
+            # timeouts, the coordinator notices the silence here, and
+            # the generation bump fences the member's next commit —
+            # membership change drives the rebalance
+            expired = s["gactive"] & (
+                (ctx["round"] - s["gseen"]) > self.session_rounds)
+            s["gactive"] = s["gactive"] & ~expired
+            s["ggen"] = s["ggen"] + expired.any(-1).astype(I32)
+
         # ---------------- client requests (inbox_cap is tiny: unrolled)
         A = client_in.valid.shape[1]
         outs = []
@@ -187,6 +268,8 @@ class KafkaProgram(NodeProgram):
         for j in range(A):
             v = client_in.valid[:, j]
             t = client_in.type[:, j]
+            aw, bw, cw = (client_in.a[:, j], client_in.b[:, j],
+                          client_in.c[:, j])
             key = jnp.clip(client_in.a[:, j], 0, K - 1)
             owner = (key % N) == me
             # send: owner appends (offset = len before)
@@ -252,6 +335,100 @@ class KafkaProgram(NodeProgram):
                                      jnp.where(is_poll, pc, 0)))
             say = v & (do_send | is_cmt | is_list | is_poll | misrouted
                        | send_full)
+
+            # ------------ consumer-group RPCs (group mode; overlaid on
+            # the legacy chain — wire types are disjoint)
+            if self.G:
+                G, M = self.G, self.M
+                is_sub = v & (t == T_SUB) & is_leader0
+                is_fetch = v & (t == T_FETCH)
+                is_gcmt = v & (t == T_GCOMMIT) & is_leader0
+                is_glist = v & (t == T_GLIST) & is_leader0
+                g_mis = v & ((t == T_SUB) | (t == T_GCOMMIT)
+                             | (t == T_GLIST)) & ~is_leader0
+                # header fields (sub/fetch pack group<<10|member in a;
+                # gcommit packs group<<26|member<<16|gen16; glist a=group)
+                g_any = jnp.clip(
+                    jnp.where(is_gcmt, (aw >> 26) & 0xF,
+                              jnp.where(is_glist, aw, aw >> 10)),
+                    0, G - 1)
+                m_any = jnp.clip(
+                    jnp.where(is_gcmt, (aw >> 16) & 0x3FF, aw & 1023),
+                    0, M - 1)
+                gen16 = aw & 0xFFFF
+                # fencing is judged against the PRE-join state: a stale
+                # generation or an evicted membership rejects the commit
+                old_act = s["gactive"][me, g_any, m_any]
+                old_gen = s["ggen"][me, g_any]
+                fenced = is_gcmt & (((old_gen & 0xFFFF) != gen16)
+                                    | ~old_act)
+                ok_cmt = is_gcmt & ~fenced
+                # membership: subscribe always joins; a fenced commit
+                # REJOINS (kafka's fenced-consumer-must-rejoin), so the
+                # kill->silence->evict->return loop self-heals without
+                # extra ops. Generation bumps only on actual change.
+                join = is_sub | fenced
+                newly = join & ~old_act
+                s["gactive"] = s["gactive"].at[me, g_any, m_any].set(
+                    old_act | join, unique_indices=True)
+                beats = is_sub | is_gcmt
+                s["gseen"] = s["gseen"].at[me, g_any, m_any].set(
+                    jnp.where(beats, ctx["round"],
+                              s["gseen"][me, g_any, m_any]),
+                    unique_indices=True)
+                s["ggen"] = s["ggen"].at[me, g_any].add(
+                    newly.astype(I32), unique_indices=True)
+                new_gen = s["ggen"][me, g_any]
+                # post-join assignment for THIS slot's group row only
+                asg_g = self._assign_members(
+                    s["gactive"][me, g_any])               # [N, K]
+                asg_b, asg_c = self._pack_assign(asg_g)
+                # non-fenced commit: advance the group's committed marks
+                # for the member's OWN assigned keys only (per-key
+                # fencing); the stored mark is monotone by construction
+                for k in range(K):
+                    w = bw if k < 2 else cw
+                    o = ((w >> (16 * (k % 2))) & 0xFFFF) - 1
+                    mine = ok_cmt & (asg_g[:, k] == m_any)
+                    s["gcommitted"] = s["gcommitted"].at[
+                        me, g_any, k].max(jnp.where(mine, o, -1),
+                                          unique_indices=True)
+                glw = _device_pack(jnp.where(
+                    s["gcommitted"][me, g_any] >= 0,
+                    s["gcommitted"][me, g_any] + 1, 0))
+                # cursor fetch, served from ANY replica: b = key<<16 |
+                # (start+1); n entries exist at reply-round length, the
+                # host slices the append-only log (state_reads_final)
+                fk = jnp.clip(bw >> 16, 0, K - 1)
+                fcur = (bw & 0xFFFF) - 1
+                flen = s["log_len"][me, fk]
+                fn = jnp.where(fcur >= 0,
+                               jnp.clip(flen - fcur, 0,
+                                        jnp.clip(cw, 0, 0x7FFF)), 0)
+                rtype = jnp.where(
+                    is_fetch, T_FETCH_OK,
+                    jnp.where(is_sub, T_SUB_OK,
+                              jnp.where(fenced, T_REBAL,
+                                        jnp.where(ok_cmt, T_GCOMMIT_OK,
+                                                  jnp.where(is_glist,
+                                                            T_GLIST_OK,
+                                                            rtype)))))
+                ra = jnp.where(is_fetch, (fk << 16) | (fcur + 1),
+                               jnp.where(is_sub | fenced | ok_cmt
+                                         | is_glist, new_gen, ra))
+                rb = jnp.where(is_fetch, fn,
+                               jnp.where(is_sub | fenced, asg_b,
+                                         jnp.where(ok_cmt, bw,
+                                                   jnp.where(is_glist,
+                                                             glw[0],
+                                                             rb))))
+                rc = jnp.where(is_sub | fenced, asg_c,
+                               jnp.where(ok_cmt, cw,
+                                         jnp.where(is_glist, glw[1],
+                                                   jnp.where(is_fetch,
+                                                             0, rc))))
+                say = say | is_fetch | is_sub | fenced | ok_cmt \
+                    | is_glist | g_mis
             outs.append((say, client_in.src[:, j], rtype, ra, rb, rc,
                          client_in.mid[:, j]))
 
@@ -297,6 +474,41 @@ class KafkaProgram(NodeProgram):
         # conservative: the beat timer ticks forever
         return jnp.array(False)
 
+    # --- consumer-group device helpers (group mode) ---
+
+    def _assign_members(self, gactive):
+        """[..., M] active-member mask -> [..., K] i32 member-per-key
+        assignment (-1 = unassigned): key k goes to the member of rank
+        (k mod count) in member-id order — the deterministic round-robin
+        every correct implementation (device and host) agrees on.
+        (edge_step calls this on ONE group's [N, M] row per inbox slot;
+        building the full [N, G, K, M] tensor per slot was pure waste —
+        membership is per-slot state, so XLA cannot CSE the copies.)"""
+        K = self.K
+        cnt = gactive.sum(-1).astype(I32)                    # [...]
+        rank = jnp.cumsum(gactive.astype(I32), axis=-1) - 1  # [..., M]
+        ks = jnp.arange(K, dtype=I32)
+        shape = (1,) * (gactive.ndim - 1) + (K,)
+        want = ks.reshape(shape) % jnp.maximum(cnt[..., None], 1)
+        hit = gactive[..., None, :] & (rank[..., None, :]
+                                       == want[..., :, None])  # [...,K,M]
+        mem = jnp.argmax(hit, axis=-1).astype(I32)
+        return jnp.where(hit.any(-1), mem, -1)
+
+    def _pack_assign(self, asg):
+        """[N, K] member-per-key -> two packed wire words (8-bit
+        member+1 fields, four per word; the device half of
+        `_unpack_assign`)."""
+        b = jnp.zeros(asg.shape[0], I32)
+        c = jnp.zeros_like(b)
+        for k in range(self.K):
+            f = jnp.where(asg[:, k] >= 0, asg[:, k] + 1, 0)
+            if k < 4:
+                b = b | (f << (8 * k))
+            else:
+                c = c | (f << (8 * (k - 4)))
+        return b, c
+
     # --- host boundary ---
 
     def owner_of(self, key: int) -> int:
@@ -315,15 +527,58 @@ class KafkaProgram(NodeProgram):
         if op["f"] == "send":
             k = int(op["value"][0])
             return self.owner_of(k) if 0 <= k < self.K else None
+        if self.G:
+            if op["f"] in ("subscribe", "commit", "list"):
+                return COORDINATOR
+            if op["f"] == "poll":
+                # an unsubscribed (or unassigned) worker's poll turns
+                # into a subscribe — coordinator-routed; real fetches go
+                # to the worker's bound replica
+                sub = self._subs.get(int(op["process"]))
+                if sub is None or not sub["keys"]:
+                    return COORDINATOR
+                return None
+            return None
         if op["f"] in ("commit", "list"):
             return COORDINATOR
         return None
+
+    def _group_request(self, op):
+        """The host half of a consumer session (doc/streams.md): each
+        worker is one group member; polls round-robin cursor fetches
+        over its assigned keys, commits claim exactly its cursors, and
+        anything without a live subscription becomes a subscribe."""
+        member = int(op["process"])
+        g = member % self.G
+        sub = self._subs.get(member)
+        f = op["f"]
+        if f == "subscribe" or (f in ("poll", "commit") and sub is None) \
+                or (f == "poll" and not sub["keys"]):
+            return {"type": "subscribe", "group": g, "member": member}
+        if f == "poll":
+            keys = sub["keys"]
+            k = keys[sub["rr"] % len(keys)]
+            sub["rr"] += 1
+            return {"type": "fetch", "group": g, "member": member,
+                    "key": k, "cursor": int(sub["cursors"].get(k, 0)),
+                    "batch": self.poll_batch}
+        if f == "commit":
+            # claim = everything this member consumed on its OWN keys;
+            # an empty claim still round-trips (it is the heartbeat)
+            offs = {k: sub["cursors"][k] - 1 for k in sub["keys"]
+                    if sub["cursors"].get(k, 0) > 0}
+            return {"type": "commit_group", "group": g,
+                    "member": member, "gen": int(sub["gen"]),
+                    "offsets": offs}
+        return {"type": "list_group", "group": g}
 
     def request_for_op(self, op):
         f = op["f"]
         if f == "send":
             k, m = op["value"]
             return {"type": "send", "key": int(k), "msg": m}
+        if self.G:
+            return self._group_request(op)
         if f == "poll":
             return {"type": "poll"}
         if f == "commit":
@@ -351,6 +606,28 @@ class KafkaProgram(NodeProgram):
         if t == "commit_offsets":
             a, b, c = _pack_offsets(body["offsets"], self.K)
             return (T_COMMIT, a, b, c)
+        if t == "subscribe":
+            return (T_SUB,
+                    (int(body["group"]) << 10) | int(body["member"]),
+                    0, 0)
+        if t == "fetch":
+            cur = int(body["cursor"])
+            if cur > 0x7FFE:
+                raise EncodeCapacityError(
+                    f"kafka fetch cursor {cur} exceeds the 15-bit wire "
+                    f"field")
+            return (T_FETCH,
+                    (int(body["group"]) << 10) | int(body["member"]),
+                    (int(body["key"]) << 16) | (cur + 1),
+                    int(body["batch"]))
+        if t == "commit_group":
+            w = _pack_offsets(body["offsets"], self.K)
+            return (T_GCOMMIT,
+                    (int(body["group"]) << 26)
+                    | (int(body["member"]) << 16)
+                    | (int(body["gen"]) & 0xFFFF), w[0], w[1])
+        if t == "list_group":
+            return (T_GLIST, int(body["group"]), 0, 0)
         return (T_LIST, 0, 0, 0)
 
     def decode_body(self, t, a, b, c, intern):
@@ -368,14 +645,126 @@ class KafkaProgram(NodeProgram):
             return {"type": "poll_ok",
                     "lens": _unpack_offsets(int(a), int(b), int(c),
                                             self.K)}
+        if t == T_SUB_OK:
+            return {"type": "subscribe_ok", "gen": int(a),
+                    "assign": _unpack_assign(int(b), int(c), self.K)}
+        if t == T_FETCH_OK:
+            return {"type": "fetch_ok", "key": int(a) >> 16,
+                    "start": (int(a) & 0xFFFF) - 1, "n": int(b)}
+        if t == T_GCOMMIT_OK:
+            return {"type": "commit_group_ok", "gen": int(a),
+                    "offsets": _unpack_offsets(int(b), int(c), 0,
+                                               self.K)}
+        if t == T_REBAL:
+            return {"type": "rebalance", "gen": int(a),
+                    "assign": _unpack_assign(int(b), int(c), self.K)}
+        if t == T_GLIST_OK:
+            return {"type": "list_group_ok", "gen": int(a),
+                    "offsets": _unpack_offsets(int(b), int(c), 0,
+                                               self.K)}
         if t == T_ERROR:
             return {"type": "error", "code": int(a),
                     "text": ("log full" if int(a) == 14 else
                              "misrouted (owner/coordinator elsewhere)")}
         return super().decode_body(t, a, b, c, intern)
 
+    def _apply_assignment(self, op, body):
+        """Folds a subscribe_ok/rebalance reply into the worker's
+        session: generation, assigned keys, and fetch cursors for newly
+        assigned keys, which resume from the group's committed floor as
+        far as this member knows it (at-least-once — re-reads across a
+        rebalance are consumer-group semantics, not anomalies)."""
+        member = int(op["process"])
+        keys = sorted(k for k, m2 in body["assign"].items()
+                      if m2 == member)
+        sub = self._subs.setdefault(
+            member, {"cursors": {}, "known_commit": {}, "rr": 0})
+        old = set(sub.get("keys") or ())
+        sub["group"] = member % self.G
+        sub["gen"] = int(body["gen"])
+        sub["keys"] = keys
+        for k in keys:
+            if k not in old or k not in sub["cursors"]:
+                sub["cursors"][k] = sub["known_commit"].get(k, -1) + 1
+        return keys
+
+    def host_state(self):
+        # both modes keep host-side session state the history depends
+        # on: resumed runs must replay it (tpu_runner checkpoints this)
+        st = {"polled": dict(self._host_polled)}
+        if self.G:
+            st["subs"] = {m: {**s, "cursors": dict(s["cursors"]),
+                              "known_commit": dict(s["known_commit"]),
+                              "keys": list(s.get("keys") or ())}
+                          for m, s in self._subs.items()}
+        return st
+
+    def set_host_state(self, st):
+        if not st:
+            return
+        self._host_polled = dict(st.get("polled") or {})
+        if self.G:
+            self._subs = {m: dict(s)
+                          for m, s in (st.get("subs") or {}).items()}
+
+    def _learn_commits(self, member: int, offsets: dict):
+        sub = self._subs.get(member)
+        if sub is not None:
+            for k, o in offsets.items():
+                ik = int(k)
+                sub["known_commit"][ik] = max(
+                    sub["known_commit"].get(ik, -1), int(o))
+
     def completion(self, op, body, read_state, intern):
         import numpy as np
+        if body["type"] == "subscribe_ok":
+            keys = self._apply_assignment(op, body)
+            if op["f"] == "subscribe":
+                return {**op, "type": "ok",
+                        "value": {"gen": body["gen"], "assigned": keys}}
+            # auto-subscribe on behalf of a poll/commit: the op itself
+            # consumed/claimed nothing (an empty observation)
+            return {**op, "type": "ok", "value": {}}
+        if body["type"] == "rebalance":
+            # fenced commit: it definitely did NOT apply; the reply
+            # carries the new generation + assignment, so the session
+            # rejoins and the next ops run in the new generation
+            self._apply_assignment(op, body)
+            return {**op, "type": "fail",
+                    "error": ["rebalanced", int(body["gen"])]}
+        if body["type"] == "fetch_ok":
+            member = int(op["process"])
+            k, start, n = body["key"], max(int(body["start"]), 0), \
+                int(body["n"])
+            pairs = []
+            if n:
+                # reply-round entry count over the append-only log: the
+                # end-of-stretch state read is exact (state_reads_final)
+                row = read_state()
+                log = np.asarray(row["log"])
+                pairs = [[o, intern.value(int(log[k, o]))]
+                         for o in range(start, start + n)]
+                sub = self._subs.get(member)
+                if sub is not None:
+                    sub["cursors"][k] = max(
+                        int(sub["cursors"].get(k, 0)), start + n)
+            return {**op, "type": "ok", "value": {str(k): pairs}}
+        if body["type"] == "commit_group_ok":
+            member = int(op["process"])
+            offs = {str(k): int(v)
+                    for k, v in body.get("offsets", {}).items()}
+            self._learn_commits(member, offs)
+            return {**op, "type": "ok",
+                    "value": {"group": member % self.G,
+                              "offsets": offs}}
+        if body["type"] == "list_group_ok":
+            member = int(op["process"])
+            offs = {str(k): int(v)
+                    for k, v in body.get("offsets", {}).items()}
+            self._learn_commits(member, offs)
+            return {**op, "type": "ok",
+                    "value": {"group": member % self.G,
+                              "offsets": offs}}
         if body["type"] == "send_ok":
             k, m = op["value"]
             return {**op, "type": "ok",

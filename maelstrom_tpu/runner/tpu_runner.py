@@ -64,6 +64,30 @@ def _wants_analysis(checker) -> bool:
     return False
 
 
+def _stream_observers(checker, test) -> dict:
+    """{name: observer} from every checker in the tree that registers
+    an incremental stream observer (doc/streams.md) — the pipeline
+    feeds them completed pairs and closes a grading window per drained
+    segment."""
+    out: dict = {}
+
+    def walk(c):
+        if c is None:
+            return
+        mk = getattr(c, "make_stream_observer", None)
+        if mk is not None:
+            ob = mk(test)
+            if ob is not None:
+                out[getattr(c, "name", type(c).__name__)] = ob
+        subs = getattr(c, "checkers", None)
+        if isinstance(subs, dict):
+            for sub in subs.values():
+                walk(sub)
+
+    walk(checker)
+    return out
+
+
 
 
 class TpuCombinedNemesis(NemesisDecisions):
@@ -140,6 +164,19 @@ class TpuCombinedNemesis(NemesisDecisions):
         if f == "stop-duplicate":
             r._net_surgery(lambda net: T.set_duplication(net, 0.0))
             return {**op, "type": "info", "value": "duplicate off"}
+        if f == "start-weather":
+            name, p, scale = self.next_weather()
+            r._net_surgery(lambda net: T.set_weather(net, p, scale))
+            return {**op, "type": "info",
+                    "value": f"weather {name} p_loss={p} scale={scale}"}
+        if f == "stop-weather":
+            # restore the run's CONFIGURED baseline (--p-loss /
+            # --latency-scale), not hardcoded zeros: the final heal must
+            # hand the checkers exactly the network the test asked for
+            base_p = float(r.test.get("p_loss") or 0.0)
+            base_s = float(r.test.get("latency_scale") or 1.0)
+            r._net_surgery(lambda net: T.set_weather(net, base_p, base_s))
+            return {**op, "type": "info", "value": "weather cleared"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
 
@@ -306,14 +343,24 @@ class TpuRunner:
             partition_groups=n if "partition" in faults else 1,
             enable_stall=bool({"kill", "pause"} & faults),
             enable_duplication="duplicate" in faults)
+        # continuous generator mode (doc/streams.md): client ops are
+        # pre-scheduled onto their offered-rate rounds and injected
+        # INSIDE the compiled scan window (the open-world stream), so
+        # traffic lands while nemesis faults are live mid-window and a
+        # whole offered-rate stretch costs one dispatch instead of one
+        # per op. Same-seed runs are byte-identical, plain and --mesh.
+        self.continuous = bool(test.get("continuous"))
         # per-message journal rows: on by default for small clusters, where
         # Lamport diagrams are readable and the per-round device pull is
         # cheap; large runs keep only the on-device counters. Tracking is
         # keyed off the config (not an attached journal object) so
         # assigning `runner.journal` after construction still pairs
         # exactly (the net's journal is only snapshotted here, not
-        # re-read later).
-        self.journal_rows = bool(test.get("journal_rows", n <= 64))
+        # re-read later). Continuous mode keeps only the counters: the
+        # journaled scan variant is a per-round debugging aid and the
+        # whole point of the stream window is to not stop per round.
+        self.journal_rows = bool(test.get("journal_rows", n <= 64)) \
+            and not self.continuous
         self.journal = (getattr(test.get("net"), "journal", None)
                         if self.journal_rows else None)
         # dealias: the runner's compiled dispatches donate their sim
@@ -378,8 +425,10 @@ class TpuRunner:
                      self.mesh.size)
         self._scan_fn = None         # built lazily
         self._scan_journal_fn = None  # journaled variant (io-collecting)
+        self._cscan_fn = None        # continuous variant (sched inject)
         self._pack_buf = None         # single-array packers (remote
         self._pack_replies = None     # backends pay a RT per array)
+        self._pack_creplies = None    # continuous drain (replies + mids)
         self._quiet_fn = None
         self.max_scan = int(test.get("max_scan", 65536))
         self.journal_scan_cap = int(test.get("journal_scan_cap", 256))
@@ -399,6 +448,22 @@ class TpuRunner:
             # inside the scan, so crossing reply-bearing stretches can
             # no longer skew completion values
             or getattr(self.program, "reply_payload_words", 0) > 0)
+        if self.continuous and not self.collect_replies:
+            # the stream window crosses reply-bearing stretches by
+            # construction; a program whose completions read mutable
+            # end-of-stretch state would complete with wrong values
+            raise ValueError(
+                f"--continuous: program {self.program.name!r} cannot "
+                f"cross reply-bearing stretches (needs_state_reads "
+                f"without state_reads_final or a reply payload); run it "
+                f"round-synchronous")
+        # stream stride (doc/streams.md): the continuous window length
+        # in rounds. Windows cross replies; the stride bounds how long a
+        # freed worker waits before the generator is polled again (and
+        # with it the emission delay of a backlogged offered op)
+        self.continuous_stride = max(1, int(
+            float(test.get("continuous_window_ms", 250.0))
+            / self.ms_per_round))
         self.intern = Intern()
         self.timeout_rounds = max(
             int(float(test.get("timeout_ms", 5000)) / self.ms_per_round), 10)
@@ -483,9 +548,14 @@ class TpuRunner:
                        track_edge_send_round=self.journal_rows)
         if donation_enabled():
             sim = dealias(sim)
-        if self.test.get("p_loss"):
+        # mirror core.build_test's host-net install exactly (same keys,
+        # same gating): --p-loss/--latency-scale runs are path-equivalent
+        if self.test.get("p_loss") is not None:
             sim = sim.replace(
                 net=T.flaky(sim.net, float(self.test["p_loss"])))
+        if self.test.get("latency_scale") is not None:
+            sim = sim.replace(net=T.set_latency_scale(
+                sim.net, float(self.test["latency_scale"])))
         return sim
 
     @staticmethod
@@ -654,6 +724,11 @@ class TpuRunner:
             "intern": self.intern,
             "nemesis_rng": (self.nemesis.rng_state()
                             if self.nemesis else None),
+            # continuous-mode carry (None on the round-synchronous path)
+            "carry": getattr(self, "_carry_live", None),
+            # program host-side session state (kafka consumer sessions,
+            # polled-offset tracking): the op stream depends on it
+            "program_host": self.program.host_state(),
         }
         state = {
             "fingerprint": cp.fingerprint(self.test),
@@ -751,6 +826,7 @@ class TpuRunner:
             self.intern = resume["intern"]
             if nemesis and resume.get("nemesis_rng") is not None:
                 nemesis.set_rng_state(resume["nemesis_rng"])
+            self.program.set_host_state(resume.get("program_host"))
             log.info("resumed at virtual round %d (%d history ops, "
                      "%d in flight)", r, len(history), len(pending))
             if self.journal is not None:
@@ -763,7 +839,11 @@ class TpuRunner:
         if not self.no_overlap and self.check_workers > 0 \
                 and _wants_analysis(test.get("checker")):
             from ..checkers.pipeline import AnalysisPipeline
-            self.pipeline = AnalysisPipeline(workers=self.check_workers)
+            self.pipeline = AnalysisPipeline(
+                workers=self.check_workers,
+                observers=_stream_observers(test.get("checker"), test),
+                ns_per_round=self.ms_per_round * 1e6,
+                head_round=lambda: getattr(self, "_r_live", 0))
         self._fed_upto = 0
         if resume is not None and self.pipeline is not None and \
                 len(history) > 0:
@@ -777,6 +857,10 @@ class TpuRunner:
             # blocks never double-count another cluster's history.
             self.pipeline.seed_resumed(history, len(history))
             self._fed_upto = len(history)
+        # continuous-mode carry: ops already drawn from the generator
+        # but not yet injected at checkpoint time (the schedule cannot
+        # be re-drawn — generators share mutable RNGs across states)
+        self._resume_carry = resume.get("carry") if resume else None
         # host mirror of the device message-id counter (refreshed by
         # every dispatch's combined fetch)
         self._init_next_mid()
@@ -821,7 +905,9 @@ class TpuRunner:
                 except (ValueError, OSError):   # pragma: no cover
                     pass
         try:
-            r = self._drive(self._loop_steps(**st))
+            steps = (self._loop_steps_continuous(**st) if self.continuous
+                     else self._loop_steps(**st))
+            r = self._drive(steps)
         except BaseException:
             # don't leak the analysis worker (and its history refs) on
             # generator/client errors or KeyboardInterrupt; land (or
@@ -884,6 +970,8 @@ class TpuRunner:
             kind = req[0]
             if kind == "scan":
                 resp = self._exec_scan(*req[1:])
+            elif kind == "cscan":
+                resp = self._exec_cscan(*req[1:])
             elif kind == "bump":
                 self.sim = self._bump(self.sim, jnp.int32(req[1]))
                 resp = None
@@ -1029,34 +1117,9 @@ class TpuRunner:
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
-            for stamp, t_, a_, b_, c_, rt, payload in replies:
-                entry = pending.pop(rt, None)
-                if entry is None:
-                    continue        # stale reply (client.clj:167-168)
-                process, op, node_idx, _dl = entry
-                body = program.decode_body(t_, a_, b_, c_, self.intern)
-                if body.get("type") == "error":
-                    err = ERROR_REGISTRY.get(body.get("code"))
-                    definite = err.definite if err else False
-                    completed = {**op,
-                                 "type": "fail" if definite else "info",
-                                 "error": [err.name if err
-                                           else body.get("code"),
-                                           body.get("text")]}
-                elif payload is not None:
-                    # state snapshotted at the reply round, on device —
-                    # no host<->device round trip per completion
-                    completed = program.completion_payload(
-                        op, body, payload, self.intern)
-                else:
-                    completed = program.completion(
-                        op, body, lambda i2=node_idx: self._read_state(i2),
-                        self.intern)
-                cctx = {"time": self._time_ns(stamp),
-                        "free": self._free_rotated(free, history),
-                        "processes": processes}
-                gen = self._complete(history, gen, cctx, process,
-                                     completed, free)
+            for rep in replies:
+                gen = self._apply_reply(program, gen, history, pending,
+                                        free, processes, rep)
 
             # timeouts -> indefinite :info (client.clj:214-233)
             expired = [m for m, (_, _, _, dl) in pending.items() if dl <= r]
@@ -1071,6 +1134,299 @@ class TpuRunner:
                 next_ckpt = r + self.checkpoint_every_rounds
 
         self._gen_live, self._r_live = gen, r
+        return r
+
+    def _apply_reply(self, program, gen, history, pending, free,
+                     processes, rep):
+        """Decodes one drained reply row — (round_stamp, type, a, b, c,
+        reply_to, payload-or-None) — and folds its completion into the
+        history and generator state. Returns the rebound generator.
+        Shared by the round-synchronous and continuous loops."""
+        stamp, t_, a_, b_, c_, rt, payload = rep
+        entry = pending.pop(rt, None)
+        if entry is None:
+            return gen              # stale reply (client.clj:167-168)
+        process, op, node_idx, _dl = entry
+        body = program.decode_body(t_, a_, b_, c_, self.intern)
+        if body.get("type") == "error":
+            err = ERROR_REGISTRY.get(body.get("code"))
+            definite = err.definite if err else False
+            completed = {**op,
+                         "type": "fail" if definite else "info",
+                         "error": [err.name if err
+                                   else body.get("code"),
+                                   body.get("text")]}
+        elif payload is not None:
+            # state snapshotted at the reply round, on device —
+            # no host<->device round trip per completion
+            completed = program.completion_payload(
+                op, body, payload, self.intern)
+        else:
+            completed = program.completion(
+                op, body, lambda i2=node_idx: self._read_state(i2),
+                self.intern)
+        cctx = {"time": self._time_ns(stamp),
+                "free": self._free_rotated(free, history),
+                "processes": processes}
+        return self._complete(history, gen, cctx, process, completed,
+                              free)
+
+    # --- continuous mode (doc/streams.md) ---
+
+    def _run_nemesis_op(self, gen, nemesis, nop, history, free,
+                        processes, r):
+        """Executes one nemesis op at the current round. Host-side fault
+        surgery is a window boundary in continuous mode: the scan cannot
+        rewrite its own masks mid-flight, so the loop stops exactly at
+        the fault's round, applies it, and opens the next window with
+        the fault live."""
+        ctx = {"time": self._time_ns(r),
+               "free": self._free_rotated(free, history),
+               "processes": processes}
+        process = nop["process"]
+        self._dispatches += 1
+        free.discard(process)
+        op = {k: v for k, v in nop.items() if k != "time"}
+        history.append_row("invoke", op.get("f"), op.get("value"),
+                           process, self._time_ns(r),
+                           final=op.get("final", False))
+        completed = nemesis.invoke(op)
+        self._reshard()
+        return self._complete(history, gen, ctx, process, completed,
+                              free)
+
+    def _encode_events(self, evs, carry_sched, carry_host, history, gen,
+                       free, processes):
+        """Encodes freshly pre-scheduled client ops into carry_sched
+        rows (round, process, op, node_idx, t, a, b, c). HOST-routed ops
+        become window boundaries (completed from device state at their
+        round); encode-capacity failures complete as definite fails on
+        the spot, like the round-synchronous path."""
+        N = self.cfg.n_nodes
+        program = self.program
+        for rd, res in evs:
+            op = {k: v for k, v in res.items() if k != "time"}
+            process = res["process"]
+            routed = program.node_for_op(op)
+            if routed is None:
+                node_idx = process % N
+            else:
+                node_idx = int(routed)
+                if not 0 <= node_idx < N:
+                    raise ValueError(
+                        f"{program.name}.node_for_op returned {routed} "
+                        f"for a {N}-node cluster")
+            body = program.request_for_op(op)
+            if body is HOST:
+                carry_host.append((rd, process, op, node_idx))
+                continue
+            try:
+                t, a, b, c = program.encode_body(body, self.intern)
+            except EncodeCapacityError as e:
+                ctx = {"time": self._time_ns(rd),
+                       "free": self._free_rotated(free, history),
+                       "processes": processes}
+                history.append_row("invoke", op.get("f"),
+                                   op.get("value"), process,
+                                   self._time_ns(rd),
+                                   final=op.get("final", False))
+                completed = {**op, "type": "fail",
+                             "error": ["encode-error", str(e)]}
+                gen = self._complete(history, gen, ctx, process,
+                                     completed, free)
+                continue
+            carry_sched.append((rd, process, op, node_idx, t, a, b, c))
+        return gen
+
+    def _loop_steps_continuous(self, test, cfg, program, gen, nemesis,
+                               processes, free, pending, history,
+                               max_rounds, next_ckpt, r):
+        """The continuous-mode dispatch loop (doc/streams.md).
+
+        Instead of stopping the device at every generator event, the
+        host PRE-SCHEDULES the next stretch of client ops onto their
+        offered-rate rounds (`generators.schedule_ahead`) and one
+        sched-inject scan lands them INSIDE the compiled window — client
+        traffic arrives while whatever faults the nemesis installed at
+        the boundary are live mid-window. Nemesis surgery, HOST-routed
+        completions, and checkpoints remain window boundaries. Yields
+        the `_loop_steps` request kinds plus
+        ``("cscan", rows, k_max, stop, history, r) ->
+        (k_executed, replies, inj_mids)``.
+
+        Determinism contract: scheduling consumes only generator state
+        and the (deterministic) reply timing of previous windows, so a
+        seed fixes the whole history byte-for-byte — plain and --mesh
+        (pinned by tests/test_continuous.py). Rows a window did not
+        reach (early stop on a reply or ring capacity) carry with their
+        rounds intact; an op enters the history only once its injection
+        is confirmed by the drain's `inj_mids`."""
+        N, C = cfg.n_nodes, self.concurrency
+        ns_pr = self.ms_per_round * 1e6
+        rc = getattr(self, "_resume_carry", None) or {}
+        self._resume_carry = None
+        carry_sched: list = list(rc.get("sched") or [])
+        carry_nem = rc.get("nem")
+        carry_host: list = list(rc.get("host") or [])
+        exhausted = False
+        while r < max_rounds:
+            self._gen_live, self._r_live = gen, r
+            self._carry_live = {"sched": carry_sched, "nem": carry_nem,
+                                "host": carry_host}
+            # stretch boundary: the previous window has landed and its
+            # replies are folded in — the graceful SIGTERM spot
+            self._check_preempted(gen, history, pending, free, r)
+
+            # host-boundary work due now
+            while carry_nem is not None and carry_nem[0] <= r:
+                nop = carry_nem[1]
+                carry_nem = None
+                gen = self._run_nemesis_op(gen, nemesis, nop, history,
+                                           free, processes, r)
+            while carry_host and carry_host[0][0] <= r:
+                _rd, process, op, node_idx = carry_host.pop(0)
+                ctx = {"time": self._time_ns(r),
+                       "free": self._free_rotated(free, history),
+                       "processes": processes}
+                history.append_row("invoke", op.get("f"),
+                                   op.get("value"), process,
+                                   self._time_ns(r),
+                                   final=op.get("final", False))
+                completed = program.host_op(
+                    op, lambda i=node_idx: self._read_state(i),
+                    self.intern)
+                gen = self._complete(history, gen, ctx, process,
+                                     completed, free)
+
+            def horizon():
+                h = r + self.max_scan
+                if next_ckpt is not None:
+                    h = min(h, next_ckpt)
+                h = min(h, max_rounds)
+                if carry_nem is not None:
+                    h = min(h, carry_nem[0])
+                if carry_host:
+                    h = min(h, carry_host[0][0])
+                return max(h, r + 1)
+
+            # pre-schedule the window; nemesis ops due NOW execute
+            # immediately (fault surgery before the dispatch) and
+            # scheduling resumes with the masks installed
+            while True:
+                gen, evs, nem, _end, end_kind = g.schedule_ahead(
+                    gen, processes, free, r, horizon(), ns_pr,
+                    self._dispatches)
+                self._dispatches += len(evs)
+                for _rd, res in evs:
+                    free.discard(res["process"])
+                gen = self._encode_events(evs, carry_sched, carry_host,
+                                          history, gen, free, processes)
+                if nem is not None and nem[0] <= r:
+                    gen = self._run_nemesis_op(gen, nemesis, nem[1],
+                                               history, free, processes,
+                                               r)
+                    continue
+                if nem is not None:
+                    carry_nem = nem
+                break
+            exhausted = end_kind == "exhausted"
+            # stable by round: carried rows precede same-round new ones
+            carry_sched.sort(key=lambda rw: rw[0])
+            self._carry_live = {"sched": carry_sched, "nem": carry_nem,
+                                "host": carry_host}
+
+            if exhausted and not pending and not carry_sched \
+                    and carry_nem is None and not carry_host \
+                    and free == set(processes):
+                break
+
+            # fast-forward quiescent gaps before the first due row (same
+            # discipline as the round-synchronous loop)
+            first_due = carry_sched[0][0] if carry_sched else None
+            h = horizon()
+            if not pending and (first_due is None or first_due > r) \
+                    and (yield ("quiet",)):
+                target = h if first_due is None else min(first_due, h)
+                k = max(target - r, 1)
+                yield ("bump", k)
+                r += k
+                if next_ckpt is not None and r >= next_ckpt:
+                    self._save_checkpoint(gen, history, pending, free, r)
+                    next_ckpt = r + self.checkpoint_every_rounds
+                continue
+
+            # one window: bounded by the stream stride, the horizon,
+            # and every timeout deadline (already-pending plus this
+            # window's scheduled injections). The window CROSSES replies
+            # (stop_on_reply False): completions fold in at the window
+            # close, so one dispatch carries a whole offered-rate
+            # stretch — the stride bounds how stale a freed worker can
+            # get before the generator is polled again.
+            k_abs = min(h, r + self.continuous_stride)
+            if pending:
+                k_abs = min(k_abs, min(v[3] for v in pending.values()))
+            for rw in carry_sched:
+                k_abs = min(k_abs, rw[0] + self.timeout_rounds)
+            k_max = max(k_abs - r, 1)
+            k, replies, inj_mids = yield ("cscan", carry_sched, k_max,
+                                          False, history, r)
+
+            injected = [(j, rw) for j, rw in enumerate(carry_sched)
+                        if rw[0] - r < k]
+            carry_sched = [rw for rw in carry_sched if rw[0] - r >= k]
+            # merge confirmed injections and replies in time order
+            # (completions first at equal rounds, like the synchronous
+            # loop's boundary behavior); an injection's own reply is
+            # always stamped after its round, so pending registration
+            # precedes it
+            events = [(rw[0], 1, j, rw) for j, rw in injected]
+            events += [(int(rep[0]), 0, i, rep)
+                       for i, rep in enumerate(replies)]
+            events.sort(key=lambda e: (e[0], e[1], e[2]))
+            r += k
+            for rd, kind, seq, item in events:
+                if kind == 1:
+                    _rd0, process, op, node_idx = item[:4]
+                    mid = int(inj_mids[seq])
+                    if mid < 0:     # pragma: no cover - device contract
+                        raise RuntimeError(
+                            f"continuous scan executed {k} rounds but "
+                            f"reported no mid for row {seq} at round "
+                            f"{rd}")
+                    history.append_row("invoke", op.get("f"),
+                                       op.get("value"), process,
+                                       self._time_ns(rd),
+                                       final=op.get("final", False))
+                    pending[mid] = (process, op, node_idx,
+                                    rd + self.timeout_rounds)
+                else:
+                    gen = self._apply_reply(program, gen, history,
+                                            pending, free, processes,
+                                            item)
+
+            # timeouts -> indefinite :info (client.clj:214-233)
+            ctx = {"time": self._time_ns(r),
+                   "free": self._free_rotated(free, history),
+                   "processes": processes}
+            expired = [m for m, (_, _, _, dl) in pending.items()
+                       if dl <= r]
+            for m in expired:
+                process, op, _ni, _dl = pending.pop(m)
+                completed = {**op, "type": "info",
+                             "error": "net-timeout"}
+                gen = self._complete(history, gen, ctx, process,
+                                     completed, free)
+
+            if next_ckpt is not None and r >= next_ckpt:
+                self._carry_live = {"sched": carry_sched,
+                                    "nem": carry_nem,
+                                    "host": carry_host}
+                self._save_checkpoint(gen, history, pending, free, r)
+                next_ckpt = r + self.checkpoint_every_rounds
+
+        self._gen_live, self._r_live = gen, r
+        self._carry_live = {"sched": carry_sched, "nem": carry_nem,
+                            "host": carry_host}
         return r
 
     def _encode_inject(self, inject_rows) -> "T.Msgs":
@@ -1171,6 +1527,53 @@ class TpuRunner:
             k, self._next_mid = int(k), int(self._next_mid)
             rn = int(rn)
         return k, self._decode_replies(rlog, rounds, plog, rn)
+
+    def _exec_cscan(self, rows, k_max, stop, history, r):
+        """One continuous-mode dispatch: encode the scheduled rows as a
+        [Q] inject batch with per-row round offsets (relative to r), run
+        the sched-inject scan, and drain replies + per-row assigned mids
+        as ONE packed fetch. Returns (k_executed, replies, inj_mids);
+        inj_mids[j] is -1 for rows the window did not reach."""
+        C = self.concurrency
+        program, cfg = self.program, self.cfg
+        N, Q = cfg.n_nodes, max(self.concurrency, 1)
+        M = len(rows)
+        if M > Q:       # pragma: no cover - workers bound the schedule
+            raise RuntimeError(f"{M} scheduled rows exceed the {Q}-row "
+                              f"inject batch")
+        inject = T.Msgs.empty(Q)
+        at = np.full(Q, -1, np.int32)
+        if M:
+            at[:M] = [rw[0] - r for rw in rows]
+            pad = [0] * (Q - M)
+            inject = inject.replace(
+                valid=jnp.arange(Q) < M,
+                src=jnp.asarray([rw[1] + N for rw in rows] + pad, T.I32),
+                dest=jnp.asarray([rw[3] for rw in rows] + pad, T.I32),
+                type=jnp.asarray([rw[4] for rw in rows] + pad, T.I32),
+                a=jnp.asarray([rw[5] for rw in rows] + pad, T.I32),
+                b=jnp.asarray([rw[6] for rw in rows] + pad, T.I32),
+                c=jnp.asarray([rw[7] for rw in rows] + pad, T.I32))
+        if self._cscan_fn is None:
+            from ..sim import make_scan_fn
+            self._cscan_fn = make_scan_fn(
+                program, cfg, reply_cap=self.reply_log_cap, donate=True,
+                shardings=self._shardings, sched_inject=True)
+        self.sim, _cm, k, rl, im = self._cscan_fn(
+            self.sim, inject, jnp.asarray(at), jnp.int32(k_max), stop)
+        self._state_cache = None
+        # window N+1 is in flight: overlap segment N's analysis
+        self._overlap_feed(history)
+        if self._pack_creplies is None:
+            self._pack_creplies = self._make_packer(
+                (rl, im, k, self.sim.net.next_mid))
+        pack, unpack = self._pack_creplies
+        packed = pack((rl, im, k, self.sim.net.next_mid))
+        flat = self.transfer.fetch(packed)
+        (rlog, rounds, plog, rn), im, k, self._next_mid = unpack(flat)
+        k, self._next_mid = int(k), int(self._next_mid)
+        return (k, self._decode_replies(rlog, rounds, plog, int(rn)),
+                im)
 
     def _decode_replies(self, rlog, rounds, plog, rn: int) -> list:
         """Materializes the drained reply-log rows as plain tuples for
@@ -1279,6 +1682,12 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     cluster instances inside one compiled scan, each checked and stored
     per cluster."""
     if int(test.get("fleet") or 1) > 1:
+        if test.get("continuous"):
+            raise ValueError(
+                "--continuous with --fleet is not supported yet: the "
+                "fleet driver coalesces round-synchronous scan requests "
+                "(run the continuous campaign as separate processes, or "
+                "drop --continuous)")
         from .fleet_runner import run_fleet_test
         return run_fleet_test(test, test_dir)
     runner = TpuRunner(test)

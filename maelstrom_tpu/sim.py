@@ -321,10 +321,23 @@ def make_round_fn(program, cfg: NetConfig, donate: bool = False,
 
 
 def _build_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
-                   reply_cap: int | None = None):
+                   reply_cap: int | None = None,
+                   sched_inject: bool = False):
     """The un-jitted scan-ahead body shared by `make_scan_fn` (which jits
     it directly) and `make_fleet_scan_fn` (which vmaps it over a leading
     cluster axis first). Returns (scan_fn, n_outs).
+
+    `sched_inject` (continuous mode, doc/streams.md) changes the inject
+    contract: scan_fn(sim, inject, at_rounds, k_max, stop_on_reply)
+    takes a [Q] Msgs batch plus an i32 [Q] vector of ROUND OFFSETS
+    relative to the window start, and each scanned round i injects
+    exactly the rows with at_rounds == i — client ops land at their
+    scheduled rounds INSIDE the compiled window, while faults installed
+    before the dispatch are live. An extra `inj_mids` i32 [Q] output
+    reports the message id each row was assigned (-1 = not injected,
+    e.g. the loop exited before the row's round): mids of mid-window
+    injections depend on how many replies preceded them, so the host
+    learns them from the drain instead of predicting.
 
     The scan runs up to k_max injection-free rounds in ONE
     dispatch (lax.while_loop). The interactive runner uses this to cross
@@ -395,30 +408,54 @@ def _build_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
         return rlog, rounds, plog, rn + jnp.sum(cm.valid.astype(I32))
 
     def cond(st):
-        _sim, cm, k, k_max, stop, _buf, _rlog, _rounds, _plog, rn = st
+        _sim, cm, k, k_max, stop, _buf, _rlog, _rounds, _plog, rn, _im = st
         go = k < k_max
         go = go & ~(stop & cm.valid.any())
         if rcap_req is not None:
             go = go & (rn + cw <= rcap)
         return go
 
-    def body(st):
-        sim, _cm, k, k_max, stop, buf, rlog, rounds, plog, rn = st
-        sim2, cm2, io = _round(program, cfg, sim, empty)
-        if cap is not None:
-            buf = jax.tree.map(lambda b, x: b.at[k].set(x), buf, io)
-        if rcap is not None:
-            # stamp with the post-round counter: the host processes a
-            # reply at the round after its producing dispatch, and the
-            # replay must use identical times
-            rlog, rounds, plog, rn = append_replies(
-                rlog, rounds, plog, rn, cm2, sim2.nodes, sim2.net.round)
-        return (sim2, cm2, k + jnp.int32(1), k_max, stop, buf, rlog,
-                rounds, plog, rn)
+    def _mk_body(inject, at_rounds):
+        def body(st):
+            sim, _cm, k, k_max, stop, buf, rlog, rounds, plog, rn, im = st
+            if sched_inject:
+                # continuous mode: this round's injections are the rows
+                # scheduled exactly at offset k
+                inj = inject.replace(
+                    valid=inject.valid & (at_rounds == k))
+            else:
+                inj = empty
+            sim2, cm2, io = _round(program, cfg, sim, inj)
+            if sched_inject:
+                sent = io[0]        # id-stamped inject view of this round
+                im = jnp.where(sent.valid, sent.mid, im)
+            if cap is not None:
+                buf = jax.tree.map(lambda b, x: b.at[k].set(x), buf, io)
+            if rcap is not None:
+                # stamp with the post-round counter: the host processes a
+                # reply at the round after its producing dispatch, and the
+                # replay must use identical times
+                rlog, rounds, plog, rn = append_replies(
+                    rlog, rounds, plog, rn, cm2, sim2.nodes,
+                    sim2.net.round)
+            return (sim2, cm2, k + jnp.int32(1), k_max, stop, buf, rlog,
+                    rounds, plog, rn, im)
+        return body
 
-    def scan_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply=True):
+    def _scan(sim: SimState, inject: Msgs, at_rounds, k_max,
+              stop_on_reply):
         nonlocal rcap, cw
-        sim1, cm1, io1 = _round(program, cfg, sim, inject)
+        if sched_inject:
+            inj0 = inject.replace(valid=inject.valid & (at_rounds == 0))
+        else:
+            inj0 = inject
+        sim1, cm1, io1 = _round(program, cfg, sim, inj0)
+        if sched_inject:
+            sent0 = io1[0]
+            im = jnp.where(sent0.valid, sent0.mid,
+                           jnp.full_like(at_rounds, -1))
+        else:
+            im = jnp.zeros(0, I32)
         k_max = jnp.int32(k_max)
         stop = jnp.asarray(stop_on_reply, bool)
         if cap is None:
@@ -441,30 +478,48 @@ def _build_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
                 rlog, rounds, plog, jnp.int32(0), cm1, sim1.nodes,
                 sim1.net.round)
         st = (sim1, cm1, jnp.int32(1), k_max, stop, buf, rlog, rounds,
-              plog, rn)
-        sim2, cm, k, _, _, buf, rlog, rounds, plog, rn = \
-            jax.lax.while_loop(cond, body, st)
+              plog, rn, im)
+        sim2, cm, k, _, _, buf, rlog, rounds, plog, rn, im = \
+            jax.lax.while_loop(cond, _mk_body(inject, at_rounds), st)
         out = (sim2, cm, k)
         if rcap is not None:
             out = out + ((rlog, rounds, plog, rn),)
+        if sched_inject:
+            out = out + (im,)
         if cap is not None:
             out = out + (buf,)
         return out
 
-    n_outs = 3 + (rcap_req is not None) + (cap is not None)
+    if sched_inject:
+        def scan_fn(sim: SimState, inject: Msgs, at_rounds, k_max,
+                    stop_on_reply=True):
+            return _scan(sim, inject, jnp.asarray(at_rounds, I32),
+                         k_max, stop_on_reply)
+    else:
+        def scan_fn(sim: SimState, inject: Msgs, k_max,
+                    stop_on_reply=True):
+            return _scan(sim, inject, None, k_max, stop_on_reply)
+
+    n_outs = (3 + (rcap_req is not None) + int(bool(sched_inject))
+              + (cap is not None))
     return scan_fn, n_outs
 
 
 def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
                  reply_cap: int | None = None, donate: bool = False,
-                 shardings=None):
+                 shardings=None, sched_inject: bool = False):
     """Jitted scan-ahead over one cluster (see `_build_scan_fn` for the
     full semantics). `donate=True` donates the SimState carry so the
     reply/io rings and the state tree are reused in place instead of
     reallocated every dispatch; `shardings` pins the input placement for
-    mesh (`--mesh`) execution (see `_jit_kwargs`)."""
-    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap)
-    return jax.jit(scan_fn, **_jit_kwargs(donate, shardings, 4, n_outs))
+    mesh (`--mesh`) execution (see `_jit_kwargs`); `sched_inject=True`
+    builds the continuous-mode variant (per-row round offsets, an
+    `inj_mids` drain output)."""
+    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap,
+                                     sched_inject)
+    n_args = 5 if sched_inject else 4
+    return jax.jit(scan_fn,
+                   **_jit_kwargs(donate, shardings, n_args, n_outs))
 
 
 def make_fleet_scan_fn(program, cfg: NetConfig,

@@ -75,6 +75,11 @@ class KafkaClient(BaseClient):
         return c
 
     def invoke(self, test, op):
+        if op["f"] == "subscribe":
+            raise RuntimeError(
+                "kafka consumer groups (--kafka-groups) are a TPU-path "
+                "protocol (--node tpu:kafka); the bin-path client "
+                "speaks the classic full-prefix workload only")
         key_names = [str(k) for k in range(self.keys)]
 
         def go():
@@ -129,10 +134,40 @@ class KafkaOpGen:
         return {"f": "list"}
 
 
+class KafkaStreamOpGen:
+    """Group-mode op source (doc/streams.md): explicit subscribes join
+    the worker's consumer group (first polls auto-subscribe too), polls
+    become cursor fetches over the member's assigned keys, commits
+    claim exactly what the member consumed (and double as the group
+    heartbeat), lists read the group's committed floors."""
+
+    def __init__(self, seed: int, keys: int = 4):
+        self.rng = random.Random(seed)
+        self.keys = keys
+        self.counter = 0
+
+    def __call__(self):
+        r = self.rng.random()
+        if r < 0.05:
+            return {"f": "subscribe"}
+        if r < 0.5:
+            self.counter += 1
+            k = self.counter % self.keys
+            return {"f": "send", "value": [k, self.counter]}
+        if r < 0.8:
+            return {"f": "poll"}
+        if r < 0.95:
+            return {"f": "commit"}
+        return {"f": "list"}
+
+
 def workload(opts: dict) -> dict:
     keys = int(opts.get("key_count") or 4)
+    groups = int(opts.get("kafka_groups") or 0)
+    op_gen = (KafkaStreamOpGen(opts.get("seed", 0), keys) if groups
+              else KafkaOpGen(opts.get("seed", 0), keys))
     return {
         "client": KafkaClient(opts["net"], keys=keys),
-        "generator": g.Fn(KafkaOpGen(opts.get("seed", 0), keys)),
+        "generator": g.Fn(op_gen),
         "checker": KafkaChecker(),
     }

@@ -37,6 +37,12 @@ step artifacts/bench-r5-broadcast.json 2400 python bench.py
 #     ISSUE 6 clusters/sec lever measured on real TPU hardware
 step artifacts/bench-fleet-r6.json 2400 env BENCH_MODE=fleet python bench.py
 
+# 1c. open-world stream bench (BENCH_MODE=stream): continuous-mode
+#     streaming kafka end to end — sustained msgs/sec + max checker lag
+#     at 1x/4x/16x offered rate (doc/streams.md). CPU fallback honest:
+#     host_cpus/devices ride the record
+step artifacts/bench-stream-r7.json 2400 env BENCH_MODE=stream python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

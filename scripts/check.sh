@@ -34,4 +34,21 @@ else
     echo "== ruff not installed: skipping (pip install -e .[dev]) =="
 fi
 
+# Continuous-mode smoke (doc/streams.md): streaming kafka under the
+# combined five-package soup — offered load injected INSIDE the
+# compiled windows while faults are live, graded incrementally; the
+# CLI exit code carries validity. STREAM_SMOKE=0 skips (the static
+# audit above stays the gate's core).
+if [ "${STREAM_SMOKE:-1}" = "1" ]; then
+    echo "== continuous-mode stream smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w kafka --node tpu:kafka \
+        --node-count 5 --continuous --kafka-groups 2 \
+        --rate 20 --time-limit 2 --seed 7 --no-audit \
+        --nemesis kill,pause,partition,duplicate,weather \
+        --nemesis-interval 0.7 --store "$SMOKE_STORE" > /dev/null
+    rm -rf "$SMOKE_STORE"
+    echo "== stream smoke valid =="
+fi
+
 echo "== static gate clean =="

@@ -1,0 +1,188 @@
+"""Continuous generator mode (doc/streams.md): ops injected at their
+seeded offered-rate rounds INSIDE the compiled scan window, while
+nemesis faults are live mid-window.
+
+Pinned contracts:
+  - same seed => byte-identical history (the whole open-world stream is
+    deterministic), including under the combined five-package soup;
+  - plain and --mesh runs are byte-identical (multichip);
+  - the windowed incremental kafka verdict is bit-equal to the post-hoc
+    whole-history checker, with the lag metric surfaced;
+  - windows actually batch: one dispatch carries many offered-rate
+    injections once replies take real latency;
+  - checkpoint/resume carries the scheduled-but-not-injected rows.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from maelstrom_tpu import core
+
+STORE = "/tmp/maelstrom-tpu-test-store"
+
+SOUP = {"kill", "pause", "partition", "duplicate", "weather"}
+
+
+def _run(seed=29, **kw):
+    opts = dict(store_root=STORE, seed=seed, workload="lin-kv",
+                node="tpu:lin-kv", node_count=5, rate=10.0,
+                time_limit=3.0, journal_rows=False, continuous=True,
+                recovery_s=1.5, timeout_ms=1000, nemesis=set(SOUP),
+                nemesis_interval=0.7)
+    opts.update(kw)
+    res = core.run(opts)
+    with open(f"{STORE}/latest/history.jsonl") as f:
+        return res, f.read()
+
+
+@pytest.mark.slow
+def test_continuous_soup_deterministic_and_valid():
+    r1, h1 = _run()
+    r2, h2 = _run()
+    assert r1["valid"] is True and r2["valid"] is True
+    assert h1 == h2                      # byte-identical histories
+    hist = [json.loads(line) for line in h1.splitlines()]
+    # the soup actually ran: every package started
+    nem_fs = {o["f"] for o in hist if o.get("process") == "nemesis"
+              and o["type"] == "info"}
+    for f in SOUP:
+        assert f"start-{f}" in nem_fs, nem_fs
+    # open-world property: client ops were INVOKED strictly inside a
+    # fault window (between a start op and its stop), not only at
+    # boundaries
+    starts = sorted(o["time"] for o in hist
+                    if o.get("f", "").startswith("start-")
+                    and o["type"] == "info")
+    stops = sorted(o["time"] for o in hist
+                   if o.get("f", "").startswith("stop-")
+                   and o["type"] == "info")
+    assert starts and stops
+    in_window = [o for o in hist if o["type"] == "invoke"
+                 and o.get("process") != "nemesis"
+                 and any(s < o["time"] < e for s, e in
+                         zip(starts, stops) if s < e)]
+    assert in_window, "no client op arrived mid-fault"
+
+
+@pytest.mark.slow
+def test_continuous_windows_batch_many_ops_per_dispatch():
+    """With real reply latency, one compiled window carries MANY
+    offered-rate injections: drains stay far below the op count (the
+    round-synchronous path pays >= 1 dispatch per op)."""
+    res, h = _run(seed=3, workload="echo", node="tpu:echo",
+                  nemesis=set(), rate=300.0, time_limit=1.0,
+                  concurrency=64, latency={"mean": 10,
+                                           "dist": "constant"},
+                  timeout_ms=5000)
+    assert res["valid"] is True
+    ops = res["stats"]["count"]
+    drains = res["net"]["drains"]
+    assert ops > 100, ops
+    assert drains < ops / 2, (drains, ops)
+
+
+@pytest.mark.multichip
+def test_continuous_mesh_bit_identical():
+    """Same-seed continuous runs are byte-identical single-chip and
+    sharded (--mesh 1,2) — sharding changes placement, never the
+    stream. The acceptance configuration: streaming kafka under the
+    full five-package soup (ISSUE 7)."""
+    _r1, h1 = _kafka_stream(seed=17, time_limit=2.0)
+    _r2, h2 = _kafka_stream(seed=17, time_limit=2.0, mesh="1,2")
+    assert h1 == h2
+
+
+def _kafka_stream(seed=7, **kw):
+    opts = dict(store_root=STORE, seed=seed, workload="kafka",
+                node="tpu:kafka", node_count=5, rate=20.0,
+                time_limit=3.0, journal_rows=False, kafka_groups=2,
+                continuous=True, recovery_s=1.5, timeout_ms=1000,
+                nemesis=set(SOUP), nemesis_interval=0.7)
+    opts.update(kw)
+    res = core.run(opts)
+    with open(f"{STORE}/latest/history.jsonl") as f:
+        return res, f.read()
+
+
+def test_continuous_kafka_windowed_verdict_equals_posthoc():
+    """The acceptance pin (ISSUE 7): continuous kafka under the full
+    soup — (a) byte-identical histories per seed, (b) the windowed
+    incremental verdict bit-equal to the post-hoc whole-history
+    checker, (c) the per-window lag metric surfaced and bounded."""
+    r1, h1 = _kafka_stream()
+    r2, h2 = _kafka_stream(no_overlap=True)   # post-hoc path
+    assert h1 == h2
+    w1 = dict(r1["workload"])
+    w2 = dict(r2["workload"])
+    windows = w1.pop("windows")
+    lag = w1.pop("checker-lag")
+    assert "windows" not in w2              # post-hoc has no windows
+    assert w1 == w2                         # verdict bit-equal
+    assert r1["valid"] is True
+    assert w1["acked-sends"] > 0
+    # rolling windows: every record carries a verdict + bounded lag
+    assert len(windows) == lag["windows"] > 1
+    assert all("verdict" in w for w in windows)
+    assert all(w["verdict"]["ok"] for w in windows)
+    max_scan_head = max(w["end-round"] for w in windows
+                        if w["end-round"] is not None)
+    assert 0 <= lag["max-lag-rounds"] <= max_scan_head
+    # the analysis-pipeline block reports the same window accounting
+    rep = r1["analysis-pipeline"]
+    assert rep["windows"] == len(windows)
+
+
+@pytest.mark.slow
+def test_continuous_checkpoint_resume_identical():
+    """A continuous run cut mid-stream and resumed from its checkpoint
+    completes with the SAME ops as an uninterrupted run — the carry
+    (ops drawn from the generator but not yet injected) rides the
+    checkpoint."""
+    from conftest import ops_projection as _ops
+
+    from maelstrom_tpu import checkpoint as cp
+    from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+    def build(sub, **over):
+        # the SAME cadence everywhere: continuous-mode op timing
+        # depends on window boundaries and checkpoints are boundaries
+        # (cadence is part of the continuous fingerprint —
+        # doc/streams.md; the round-synchronous path stays neutral)
+        opts = dict(workload="kafka", node="tpu:kafka", node_count=5,
+                    rate=20.0, time_limit=3.0, kafka_groups=2,
+                    continuous=True, journal_rows=False, seed=5,
+                    recovery_s=1.0, timeout_ms=1000,
+                    checkpoint_every=0.5,
+                    store_root=f"{STORE}-cont/{sub}")
+        opts.update(over)
+        test = core.build_test(opts)
+        test["store_dir"] = f"{STORE}-cont/{sub}"
+        import os
+        os.makedirs(test["store_dir"], exist_ok=True)
+        return test
+
+    hist_a = TpuRunner(build("a")).run()
+    assert len(hist_a) > 20
+
+    tb = build("b")
+    tb["max_rounds"] = 1200
+    TpuRunner(tb).run()
+
+    tc = build("b")
+    runner_c = TpuRunner(tc)
+    resume = cp.load(f"{STORE}-cont/b")
+    cp.check_fingerprint(resume, tc)
+    hist_c = runner_c.run(resume=resume)
+    assert _ops(hist_c) == _ops(hist_a)
+
+
+def test_continuous_rejections():
+    """Guard rails: --continuous composes with neither --fleet nor
+    programs whose completions read mutable end-of-stretch state."""
+    with pytest.raises(ValueError, match="fleet"):
+        core.run(dict(store_root=STORE, workload="echo",
+                      node="tpu:echo", node_count=4, fleet=2,
+                      continuous=True, time_limit=1.0))

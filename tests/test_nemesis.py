@@ -29,19 +29,21 @@ def test_package_empty_is_inert():
 
 
 def test_package_composes_all_fault_schedules():
-    pkg = nem.package({"kill", "pause", "partition", "duplicate"},
-                      interval_s=1.0)
-    assert pkg["faults"] == ("partition", "kill", "pause", "duplicate")
+    pkg = nem.package({"kill", "pause", "partition", "duplicate",
+                       "weather"}, interval_s=1.0)
+    assert pkg["faults"] == ("partition", "kill", "pause", "duplicate",
+                             "weather")
     ops = interpret(g.time_limit(4.2, pkg["generator"]),
                     processes=("w0",), max_time_s=8)
     fs = [o["f"] for o in ops]
     # every package starts AND stops within the window, interleaved
-    for f in ("partition", "kill", "pause", "duplicate"):
+    for f in ("partition", "kill", "pause", "duplicate", "weather"):
         assert f"start-{f}" in fs and f"stop-{f}" in fs, fs
     # final generator heals every package
     finals = interpret(pkg["final_generator"], processes=("w0",))
     assert [o["f"] for o in finals] == [
-        "stop-partition", "stop-kill", "stop-pause", "stop-duplicate"]
+        "stop-partition", "stop-kill", "stop-pause", "stop-duplicate",
+        "stop-weather"]
 
 
 # --- grudge shapes ----------------------------------------------------------
@@ -128,7 +130,8 @@ def test_nemesis_determinism_tpu_path(tmp_path):
     import json
 
     def run_once():
-        res = _tpu_test(29, {"kill", "pause", "partition", "duplicate"})
+        res = _tpu_test(29, {"kill", "pause", "partition", "duplicate",
+                             "weather"})
         assert res["valid"] is True
         with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
             return [json.loads(line) for line in f]
@@ -139,6 +142,7 @@ def test_nemesis_determinism_tpu_path(tmp_path):
                if o.get("process") == "nemesis" and o["type"] == "info"]
     assert any(f == "start-kill" for f, _, _ in nem_ops), nem_ops
     assert any(f == "start-partition" for f, _, _ in nem_ops), nem_ops
+    assert any(f == "start-weather" for f, _, _ in nem_ops), nem_ops
 
 
 def test_nemesis_determinism_host_path():
@@ -150,13 +154,14 @@ def test_nemesis_determinism_host_path():
     per-fault decision streams (`NemesisDecisions`) must not move."""
     import json
 
+    composed = {"kill", "pause", "partition", "duplicate"}
+
     def run_once():
         res = core.run(dict(
             store_root="/tmp/maelstrom-tpu-test-store", seed=31,
             workload="echo", bin="demo/python/echo.py", node_count=5,
             rate=10.0, time_limit=3.5,
-            nemesis={"kill", "pause", "partition", "duplicate"},
-            nemesis_interval=0.8))
+            nemesis=set(composed), nemesis_interval=0.8))
         assert res["valid"] is True
         with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
             hist = [json.loads(line) for line in f]
@@ -168,10 +173,10 @@ def test_nemesis_determinism_host_path():
                if o.get("process") == "nemesis" and o["type"] == "info"
                and o["f"].startswith("start-")]
         return {f: [x for x in seq if x[0] == f"start-{f}"]
-                for f in nem.FAULTS}
+                for f in composed}
 
     s1, s2 = run_once(), run_once()
-    for f in nem.FAULTS:
+    for f in composed:
         # wall-clock may cut the window a cycle earlier in one run, so
         # compare the common prefix; every decision in it must match
         k = min(len(s1[f]), len(s2[f]))
@@ -297,19 +302,75 @@ def test_retry_policy_from_test_opts():
 
 
 @pytest.mark.parametrize("fault", ["partition", "kill", "pause",
-                                   "duplicate"])
+                                   "duplicate", "weather"])
 def test_fault_package_smoke_echo(fault):
     res = _tpu_test(7, {fault})
     assert res["valid"] is True, res["net"]
 
 
 @pytest.mark.parametrize("fault", ["partition", "kill", "pause",
-                                   "duplicate"])
+                                   "duplicate", "weather"])
 def test_fault_package_smoke_broadcast(fault):
     res = _tpu_test(7, {fault}, workload="broadcast",
                     node="tpu:broadcast", topology="grid")
     assert res["valid"] is True, (res["net"], res["workload"])
     assert res["workload"]["lost-count"] == 0
+
+
+# --- weather package ---------------------------------------------------------
+
+
+def test_weather_decision_stream_deterministic():
+    a = nem.NemesisDecisions(NODES, seed=5)
+    b = nem.NemesisDecisions(NODES, seed=5)
+    fronts = [a.next_weather() for _ in range(6)]
+    assert fronts == [b.next_weather() for _ in range(6)]
+    assert all(f in nem.WEATHER_FRONTS for f in fronts)
+    # a different seed moves the schedule
+    c = nem.NemesisDecisions(NODES, seed=6)
+    assert [c.next_weather() for _ in range(6)] != fronts
+
+
+def test_weather_host_executor_toggles_and_restores_baseline():
+    from maelstrom_tpu.net.host import HostNet
+    net = HostNet(latency={"mean": 5, "dist": "constant"})
+    net.p_loss = 0.01                    # the run's configured baseline
+    net.latency_dist = net.latency_dist.scaled(3.0)
+    ex = nem.CombinedNemesis(net, NODES, seed=1)
+    r = ex.invoke({"f": "start-weather", "process": "nemesis"})
+    assert r["type"] == "info" and "weather" in r["value"]
+    name, p, scale = nem.NemesisDecisions(NODES, seed=1).next_weather()
+    assert net.p_loss == p
+    assert net.latency_dist.scale == scale
+    r = ex.invoke({"f": "stop-weather", "process": "nemesis"})
+    assert r["value"] == "weather cleared"
+    assert net.p_loss == 0.01
+    assert net.latency_dist.scale == 3.0
+
+
+@pytest.mark.slow
+def test_weather_tpu_history_reports_fronts_and_heals():
+    """Weather fronts appear in the TPU history with their drawn values
+    and the final heal restores the configured baseline on the live
+    NetState (observable through a runner-level run)."""
+    import json
+    res = _tpu_test(13, {"weather"}, workload="broadcast",
+                    node="tpu:broadcast", topology="grid",
+                    p_loss=0.01, latency_scale=2.0)
+    assert res["valid"] is True
+    with open("/tmp/maelstrom-tpu-test-store/latest/history.jsonl") as f:
+        hist = [json.loads(line) for line in f]
+    starts = [o for o in hist if o.get("f") == "start-weather"
+              and o["type"] == "info"]
+    stops = [o for o in hist if o.get("f") == "stop-weather"
+             and o["type"] == "info"]
+    assert starts and stops
+    # the drawn front is one of the presets, named in the op value
+    assert any(name in starts[0]["value"]
+               for name, _p, _s in nem.WEATHER_FRONTS), starts[0]
+    # heal-before-grade: the last weather op is a stop
+    last = max(starts + stops, key=lambda o: o["time"])
+    assert last["f"] == "stop-weather"
 
 
 def test_kill_soup_history_shows_downtime_and_recovery():

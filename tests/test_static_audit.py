@@ -329,6 +329,52 @@ def test_gate_production_plain_round_and_scan_fns():
     assert suppressed, "baseline entries stopped matching: stale baseline"
 
 
+def test_gate_traces_continuous_scan_variant():
+    """ISSUE 7: the default program set now traces the continuous-mode
+    (`--continuous`) sched-inject scan, so the PR 5 rules cover the new
+    injection path too — zero non-baselined findings."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["kafka"], mesh=None, fleet=False)
+    assert any(e.startswith("cscan_fn[") for e in entries), entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+
+
+def test_fixture_violation_in_continuous_scan_path_fires():
+    """A seeded hazard INSIDE the continuous scan body is caught through
+    the cscan trace: an unstable argsort planted in a program step
+    surfaces as exactly one unstable-sort site when the sched-inject
+    scan is audited."""
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.sim import make_scan_fn, make_sim
+
+    program = get_program("echo", {}, ["n0", "n1", "n2"])
+    orig = program.step
+
+    def bad_step(state, inbox, ctx):
+        state2, outbox = orig(state, inbox, ctx)
+        order = jnp.argsort(inbox.mid[:, 0], stable=False)  # seeded bug
+        return state2, outbox.replace(
+            a=outbox.a + inbox.mid[order][0, 0] * 0)
+    program.step = bad_step
+    cfg = T.NetConfig(n_nodes=3, n_clients=2)
+    sim = make_sim(program, cfg)
+    inject = T.Msgs.empty(2)
+    spec = StepSpec(
+        name="cscan_fn[fx]",
+        fn=make_scan_fn(program, cfg, reply_cap=8, sched_inject=True),
+        args=(sim, inject, jnp.zeros(2, jnp.int32), jnp.int32(4), True))
+    # the step appears in both the window's first round and the loop
+    # body: dedupe collapses the two traces to the one seeded site
+    sites = dedupe_sites(audit_step(spec))
+    unstable = [s for s in sites if s.rule == "unstable-sort"]
+    assert len(unstable) == 1, [s.as_dict() for s in sites]
+
+
 @pytest.mark.multichip
 def test_gate_production_mesh_round_and_scan_fns():
     """The --mesh 1,2 variants: same zero-new-findings bar with the

@@ -1,0 +1,58 @@
+"""--p-loss / --latency-scale path parity (ISSUE 7 satellite): the
+same option keys install the same values on the host network and the
+TPU NetState — including explicit zeros — so a `--bin` run and a
+`--node tpu:` run of identical flags see the same network model."""
+
+import jax
+
+from maelstrom_tpu import core
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+
+def _host_net(**opts):
+    test = core.build_test(dict(
+        workload="echo", bin="demo/python/echo.py", node_count=3,
+        **opts))
+    return test["net"]
+
+
+def _tpu_net(**opts):
+    test = core.build_test(dict(
+        workload="echo", node="tpu:echo", node_count=3, **opts))
+    return TpuRunner(test).sim.net
+
+
+def test_p_loss_and_latency_scale_flow_to_both_paths():
+    host = _host_net(p_loss=0.25, latency_scale=3.0,
+                     latency={"mean": 4, "dist": "constant"})
+    tpu = _tpu_net(p_loss=0.25, latency_scale=3.0,
+                   latency={"mean": 4, "dist": "constant"})
+    assert host.p_loss == 0.25
+    assert host.latency_dist.scale == 3.0
+    assert float(jax.device_get(tpu.p_loss)) == 0.25
+    assert float(jax.device_get(tpu.latency_scale)) == 3.0
+
+
+def test_explicit_zero_p_loss_installs_on_both_paths():
+    # the old code gated on truthiness: an explicit 0.0 was skipped on
+    # the host path while defaults differed — both must install
+    host = _host_net(p_loss=0.0)
+    tpu = _tpu_net(p_loss=0.0)
+    assert host.p_loss == 0.0
+    assert float(jax.device_get(tpu.p_loss)) == 0.0
+
+
+def test_default_baselines_match():
+    host = _host_net()
+    tpu = _tpu_net()
+    assert host.p_loss == 0.0
+    assert host.latency_dist.scale == 1.0
+    assert float(jax.device_get(tpu.p_loss)) == 0.0
+    assert float(jax.device_get(tpu.latency_scale)) == 1.0
+
+
+def test_latency_scale_scales_host_draws():
+    import random
+    host = _host_net(latency_scale=10.0,
+                     latency={"mean": 2, "dist": "constant"})
+    assert host.latency_dist.draw(random.Random(0)) == 20.0
