@@ -91,6 +91,10 @@ _CPU_FALLBACK_DEFAULTS = {
     "BENCH_EFFICIENT": "0", "BENCH_RAFT_CLUSTERS": "256",
     "BENCH_RAFT_GRADED": "0",
     "BENCH_STREAM_TIME_LIMIT": "5", "BENCH_STREAM_RATE": "25",
+    # batched-broadcast comparison: the speedup is message economics
+    # (shape-identical per-round work), so shrunk sizes keep the ratio
+    # meaningful while the wall time stays in minutes
+    "BENCH_BB_NODES": "1024", "BENCH_BB_VALUES": "256",
 }
 
 
@@ -553,6 +557,183 @@ def bench_fleet_record(sizes=None) -> dict:
     }
 
 
+def bench_broadcast_batched_record() -> dict:
+    """Chop Chop-grade batched atomic broadcast (ISSUE 9, doc/perf.md):
+    the distilled-batch node (`nodes/broadcast_batched.py`) against the
+    eager-resend gossip node at EQUAL node count, same grid, same
+    zero-latency network. Both runs deliver the same V client values to
+    every node; each is timed to ITS OWN convergence (all values seen
+    everywhere — checked per chunk, identically for both), because the
+    batching win IS finishing the same workload in fewer simulated
+    messages and rounds.
+
+    Metrics per protocol:
+      - client_ops_per_sec: V client ops fully delivered per wall
+        second — the Chop Chop headline (ops/s at the network limit);
+      - msgs_per_sec: raw simulated messages per wall second (the
+        "network limit" both protocols saturate);
+      - units_per_msg: logical client-op units per network message
+        (1.0 for eager by construction; the batched node's distillation
+        factor, from the net's sent_units/recv_units counters).
+
+    `speedup_client_ops` (batched over eager) is the acceptance figure:
+    >= 2x on the same hardware, CPU fallback included — the per-round
+    array work is shape-identical for both protocols, so the ratio is
+    pure message economics, not idle-parallelism dependent (unlike the
+    fleet ratio). A non-converged side invalidates the record."""
+    import jax
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.nodes.broadcast import T_BCAST
+    from maelstrom_tpu.nodes.broadcast_batched import (T_BATCH,
+                                                       range_checksum)
+    from maelstrom_tpu.sim import (dealias, donation_enabled,
+                                   make_run_fn, make_sim)
+
+    N = int(os.environ.get("BENCH_BB_NODES", 4096))
+    V = int(os.environ.get("BENCH_BB_VALUES", 512))
+    B = int(os.environ.get("BENCH_BB_BATCH", 32))
+    chunk = int(os.environ.get("BENCH_BB_CHUNK", 64))
+    # generous horizon: the eager side needs ~V rounds per edge backlog
+    # plus grid mixing; convergence exits early, the horizon only backs
+    # the non-convergence failure mode
+    max_rounds = int(os.environ.get("BENCH_BB_MAX_ROUNDS", 16 * V))
+    max_rounds = max(chunk, (max_rounds // chunk) * chunk)
+    pool_cap = int(os.environ.get("BENCH_BB_POOL", 4096))
+    donate = (os.environ.get("BENCH_DONATE", "1") == "1"
+              and donation_enabled())
+    nodes = [f"n{i}" for i in range(N)]
+
+    def measure(kind):
+        opts = {"topology": "grid", "max_values": V,
+                "gossip_per_neighbor": 1, "latency": {"mean": 0},
+                "eager_resend": True}
+        if kind == "batched":
+            prog = get_program("broadcast-batched",
+                               {**opts, "batch_max": B}, nodes)
+            n_inj = (V + B - 1) // B
+            lo = np.arange(n_inj, dtype=np.int64) * B
+            n_vals = np.minimum(B, V - lo)
+            a_col, b_col = lo, n_vals
+            c_col = np.array([int(range_checksum(int(l), int(n)))
+                              for l, n in zip(lo, n_vals)])
+            t_code = T_BATCH
+        else:
+            prog = get_program("broadcast", opts, nodes)
+            n_inj = V
+            a_col = np.arange(V, dtype=np.int64)
+            b_col = np.zeros(V, dtype=np.int64)
+            c_col = np.zeros(V, dtype=np.int64)
+            t_code = T_BCAST
+        cfg = T.NetConfig(
+            n_nodes=N, n_clients=1, pool_cap=pool_cap,
+            inbox_cap=prog.inbox_cap, client_cap=0,
+            unit_words=tuple(getattr(prog, "unit_words", ()) or ()))
+        run_fn = make_run_fn(prog, cfg, donate=donate)
+        # one injection per round starting at round 0, dest spread by
+        # the Fibonacci-hash stride (same discipline as _main_broadcast)
+        rr = np.arange(max_rounds)
+        live = rr < n_inj
+        j = np.minimum(rr, n_inj - 1)
+        dest = (a_col[j] * 2654435761) % N
+        plan = T.Msgs.empty((max_rounds, 1)).replace(
+            valid=jnp.asarray(live[:, None]),
+            src=jnp.full((max_rounds, 1), N, T.I32),
+            dest=jnp.asarray(dest.astype(np.int32)[:, None]),
+            type=jnp.full((max_rounds, 1), t_code, T.I32),
+            a=jnp.asarray(a_col[j].astype(np.int32)[:, None]),
+            b=jnp.asarray(b_col[j].astype(np.int32)[:, None]),
+            c=jnp.asarray(c_col[j].astype(np.int32)[:, None]))
+        chunks = jax.tree.map(
+            lambda f: f.reshape((max_rounds // chunk, chunk)
+                                + f.shape[1:]), plan)
+        conv = jax.jit(lambda sim: sim.nodes["seen"][:, :V].all())
+
+        def run(seed):
+            sim = make_sim(prog, cfg, seed=seed)
+            if donate:
+                sim = dealias(sim)
+            rounds = 0
+            for i in range(max_rounds // chunk):
+                sim, _ = run_fn(sim,
+                                jax.tree.map(lambda f, i=i: f[i], chunks))
+                rounds += chunk
+                # per-chunk convergence probe: one scalar fetch, booked
+                # identically for both protocols inside the timed window
+                if bool(jax.device_get(conv(sim))):
+                    break
+            return sim, rounds
+
+        t0 = time.perf_counter()
+        run(seed=0)
+        print(f"bench[batched:{kind}]: compile+first run "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
+        t0 = time.perf_counter()
+        sim, rounds = run(seed=1)
+        dt = time.perf_counter() - t0
+        st = T.stats_dict(sim.net)
+        seen = np.asarray(jax.device_get(sim.nodes["seen"][:, :V]))
+        units = st["recv_units"] if cfg.unit_words else st["recv_all"]
+        return {
+            "protocol": kind,
+            "rounds_to_convergence": rounds,
+            "wall_s": round(dt, 3),
+            "converged": bool(seen.all()),
+            "client_ops": V,
+            "client_ops_per_sec": round(V / dt, 1),
+            "messages_delivered": int(st["recv_all"]),
+            "msgs_per_sec": round(st["recv_all"] / dt, 1),
+            "units_delivered": int(units),
+            "units_per_msg": round(units / max(st["recv_all"], 1), 3),
+            "dropped_overflow": st["dropped_overflow"],
+        }
+
+    rows = [measure("eager"), measure("batched")]
+    eager, batched = rows
+    speedup = round(batched["client_ops_per_sec"]
+                    / max(eager["client_ops_per_sec"], 1e-9), 2)
+    for r in rows:
+        print(f"bench[batched]: {r['protocol']}: "
+              f"{r['client_ops_per_sec']:.1f} ops/s, "
+              f"{r['msgs_per_sec']:.0f} msgs/s, "
+              f"{r['rounds_to_convergence']} rounds", file=sys.stderr)
+    return {
+        "protocols": rows,
+        "nodes": N, "values": V, "batch": B,
+        "speedup_client_ops": speedup,
+        "msg_reduction": round(
+            eager["messages_delivered"]
+            / max(batched["messages_delivered"], 1), 2),
+        "donated_carry": donate,
+        "host_cpus": os.cpu_count(),
+        "devices": jax.device_count(),
+        "valid": all(r["converged"] and not r["dropped_overflow"]
+                     for r in rows),
+    }
+
+
+def _main_broadcast_batched():
+    """`BENCH_MODE=broadcast_batched`: the batched-vs-eager record as
+    its own artifact, headline `value` = the batched node's delivered
+    client-ops/s, `vs_baseline` = the speedup over eager-resend at
+    equal node count (the ISSUE 9 acceptance figure)."""
+    bb = bench_broadcast_batched_record()
+    top = next(r for r in bb["protocols"] if r["protocol"] == "batched")
+    record = {
+        "metric": "broadcast_batched_client_ops_per_sec",
+        "value": top["client_ops_per_sec"],
+        "unit": "client-ops/sec",
+        "vs_baseline": bb["speedup_client_ops"],
+        **bb,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not bb["valid"]:
+        sys.exit(1)
+
+
 def bench_stream_record(mults=None) -> dict:
     """Open-world stream throughput (doc/streams.md): continuous-mode
     streaming kafka — consumer groups, cursor fetches, windowed
@@ -657,6 +838,10 @@ def main():
     elif mode == "stream":
         metric, unit = "stream_kafka_msgs_per_sec", "msgs/sec"
         fn = _main_stream
+    elif mode == "broadcast_batched":
+        metric = "broadcast_batched_client_ops_per_sec"
+        unit = "client-ops/sec"
+        fn = _main_broadcast_batched
     else:
         metric = ("raft_cluster_rounds_per_sec_10k_clusters" if raft
                   else "broadcast_sim_msgs_per_sec_100k_nodes")
@@ -891,6 +1076,14 @@ def _main_broadcast():
         fleet = bench_fleet_record()
         record["fleet"] = fleet
 
+    # batched atomic broadcast (ISSUE 9; BENCH_BATCHED=0 to skip):
+    # distilled-batch vs eager-resend client-ops/s at equal node count,
+    # so the recapture records old and new metric in one run
+    batched = None
+    if os.environ.get("BENCH_BATCHED", "1") == "1":
+        batched = bench_broadcast_batched_record()
+        record["broadcast_batched"] = batched
+
     print(json.dumps(record))
     # a non-converged, lossy, or checker-failed run is not a valid
     # benchmark: fail loudly (after emitting the JSON record)
@@ -908,6 +1101,10 @@ def _main_broadcast():
     # a fleet size that fails to converge (or drops messages) is a
     # correctness bug in the vmapped scan, not a perf datum
     if fleet is not None and not fleet["valid"]:
+        sys.exit(1)
+    # a batched-broadcast side that fails to converge is a protocol
+    # bug in the range-gossip node, not a perf datum
+    if batched is not None and not batched["valid"]:
         sys.exit(1)
 
 

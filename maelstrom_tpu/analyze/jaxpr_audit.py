@@ -39,7 +39,9 @@ from . import Finding
 # Workload -> built-in TPU node program (the `--node tpu:<x>` namespace;
 # lin-mutex rides the lin-kv program).
 WORKLOAD_NODES = {
-    "broadcast": "tpu:broadcast", "g-set": "tpu:g-set",
+    "broadcast": "tpu:broadcast",
+    "broadcast-batched": "tpu:broadcast-batched",
+    "g-set": "tpu:g-set",
     "g-counter": "tpu:g-counter", "pn-counter": "tpu:pn-counter",
     "lin-kv": "tpu:lin-kv", "txn-list-append": "tpu:txn-list-append",
     "unique-ids": "tpu:unique-ids", "kafka": "tpu:kafka",

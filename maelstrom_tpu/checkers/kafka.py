@@ -56,9 +56,16 @@ def _commit_shape(v: dict):
     streaming values are {"group": g, "offsets": {...}}, classic ones
     are the flat offsets map."""
     if "offsets" in v and "group" in v:
+        # `keys` (banked wide-key lists, nodes/kafka.py key_count > 4)
+        # declares which keys the observation covers: a committed key
+        # OUTSIDE the declared scope is unobserved, not regressed.
+        # None = the observation covers every key (all pre-bank values).
+        scope = (frozenset(str(k) for k in v["keys"])
+                 if "keys" in v else None)
         return (int(v["group"]),
-                {str(k): int(o) for k, o in v["offsets"].items()})
-    return None, {str(k): int(o) for k, o in v.items()}
+                {str(k): int(o) for k, o in v["offsets"].items()},
+                scope)
+    return None, {str(k): int(o) for k, o in v.items()}, None
 
 
 def extract_observation(invoke, complete):
@@ -69,7 +76,12 @@ def extract_observation(invoke, complete):
       ("send", ack_time, key, offset, msg)
       ("poll", invoke_time, {key: [[offset, msg], ...]})
       ("commit", complete_time, group_or_None, {key: offset})
-      ("list", invoke_time, complete_time, group_or_None, {key: offset})
+      ("list", invoke_time, complete_time, group_or_None, {key: offset},
+       scope_or_None)
+
+    `scope` (streaming lists only) is the frozenset of key names the
+    observation covers — banked wide-key lists read one 4-key window
+    per RPC (see `_commit_shape`); None covers every key.
     """
     if complete is None or not complete.is_ok():
         return None
@@ -81,11 +93,11 @@ def extract_observation(invoke, complete):
     if f == "poll" and isinstance(v, dict):
         return ("poll", invoke.time, v)
     if f == "commit" and isinstance(v, dict):
-        grp, offs = _commit_shape(v)
+        grp, offs, _scope = _commit_shape(v)
         return ("commit", complete.time, grp, offs)
     if f == "list" and isinstance(v, dict):
-        grp, offs = _commit_shape(v)
-        return ("list", invoke.time, complete.time, grp, offs)
+        grp, offs, scope = _commit_shape(v)
+        return ("list", invoke.time, complete.time, grp, offs, scope)
     return None
 
 
@@ -151,8 +163,8 @@ def grade(observations, streaming: bool = False) -> dict:
             _, t, grp, offs = rec
             commits.append((t, grp, offs))
         else:   # list
-            _, inv_t, t, grp, offs = rec
-            lists.append((inv_t, t, grp, offs))
+            _, inv_t, t, grp, offs, scope = rec
+            lists.append((inv_t, t, grp, offs, scope))
 
     # 3. lost writes.
     if streaming:
@@ -207,18 +219,21 @@ def grade(observations, streaming: bool = False) -> dict:
     # COMPLETED must observe at least that offset. One time-sorted sweep
     # with running per-(group, key) floors; at equal timestamps checks
     # run before floor-raises (lenient toward concurrency).
-    events = ([(c_t, 1, None, offs, grp) for c_t, grp, offs in commits]
-              + [(c2, 1, None, offs, grp)
-                 for _i, c2, grp, offs in lists]
-              + [(li_inv, 0, offs, None, grp)
-                 for li_inv, _c, grp, offs in lists])
+    events = ([(c_t, 1, None, offs, grp, None)
+               for c_t, grp, offs in commits]
+              + [(c2, 1, None, offs, grp, None)
+                 for _i, c2, grp, offs, _s in lists]
+              + [(li_inv, 0, offs, None, grp, scope)
+                 for li_inv, _c, grp, offs, scope in lists])
     floor: dict = {}             # (group, key) -> offset
-    for _t, _kind, check_offs, raise_offs, grp in sorted(
+    for _t, _kind, check_offs, raise_offs, grp, scope in sorted(
             events, key=lambda e: (e[0], e[1])):
         if check_offs is not None:
             for (g2, k), lo in floor.items():
                 if g2 != grp:
                     continue
+                if scope is not None and k not in scope:
+                    continue    # banked list: key outside its window
                 if check_offs.get(k, -1) < lo:
                     rec = {"key": k, "committed": lo,
                            "observed": check_offs.get(k, -1)}
@@ -361,10 +376,12 @@ class KafkaStreamObserver:
             for k, o in offs.items():
                 self._raise_floor(grp, k, t, o)
         else:   # list
-            _, inv_t, t, grp, offs = rec
+            _, inv_t, t, grp, offs, scope = rec
             for (g2, k), runs in self._raises.items():
                 if g2 != grp:
                     continue
+                if scope is not None and k not in scope:
+                    continue    # banked list: key outside its window
                 # binding floor: highest raise STRICTLY before the
                 # list's invoke (equal-timestamp leniency of `grade`)
                 lo = -1

@@ -100,6 +100,12 @@ class NetStatsChecker(Checker):
                              os.path.join(store_dir, "messages.svg"))
             except Exception as e:      # viz must never fail the test
                 stats["viz-error"] = repr(e)
+        # batched-payload units (net/host.py `_units`): surfaced only
+        # when some message actually carried a batch record, so classic
+        # workloads' results stay shaped as before
+        if getattr(self.net, "batched_msgs", 0):
+            stats["sent-units"] = self.net.sent_units
+            stats["recv-units"] = self.net.recv_units
         # journal ingest volume (counts() includes host-bytes): the host
         # path's analogue of the TPU path's device-drain accounting
         # (TransferStats above, surfaced by TpuNetStats)

@@ -104,7 +104,11 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # change the op stream, so a resume must match
                     "continuous", "continuous_window_ms",
                     "latency_scale", "kafka_groups",
-                    "session_timeout_ms", "poll_batch")
+                    "session_timeout_ms", "poll_batch",
+                    # batched atomic broadcast (doc/perf.md): the
+                    # distiller's batch shape and the value-table
+                    # capacity both change the op stream / wire records
+                    "batch_max", "batch_dup_rate", "max_values")
 
 
 class CheckpointError(RuntimeError):

@@ -25,7 +25,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--node", help="Built-in TPU node program, e.g. "
                                   "tpu:broadcast (instead of --bin)")
     t.add_argument("-w", "--workload", default="lin-kv",
-                   choices=["broadcast", "echo", "g-set", "g-counter",
+                   choices=["broadcast", "broadcast-batched", "echo",
+                            "g-set", "g-counter",
                             "pn-counter", "lin-kv", "lin-mutex",
                             "txn-list-append", "unique-ids", "kafka",
                             "txn-rw-register"],
@@ -79,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Network topology offered to broadcast nodes")
     t.add_argument("--key-count", type=int,
                    help="Keys to work on at once (append test)")
+    t.add_argument("--batch-max", type=int,
+                   help="Batched broadcast: max client values distilled "
+                        "into one batch (broadcast-batched workload; "
+                        "default 16)")
+    t.add_argument("--max-values", type=int,
+                   help="Broadcast value-table capacity (broadcast / "
+                        "broadcast-batched nodes; default 1024)")
     t.add_argument("--max-txn-length", type=int, default=4,
                    help="Max micro-ops per transaction")
     t.add_argument("--max-writes-per-key", type=int, default=16,
@@ -307,7 +315,7 @@ def opts_from_args(args) -> dict:
     for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
               "check_workers", "fleet", "fleet_sweep", "nemesis_seed",
               "kafka_groups", "session_timeout_ms", "poll_batch",
-              "continuous_window_ms"):
+              "continuous_window_ms", "batch_max", "max_values"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

@@ -86,6 +86,11 @@ DEFAULTS = dict(
     # cursor-based fetches (no O(prefix) replies), coordinator-driven
     # rebalancing on membership change, per-group offset commits
     kafka_groups=0, session_timeout_ms=2500.0, poll_batch=8,
+    # batched atomic broadcast (doc/perf.md "batched atomic broadcast"):
+    # the distiller's batch shape for the broadcast-batched workload —
+    # up to batch_max fresh values per batch, a batch_dup_rate fraction
+    # of duplicate re-submissions collapsed by distillation
+    batch_max=16, batch_dup_rate=0.25,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user

@@ -102,6 +102,14 @@ class HostNet:
         self.journal: Journal | None = None
         self.p_loss = 0.0
         self.p_dup = 0.0        # at-least-once duplication (servers only)
+        # batched-payload parity with the TPU net (net/tpu.py
+        # `NetConfig.unit_words`): a JSON body carrying `batch_units: n`
+        # is ONE message transporting n logical client ops; both paths
+        # book units next to raw message counts so ops-per-message
+        # economics read the same whichever network ran the test
+        self.sent_units = 0
+        self.recv_units = 0
+        self.batched_msgs = 0   # messages that declared batch_units > 1
         self.partitions: dict[str, set[str]] = {}   # dest -> blocked srcs
         self.queues: dict[str, _NodeQueue] = {}
         self.next_client_id = itertools.count(0)
@@ -164,6 +172,17 @@ class HostNet:
 
     # --- send / recv (reference net.clj:188-246) ---
 
+    @staticmethod
+    def _units(msg: Message) -> int:
+        """Logical client-op units one message carries: the declared
+        `batch_units` body field for distilled-batch RPCs, else 1 (the
+        host half of the TPU net's `payload_units`)."""
+        body = msg.body if isinstance(msg.body, dict) else {}
+        try:
+            return max(int(body.get("batch_units", 1)), 1)
+        except (TypeError, ValueError):
+            return 1
+
     def latency_for_ms(self, msg: Message) -> float:
         """Clients get zero latency — latency on clients *hides* consistency
         anomalies (reference `net.clj:177-186`)."""
@@ -184,6 +203,10 @@ class HostNet:
 
         if self.journal is not None:
             self.journal.log_send(msg, self.time_ns())
+        u = self._units(msg)
+        self.sent_units += u
+        if u > 1:
+            self.batched_msgs += 1
         if self.log_send:
             log.info("send %r", msg)
 
@@ -219,4 +242,5 @@ class HostNet:
             log.info("recv %r", msg)
         if self.journal is not None:
             self.journal.log_recv(msg, self.time_ns())
+        self.recv_units += self._units(msg)
         return msg

@@ -90,6 +90,15 @@ class NetStats:
     lost: jnp.ndarray
     dropped_partition: jnp.ndarray
     dropped_overflow: jnp.ndarray   # pool-full drops: MUST be 0 for a valid run
+    # client-op UNITS transported (batched atomic broadcast,
+    # doc/perf.md): a distilled batch row is ONE message carrying n
+    # logical client ops — the program registers which type codes are
+    # batches and which payload word holds the count
+    # (`NetConfig.unit_words`), and the net books units next to raw
+    # message counts so ops-per-message economics stay honest. Both stay
+    # 0 when no unit_words are configured (the booking compiles out).
+    sent_units: jnp.ndarray
+    recv_units: jnp.ndarray
     # messages consumed because their destination was crash-killed by
     # the nemesis (the process is down: delivery is connection-refused,
     # unlike pause where the message waits in the pool)
@@ -105,7 +114,8 @@ class NetStats:
     @classmethod
     def zeros(cls) -> "NetStats":
         z = jnp.zeros((), I32)
-        return cls(z, z, z, z, z, z, z, z, z, jnp.zeros(TYPE_BUCKETS, I32))
+        return cls(z, z, z, z, z, z, z, z, z, z, z,
+                   jnp.zeros(TYPE_BUCKETS, I32))
 
 
 TYPE_BUCKETS = 64     # wire type codes are small ints; 63 = overflow bin
@@ -155,6 +165,12 @@ class NetConfig:
     partition_groups: int = 1     # block-matrix side; 1 = component-only
     enable_stall: bool = False    # kill/pause masks honored in the round
     enable_duplication: bool = False  # duplicate fault path compiled in
+    # batched payload rows (doc/perf.md "batched atomic broadcast"):
+    # ((type_code, word), ...) pairs declaring that messages of
+    # `type_code` are distilled batches whose logical client-op count
+    # rides payload word `word` (0 = a, 1 = b, 2 = c). Every other
+    # message counts 1 unit. Empty = units booking compiles out.
+    unit_words: tuple = ()
 
     @property
     def n_total(self) -> int:
@@ -181,6 +197,19 @@ def make_net(cfg: NetConfig) -> NetState:
 def involves_client(cfg: NetConfig, src, dest):
     """Client on either end (reference `util.clj:12-16`)."""
     return (src >= cfg.n_nodes) | (dest >= cfg.n_nodes)
+
+
+def payload_units(cfg: NetConfig, types, words, valid):
+    """Total client-op units over a masked message batch: 1 per valid
+    message, except registered batch types (`cfg.unit_words`), which
+    count their declared payload word (floored at 1 — a batch always
+    carries at least its own record). Shapes are whatever the caller's
+    batch uses; `words` is the (a, b, c) triple."""
+    u = valid.astype(I32)
+    for code, w in cfg.unit_words:
+        u = jnp.where(valid & (types == code),
+                      jnp.maximum(words[w], 1), u)
+    return jnp.sum(u)
 
 
 def draw_latency_rounds(cfg: NetConfig, key, scale, shape):
@@ -287,6 +316,9 @@ def _send(cfg: NetConfig, net: NetState, out: Msgs, key):
         + jnp.sum((keep & ~ok).astype(I32)),
         duplicated=st.duplicated + n_dup,
         sent_by_type=count_by_type(st.sent_by_type, out.type, new))
+    if cfg.unit_words:
+        st = st.replace(sent_units=st.sent_units + payload_units(
+            cfg, out.type, (out.a, out.b, out.c), new))
     net = net.replace(pool=pool, stats=st,
                       next_mid=net.next_mid + jnp.sum(new.astype(I32)))
     return net, sent_view
@@ -408,6 +440,10 @@ def _deliver_due(cfg: NetConfig, net: NetState):
         dropped_partition=st.dropped_partition
         + jnp.sum(dropped.astype(I32)),
         dropped_down=st.dropped_down + jnp.sum(to_down.astype(I32)))
+    if cfg.unit_words:
+        st = st.replace(recv_units=st.recv_units + payload_units(
+            cfg, pool.type, (pool.a, pool.b, pool.c),
+            taken | c_taken))
     return net.replace(pool=pool, stats=st), inbox, client_msgs
 
 

@@ -316,7 +316,8 @@ def register(cls):
 
 def get_program(name: str, opts: dict, nodes: list[str]) -> NodeProgram:
     # import for side effect: program registration
-    from . import (echo, broadcast, gset, pn_counter, raft,  # noqa: F401
+    from . import (echo, broadcast, broadcast_batched,  # noqa: F401
+                   gset, pn_counter, raft,  # noqa: F401
                    txn_list_append, txn_rw_register, unique_ids,  # noqa: F401
                    kafka)  # noqa: F401
     if name not in PROGRAMS:

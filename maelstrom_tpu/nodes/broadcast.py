@@ -141,6 +141,67 @@ class BroadcastProgram(NodeProgram):
             sent |= sel[:, :, j, None] & (topi[:, :, j, None] == vee)
         return sel, topi, sent
 
+    def _digest_known(self, edge_in: EdgeMsgs, L: int):
+        """Digest receive: [N, D, V] bool of values each edge's neighbor
+        has proven it holds. Lane content reduced over lanes. Normally
+        one digest per edge per round; the spill write can land two
+        (sent in different rounds) in one cell — last lane wins, the
+        ignored one is re-owed when its gossip retransmits (digests are
+        idempotent). Shared with the batched node (broadcast_batched.py):
+        acknowledgement is value-based, so it is independent of how the
+        values traveled (single-value gossip or distilled ranges)."""
+        N, D, V = self.n_nodes, self.D, self.V
+        vee = jnp.arange(V, dtype=I32)
+        d_in = edge_in.valid & (edge_in.type == T_DIGEST)
+        has_digest = d_in.any(axis=2)                       # [N, D]
+
+        def lane_pick(field):
+            out = jnp.zeros((N, D), I32)
+            for l in range(L):
+                out = jnp.where(d_in[:, :, l], field[:, :, l], out)
+            return out
+        w_in = lane_pick(edge_in.a)
+        b_in, c_in = lane_pick(edge_in.b), lane_pick(edge_in.c)
+        j = vee - w_in[:, :, None] * 64                     # [N, D, V]
+        in_window = (j >= 0) & (j < 64)
+        bit = jnp.where(
+            j < 32,
+            (b_in[:, :, None] >> jnp.clip(j, 0, 31)) & 1,
+            (c_in[:, :, None] >> jnp.clip(j - 32, 0, 31)) & 1)
+        return has_digest[:, :, None] & in_window & (bit == 1)
+
+    def _digest_out(self, seen, owed, arrived):
+        """Digest send half (shared with broadcast_batched.py): owe the
+        windows gossip arrived in, pay one owed window per edge per
+        round. Returns (owed', have_owed [N, D], w_send, b_out, c_out).
+
+        Digest payload: 64 seen-bits of each edge's owed window. Words
+        are packed once per node per window, then selected per edge with
+        an unrolled compare — a dynamic [N, D, 64] gather here serializes
+        on TPU (~300 ms/round at 100k nodes)."""
+        N, D, V, W = self.n_nodes, self.D, self.V, self.n_windows
+        arrived_pad = jnp.pad(arrived, ((0, 0), (0, 0), (0, self.Vp - V)))
+        owed = owed | arrived_pad.reshape(N, D, W, 64).any(axis=3)
+        have_owed = owed.any(axis=2)                        # [N, D]
+        www = jnp.arange(W, dtype=I32)
+        w_send = jnp.argmax(owed.astype(I32) * (W - www), axis=2)  # [N, D]
+        owed = owed & ~(have_owed[:, :, None] & (w_send[:, :, None] == www))
+
+        seen_pad = jnp.pad(seen, ((0, 0), (0, self.Vp - V)))
+        wins = seen_pad.reshape(N, W, 64)
+        words_b = jnp.zeros((N, W), I32)
+        words_c = jnp.zeros((N, W), I32)
+        for jj in range(32):
+            words_b |= wins[:, :, jj].astype(I32) << jj
+            words_c |= wins[:, :, 32 + jj].astype(I32) << jj
+        b_out = jnp.zeros((N, D), I32)
+        c_out = jnp.zeros((N, D), I32)
+        for w in range(W):
+            m = w_send == w
+            b_out = jnp.where(m, words_b[:, w][:, None], b_out)
+            c_out = jnp.where(m, words_c[:, w][:, None], c_out)
+        return owed, have_owed, w_send, b_out, c_out
+
     def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
         """(state, edge_in [N,D,L], client_in Msgs [N,K]) ->
         (state', edge_out [N,D,L], client_out Msgs [N,K])."""
@@ -218,26 +279,7 @@ class BroadcastProgram(NodeProgram):
                     edge_out, client_out)
 
         # --- digests clear pending for values the neighbor has ---
-        d_in = edge_in.valid & (edge_in.type == T_DIGEST)
-        has_digest = d_in.any(axis=2)                       # [N, D]
-        # lane content reduced over lanes. Normally one digest per edge
-        # per round; the spill write can land two (sent in different
-        # rounds) in one cell — last lane wins, the ignored one is
-        # re-owed when its gossip retransmits (digests are idempotent)
-        def lane_pick(field):
-            out = jnp.zeros((N, D), I32)
-            for l in range(L):
-                out = jnp.where(d_in[:, :, l], field[:, :, l], out)
-            return out
-        w_in = lane_pick(edge_in.a)
-        b_in, c_in = lane_pick(edge_in.b), lane_pick(edge_in.c)
-        j = vee - w_in[:, :, None] * 64                     # [N, D, V]
-        in_window = (j >= 0) & (j < 64)
-        bit = jnp.where(
-            j < 32,
-            (b_in[:, :, None] >> jnp.clip(j, 0, 31)) & 1,
-            (c_in[:, :, None] >> jnp.clip(j - 32, 0, 31)) & 1)
-        neighbor_has = (has_digest[:, :, None] & in_window & (bit == 1))
+        neighbor_has = self._digest_known(edge_in, L)
 
         # queue new values everywhere except their arrival edge; drop
         # pending/inflight the moment we know the neighbor has the value.
@@ -262,32 +304,8 @@ class BroadcastProgram(NodeProgram):
 
         # --- digest scheduling: ack exactly the windows gossip arrived in,
         # one owed window per edge per round ---
-        W = self.n_windows
-        owed = state["owed"]
-        arrived_pad = jnp.pad(arrived, ((0, 0), (0, 0), (0, self.Vp - V)))
-        owed = owed | arrived_pad.reshape(N, D, W, 64).any(axis=3)
-        have_owed = owed.any(axis=2)                        # [N, D]
-        www = jnp.arange(W, dtype=I32)
-        w_send = jnp.argmax(owed.astype(I32) * (W - www), axis=2)  # [N, D]
-        owed = owed & ~(have_owed[:, :, None] & (w_send[:, :, None] == www))
-
-        # digest payload: 64 seen-bits of each edge's owed window. Words
-        # are packed once per node per window, then selected per edge with
-        # an unrolled compare — a dynamic [N, D, 64] gather here serializes
-        # on TPU (~300 ms/round at 100k nodes).
-        seen_pad = jnp.pad(seen, ((0, 0), (0, self.Vp - V)))
-        wins = seen_pad.reshape(N, W, 64)
-        words_b = jnp.zeros((N, W), I32)
-        words_c = jnp.zeros((N, W), I32)
-        for jj in range(32):
-            words_b |= wins[:, :, jj].astype(I32) << jj
-            words_c |= wins[:, :, 32 + jj].astype(I32) << jj
-        b_out = jnp.zeros((N, D), I32)
-        c_out = jnp.zeros((N, D), I32)
-        for w in range(W):
-            m = w_send == w
-            b_out = jnp.where(m, words_b[:, w][:, None], b_out)
-            c_out = jnp.where(m, words_c[:, w][:, None], c_out)
+        owed, have_owed, w_send, b_out, c_out = self._digest_out(
+            seen, state["owed"], arrived)
 
         # --- assemble edge output: digest lane 0, gossip lanes 1.. ---
         send_digest = have_owed & edge_ok

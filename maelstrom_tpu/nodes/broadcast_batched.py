@@ -1,0 +1,273 @@
+"""Batched atomic broadcast: distilled client batches on the wire.
+
+The Chop Chop-shaped sibling of `nodes/broadcast.py` (PAPERS.md, arxiv
+2304.07081 "Chop Chop: Byzantine Atomic Broadcast to the Network Limit"):
+instead of one network message per client value, client submissions are
+*distilled* on the sending side — deduplicated and id-compressed into a
+compact columnar record (a contiguous id range ``[lo, lo+n)`` plus an
+arithmetic checksum) — and ONE simulated-network message carries the whole
+batch. Node-to-node gossip moves the same way: each edge lane carries a
+maximal *run* of pending value ids per round (``T_GRANGE``), so a backlog
+of n contiguous values crosses an edge in one message instead of n.
+
+Receivers expand batches with a **server-side expansion proof**: the reply
+to a batch echoes the id range the server actually expanded, the count of
+ids it marked, and the checksum it recomputed *from its own expansion*
+(``sum(ids)`` over the expanded mask, mod 2^31-1). The
+`BatchedBroadcastChecker` (checkers/set_full.py) verifies every proof
+against the batch's claimed values — forged counts, truncated batches,
+in-batch duplicates, and replayed batches are each a definite fail — and
+then grades the *expanded* per-value stream with the stock set-full
+semantics, so the verdict is bit-equal to the unbatched broadcast checker
+on the same op stream by construction.
+
+Acknowledgement between nodes reuses the broadcast seen-digest protocol
+unchanged (`BroadcastProgram._digest_known` / `_digest_out`): digests are
+value-based bitmaps, so they are independent of whether the values
+traveled as single-value gossip or as distilled ranges — loss and
+partitions only delay convergence, exactly as in the parent protocol.
+
+Message accounting: batch rows carry their op count in payload word `b`;
+the program declares this via `unit_words` so the simulated network books
+`sent_units`/`recv_units` (client-op units transported) next to the raw
+message counters — the Chop Chop headline is ops/s at a fixed msgs/s
+budget, and the counters keep that ratio honest (`net/tpu.py`,
+`doc/perf.md`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkers.set_full import PROOF_MOD, range_checksum
+from ..net.static import EdgeMsgs
+from ..net.tpu import I32
+from . import EncodeCapacityError, register
+from .broadcast import BroadcastProgram, T_DIGEST
+
+__all__ = ["BroadcastBatchedProgram", "PROOF_MOD", "range_checksum"]
+
+T_BATCH = 20       # client -> node: a = lo, b = n, c = claim checksum
+T_BATCH_OK = 21    # node -> client: a = lo, b = expanded count,
+#                    c = server-recomputed checksum (the expansion proof)
+T_BREAD = 22
+T_BREAD_OK = 23    # bare ack; set materialized from the reply payload
+T_GRANGE = 24      # edge gossip: a = lo, b = n (a run of value ids)
+
+
+@register
+class BroadcastBatchedProgram(BroadcastProgram):
+    name = "broadcast-batched"
+
+    def __init__(self, opts, nodes):
+        opts = dict(opts)
+        # the naive (send-once, no-digest) mode is a teaching device of
+        # the parent; batches always retransmit until digest-acked
+        opts["naive_broadcast"] = False
+        # `gossip_per_neighbor` here counts RANGES per edge per round,
+        # not single values; one range lane usually drains a whole
+        # contiguous backlog, so the default is small
+        opts.setdefault("gossip_per_neighbor", 2)
+        super().__init__(opts, nodes)
+        # batch rows carry their client-op count in payload word b
+        # (0 = a, 1 = b, 2 = c): the net books units alongside messages
+        self.unit_words = ((T_BATCH, 1), (T_BATCH_OK, 1), (T_GRANGE, 1))
+        # cap the run length a single CLIENT batch record may claim —
+        # the same `batch_max` knob as the distiller (--batch-max), so
+        # encode rejects any record larger than the batcher may build
+        # (wire honesty: the count field is what the expansion proof
+        # audits). Gossip ranges are NOT capped by it: node-to-node
+        # T_GRANGE runs are server-side re-distillation — arriving
+        # batches merge into longer contiguous runs, audited by the
+        # digest protocol rather than a per-record proof.
+        self.max_batch = min(int(opts.get("batch_max") or self.V),
+                             self.V)
+
+    def _select_ranges(self, pending):
+        """Per-edge maximal-run extraction: up to `per_nb` runs of
+        contiguous pending value ids, longest-prefix first. Returns
+        (lanes [(has, lo, n)], sent [N, D, V] union mask). The gossip
+        analogue of `_select_gossip`, except one lane moves a whole run."""
+        N, D, V = self.n_nodes, self.D, self.V
+        vee = jnp.arange(V, dtype=I32)
+        rem = pending
+        sent = jnp.zeros(pending.shape, bool)
+        lanes = []
+        for _ in range(self.per_nb):
+            has = rem.any(axis=2)                           # [N, D]
+            lo = jnp.argmax(rem, axis=2).astype(I32)        # first pending
+            after = vee[None, None, :] >= lo[:, :, None]
+            brk = after & ~rem
+            first_brk = jnp.min(jnp.where(brk, vee, V), axis=2)
+            n = jnp.clip(first_brk - lo, 0, V)
+            n = jnp.where(has, jnp.maximum(n, 1), 0)
+            mask = (after & (vee[None, None, :] < (lo + n)[:, :, None])
+                    & has[:, :, None])
+            lanes.append((has, lo, n))
+            rem = rem & ~mask
+            sent = sent | mask
+        return lanes, sent
+
+    def edge_step(self, state, edge_in: EdgeMsgs, client_in, ctx):
+        N, D, V = self.n_nodes, self.D, self.V
+        L = int(edge_in.valid.shape[2])
+        seen, pending = state["seen"], state["pending"]
+        inflight = state["inflight"]
+        vee = jnp.arange(V, dtype=I32)
+        edge_ok = self.neighbors >= 0                       # [N, D]
+
+        # --- range-gossip arrivals: expand [lo, lo+n) per lane ---
+        g_in = edge_in.valid & (edge_in.type == T_GRANGE)   # [N, D, L]
+        glo = jnp.clip(edge_in.a, 0, V)
+        gn = jnp.clip(edge_in.b, 0, V)
+        arrived = jnp.zeros((N, D, V), bool)
+        for l in range(L):
+            arrived |= (g_in[:, :, l, None]
+                        & (vee >= glo[:, :, l, None])
+                        & (vee < (glo + gn)[:, :, l, None]))
+
+        # --- client batches: expand, and prove the expansion ---
+        K = client_in.valid.shape[1]
+        is_batch = client_in.valid & (client_in.type == T_BATCH)
+        is_read = client_in.valid & (client_in.type == T_BREAD)
+        blo = jnp.clip(client_in.a, 0, V)
+        bn = jnp.clip(client_in.b, 0, V)
+        cb = jnp.zeros((N, V), bool)
+        exp_cnt = jnp.zeros((N, K), I32)
+        exp_sum = jnp.zeros((N, K), I32)
+        for k in range(K):
+            m = ((vee[None, :] >= blo[:, k, None])
+                 & (vee[None, :] < (blo + bn)[:, k, None]))  # [N, V]
+            cb |= is_batch[:, k, None] & m
+            # the proof is computed from the server's OWN expansion mask
+            # — a range clipped by V (or tampered in flight) yields a
+            # count/checksum that cannot match the client's claim
+            exp_cnt = exp_cnt.at[:, k].set(m.sum(axis=1).astype(I32))
+            exp_sum = exp_sum.at[:, k].set(
+                ((vee[None, :] * m).sum(axis=1) % PROOF_MOD).astype(I32))
+
+        new = (arrived.any(axis=1) | cb) & ~seen            # [N, V]
+        seen = seen | arrived.any(axis=1) | cb
+
+        # --- client replies: batch acks carry the expansion proof ---
+        reply_type = jnp.where(is_batch, T_BATCH_OK,
+                               jnp.where(is_read, T_BREAD_OK, 0))
+        client_out = client_in.replace(
+            valid=is_batch | is_read, dest=client_in.src,
+            reply_to=client_in.mid, type=reply_type,
+            a=jnp.where(is_batch, client_in.a, 0),
+            b=jnp.where(is_batch, exp_cnt, 0),
+            c=jnp.where(is_batch, exp_sum, 0))
+
+        # --- digest receive + retry bookkeeping (parent protocol) ---
+        neighbor_has = self._digest_known(edge_in, L)
+        known = arrived | neighbor_has
+        inflight_old = state["inflight_old"]
+        requeue = (ctx["round"] % self.retry_rounds) == 0
+        pending = ((pending | (new[:, None, :] & edge_ok[:, :, None])
+                    | (inflight_old & requeue))
+                   & ~known)
+        inflight_old = jnp.where(requeue, inflight, inflight_old) & ~known
+        inflight = inflight & ~known & ~requeue
+
+        # --- pick ranges to gossip ---
+        lanes, sent = self._select_ranges(pending)
+        if not self.eager_resend:
+            pending = pending & ~sent
+            inflight = inflight | sent
+
+        # --- digest send (parent protocol) ---
+        owed, have_owed, w_send, b_out, c_out = self._digest_out(
+            seen, state["owed"], arrived)
+
+        # --- assemble edge output: digest lane 0, range lanes 1.. ---
+        send_digest = have_owed & edge_ok
+        e_valid = jnp.concatenate(
+            [send_digest[:, :, None]]
+            + [(h & edge_ok)[:, :, None] for h, _lo, _n in lanes], axis=2)
+        e_type = jnp.concatenate(
+            [jnp.full((N, D, 1), T_DIGEST, I32),
+             jnp.full((N, D, self.per_nb), T_GRANGE, I32)], axis=2)
+        e_a = jnp.concatenate(
+            [w_send[:, :, None]] + [lo[:, :, None] for _h, lo, _n in lanes],
+            axis=2)
+        e_b = jnp.concatenate(
+            [b_out[:, :, None]] + [n[:, :, None] for _h, _lo, n in lanes],
+            axis=2)
+        e_c = jnp.concatenate(
+            [c_out[:, :, None], jnp.zeros((N, D, self.per_nb), I32)],
+            axis=2)
+        edge_out = EdgeMsgs(valid=e_valid, type=e_type, a=e_a, b=e_b,
+                            c=e_c)
+
+        return ({"seen": seen, "pending": pending, "inflight": inflight,
+                 "inflight_old": inflight_old, "owed": owed},
+                edge_out, client_out)
+
+    # --- host boundary ---
+
+    def request_for_op(self, op):
+        if op["f"] == "broadcast-batch":
+            return {"type": "batch", "values": list(op["value"])}
+        return {"type": "read"}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "batch":
+            vals = body["values"]
+            if not vals:
+                raise EncodeCapacityError("empty distilled batch")
+            ids = []
+            for v in vals:
+                i = intern.peek(v)
+                if i is None:
+                    if len(intern) >= self.V:
+                        raise EncodeCapacityError(
+                            f"broadcast value table full ({self.V}); "
+                            f"raise --max-values")
+                    i = intern.id(v)
+                ids.append(i)
+            n = len(ids)
+            lo = min(ids)
+            # the distiller contract: a batch is deduped, and its ids —
+            # fresh sequential interns of sorted fresh values — form one
+            # contiguous run. A violation is a batcher bug; failing the
+            # op definitely beats shipping a record whose columnar form
+            # silently claims values the batch does not contain.
+            if len(set(ids)) != n:
+                raise EncodeCapacityError(
+                    "duplicate value inside a distilled batch")
+            if sorted(ids) != list(range(lo, lo + n)) or n > self.max_batch:
+                raise EncodeCapacityError(
+                    f"distilled batch ids not one contiguous run of <= "
+                    f"{self.max_batch} (got {n} ids from {lo})")
+            return (T_BATCH, lo, n, range_checksum(lo, n))
+        return (T_BREAD, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_BATCH_OK:
+            return {"type": "batch_ok", "lo": int(a), "n": int(b),
+                    "proof": int(c)}
+        if t == T_BREAD_OK:
+            return {"type": "read_ok"}
+        return super(BroadcastProgram, self).decode_body(t, a, b, c,
+                                                         intern)
+
+    def completion_payload(self, op, body, payload, intern):
+        if body["type"] == "batch_ok":
+            lo, n = body["lo"], body["n"]
+            return {**op, "type": "ok",
+                    "value": {"lo": lo, "n": n, "proof": body["proof"],
+                              "expanded": [intern.value(i)
+                                           for i in range(lo, lo + n)
+                                           if i < len(intern._rev)]}}
+        return super().completion_payload(op, body, payload, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] == "batch_ok":
+            return self.completion_payload(op, body, None, intern)
+        if body["type"] == "read_ok":
+            seen_row = np.asarray(read_state()["seen"])
+            return {**op, "type": "ok",
+                    "value": [intern.value(int(i))
+                              for i in np.nonzero(seen_row)[0]]}
+        return {**op, "type": "ok"}

@@ -55,17 +55,23 @@ T_FETCH = 32       # a = group<<10 | member, b = key<<16 | (cursor+1),
                    # c = max batch — the cursor poll: NO full-prefix reply
 T_FETCH_OK = 33    # a = key<<16 | (start+1), b = n entries (host slices
                    # the replica log [start, start+n), state_reads_final)
-T_GCOMMIT = 34     # a = group<<26 | member<<16 | gen16, b|c = packed
-                   # offsets (keys 0..3; group mode caps key_count at 4)
-T_GCOMMIT_OK = 35  # a = generation, b|c echo the applied offsets
+T_GCOMMIT = 34     # a = bank<<30 | group<<26 | member<<16 | gen16,
+                   # b|c = packed offsets for the 4-key BANK the header
+                   # names (bank 0 = keys 0..3, bank 1 = keys 4..7):
+                   # commits are split per bank, lifting the old
+                   # key_count <= 4 cap to 8 without widening the wire
+T_GCOMMIT_OK = 35  # a = bank<<30 | gen30, b|c echo the applied offsets
 T_REBAL = 36       # fenced commit: a = NEW generation, b|c = packed
                    # assignment — the member was evicted/staled and has
                    # been rejoined; it must re-fetch from committed
-T_GLIST = 37       # a = group
-T_GLIST_OK = 38    # a = generation, b|c = packed committed offsets (+1)
+T_GLIST = 37       # a = bank<<30 | group
+T_GLIST_OK = 38    # a = bank<<30 | gen30, b|c = packed committed
+                   # offsets (+1) of the requested bank
 
 MAX_PACK_KEYS = 6  # 2 x 16-bit fields per wire word, 3 words
-MAX_GROUPS = 8     # group id must fit the packed gcommit header
+BANK_KEYS = 4      # keys per commit bank (2 words x 2 fields)
+MAX_GROUP_KEYS = 2 * BANK_KEYS   # group mode: 2 banks on the wire
+MAX_GROUPS = 16    # group id rides 4 header bits (26..29; bank is 30)
 # member ids ride two field widths: 10 bits in the sub/fetch/gcommit
 # request headers AND 8-bit member+1 fields in the packed ASSIGNMENT
 # replies (_pack_assign/_unpack_assign) — the tighter one binds
@@ -74,9 +80,14 @@ COORDINATOR = 0    # node holding the authoritative committed-offset row
                    # AND the consumer-group coordinator state
 
 
-def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
+def _pack_offsets(offs: dict, keys: int, base: int = 0) \
+        -> tuple[int, int, int]:
+    """Packs offsets for keys [base, base+keys) into up to three wire
+    words (field j = key base+j). Legacy commits pack keys 0..K-1 across
+    a|b|c; banked group commits pack one 4-key bank into b|c."""
     words = [0, 0, 0]
-    for k in range(keys):
+    for j in range(keys):
+        k = base + j
         o = offs.get(str(k), offs.get(k))
         if o is None:
             continue
@@ -84,33 +95,37 @@ def _pack_offsets(offs: dict, keys: int) -> tuple[int, int, int]:
             raise EncodeCapacityError(
                 f"kafka committed offset {o} exceeds the 15-bit wire "
                 f"field")
-        words[k // 2] |= (int(o) + 1) << (16 * (k % 2))
+        words[j // 2] |= (int(o) + 1) << (16 * (j % 2))
     return words[0], words[1], words[2]
 
 
 def _device_pack(vals_plus1):
     """[N, K] int32 (0 = absent, v+1 otherwise) -> three packed wire
     words, the device half of _pack_offsets' convention (16-bit fields,
-    2 per word)."""
+    2 per word). Covers keys 0..5 only — the legacy 3-word reply forms
+    (T_POLL_OK/T_LIST_OK); group mode past 4 keys uses the banked
+    2-word forms instead, so the truncation is never observable there."""
     words = [jnp.zeros((vals_plus1.shape[0],), I32) for _ in range(3)]
-    for k in range(vals_plus1.shape[1]):
+    for k in range(min(vals_plus1.shape[1], MAX_PACK_KEYS)):
         words[k // 2] = words[k // 2] | (vals_plus1[:, k]
                                          << (16 * (k % 2)))
     return words
 
 
-def _unpack_offsets(a: int, b: int, c: int, keys: int) -> dict:
+def _unpack_offsets(a: int, b: int, c: int, keys: int,
+                    base: int = 0) -> dict:
     out = {}
-    for k in range(keys):
-        v = ((a, b, c)[k // 2] >> (16 * (k % 2))) & 0xFFFF
+    for j in range(keys):
+        v = ((a, b, c)[j // 2] >> (16 * (j % 2))) & 0xFFFF
         if v:
-            out[str(k)] = v - 1
+            out[str(base + j)] = v - 1
     return out
 
 
 def _unpack_assign(b: int, c: int, keys: int) -> dict:
     """Two packed assignment words -> {key: member or None}: 8-bit
-    member+1 fields, four per word (keys 0..3 in b, 4..5 in c)."""
+    member+1 fields, four per word (keys 0..3 in b, 4..7 in c — the
+    full group-mode key range)."""
     out = {}
     for k in range(keys):
         v = ((b, c)[k // 4] >> (8 * (k % 4))) & 0xFF
@@ -136,10 +151,15 @@ class KafkaProgram(NodeProgram):
     def __init__(self, opts, nodes):
         super().__init__(opts, nodes)
         self.K = int(opts.get("key_count") or 4)
-        if self.K > MAX_PACK_KEYS:
+        # group mode lifts the legacy 3-word cap to 8 via banked commit
+        # words; the classic full-prefix forms stay bound by the 3-word
+        # replies (poll lengths / committed maps ride a|b|c)
+        key_cap = (MAX_GROUP_KEYS if int(opts.get("kafka_groups") or 0)
+                   else MAX_PACK_KEYS)
+        if self.K > key_cap:
             raise ValueError(
-                f"kafka supports at most {MAX_PACK_KEYS} keys on the "
-                f"wire (got {self.K}); raise MAX_PACK_KEYS or shard")
+                f"kafka supports at most {key_cap} keys on the wire for "
+                f"this mode (got {self.K}); shard keys across runs")
         rate = float(opts.get("rate") or 0.0)
         tl = float(opts.get("time_limit") or 0.0)
         # cap+1 must fit a 15-bit packed length field ((len+1) << 16
@@ -178,10 +198,10 @@ class KafkaProgram(NodeProgram):
             if self.G > MAX_GROUPS:
                 raise ValueError(f"kafka_groups {self.G} exceeds the "
                                  f"packed header width ({MAX_GROUPS})")
-            if self.K > 4:
-                raise ValueError(
-                    f"group mode packs commit offsets into two wire "
-                    f"words: key_count must be <= 4 (got {self.K})")
+            # commit offsets ride two wire words = one 4-key bank; the
+            # header's bank bit splits wider key spaces across
+            # alternating per-bank commits (key_count <= 8)
+            self._list_bank = 0     # glist bank rotation (host side)
             self.M = int(opts.get("concurrency") or len(nodes))
             if self.M > MAX_MEMBERS:
                 raise ValueError(f"{self.M} workers exceed the member "
@@ -290,8 +310,10 @@ class KafkaProgram(NodeProgram):
             s["log_overflow"] = s["log_overflow"] + (
                 is_send & owner & full).astype(I32)
             # commit: node 0 maxes its committed row with the packed map
+            # (legacy 3-word form: keys 0..5 only — group mode past 4
+            # keys commits through the banked T_GCOMMIT instead)
             is_cmt = v & (t == T_COMMIT) & is_leader0
-            for k in range(K):
+            for k in range(min(K, MAX_PACK_KEYS)):
                 w = (client_in.a[:, j], client_in.b[:, j],
                      client_in.c[:, j])[k // 2]
                 o = ((w >> (16 * (k % 2))) & 0xFFFF) - 1
@@ -347,11 +369,14 @@ class KafkaProgram(NodeProgram):
                 g_mis = v & ((t == T_SUB) | (t == T_GCOMMIT)
                              | (t == T_GLIST)) & ~is_leader0
                 # header fields (sub/fetch pack group<<10|member in a;
-                # gcommit packs group<<26|member<<16|gen16; glist a=group)
+                # gcommit packs bank<<30|group<<26|member<<16|gen16;
+                # glist a = bank<<30|group). The bank bit names which
+                # 4-key window b|c cover (keys 4*bank .. 4*bank+3).
                 g_any = jnp.clip(
                     jnp.where(is_gcmt, (aw >> 26) & 0xF,
-                              jnp.where(is_glist, aw, aw >> 10)),
+                              jnp.where(is_glist, aw & 0xFFFF, aw >> 10)),
                     0, G - 1)
+                bank = jnp.where(is_gcmt | is_glist, (aw >> 30) & 1, 0)
                 m_any = jnp.clip(
                     jnp.where(is_gcmt, (aw >> 16) & 0x3FF, aw & 1023),
                     0, M - 1)
@@ -385,17 +410,36 @@ class KafkaProgram(NodeProgram):
                 asg_b, asg_c = self._pack_assign(asg_g)
                 # non-fenced commit: advance the group's committed marks
                 # for the member's OWN assigned keys only (per-key
-                # fencing); the stored mark is monotone by construction
+                # fencing), within the bank the header names; the stored
+                # mark is monotone by construction
                 for k in range(K):
-                    w = bw if k < 2 else cw
-                    o = ((w >> (16 * (k % 2))) & 0xFFFF) - 1
-                    mine = ok_cmt & (asg_g[:, k] == m_any)
+                    kb, kj = divmod(k, BANK_KEYS)
+                    w = bw if kj < 2 else cw
+                    o = ((w >> (16 * (kj % 2))) & 0xFFFF) - 1
+                    mine = ok_cmt & (asg_g[:, k] == m_any) & (bank == kb)
                     s["gcommitted"] = s["gcommitted"].at[
                         me, g_any, k].max(jnp.where(mine, o, -1),
                                           unique_indices=True)
-                glw = _device_pack(jnp.where(
-                    s["gcommitted"][me, g_any] >= 0,
-                    s["gcommitted"][me, g_any] + 1, 0))
+                # glist reply words: the requested bank's 4-key window
+                # of the group's committed floors
+                gplus = jnp.where(s["gcommitted"][me, g_any] >= 0,
+                                  s["gcommitted"][me, g_any] + 1, 0)
+
+                def _bank_words(base):
+                    wb = jnp.zeros((N,), I32)
+                    wc = jnp.zeros((N,), I32)
+                    for kj in range(min(BANK_KEYS, K - base)):
+                        f = gplus[:, base + kj] << (16 * (kj % 2))
+                        if kj < 2:
+                            wb = wb | f
+                        else:
+                            wc = wc | f
+                    return wb, wc
+                glb, glc = _bank_words(0)
+                if K > BANK_KEYS:
+                    wb1, wc1 = _bank_words(BANK_KEYS)
+                    glb = jnp.where(bank == 1, wb1, glb)
+                    glc = jnp.where(bank == 1, wc1, glc)
                 # cursor fetch, served from ANY replica: b = key<<16 |
                 # (start+1); n entries exist at reply-round length, the
                 # host slices the append-only log (state_reads_final)
@@ -413,18 +457,24 @@ class KafkaProgram(NodeProgram):
                                                   jnp.where(is_glist,
                                                             T_GLIST_OK,
                                                             rtype)))))
+                # commit/list replies echo the bank in bit 30 so the
+                # decode labels the offsets with their true keys
+                # (bank 0 leaves the word bit-identical to the pre-bank
+                # wire format)
+                gen_banked = (new_gen & 0x3FFFFFFF) | (bank << 30)
                 ra = jnp.where(is_fetch, (fk << 16) | (fcur + 1),
-                               jnp.where(is_sub | fenced | ok_cmt
-                                         | is_glist, new_gen, ra))
+                               jnp.where(ok_cmt | is_glist, gen_banked,
+                                         jnp.where(is_sub | fenced,
+                                                   new_gen, ra)))
                 rb = jnp.where(is_fetch, fn,
                                jnp.where(is_sub | fenced, asg_b,
                                          jnp.where(ok_cmt, bw,
                                                    jnp.where(is_glist,
-                                                             glw[0],
+                                                             glb,
                                                              rb))))
                 rc = jnp.where(is_sub | fenced, asg_c,
                                jnp.where(ok_cmt, cw,
-                                         jnp.where(is_glist, glw[1],
+                                         jnp.where(is_glist, glc,
                                                    jnp.where(is_fetch,
                                                              0, rc))))
                 say = say | is_fetch | is_sub | fenced | ok_cmt \
@@ -564,13 +614,29 @@ class KafkaProgram(NodeProgram):
                     "batch": self.poll_batch}
         if f == "commit":
             # claim = everything this member consumed on its OWN keys;
-            # an empty claim still round-trips (it is the heartbeat)
-            offs = {k: sub["cursors"][k] - 1 for k in sub["keys"]
-                    if sub["cursors"].get(k, 0) > 0}
+            # an empty claim still round-trips (it is the heartbeat).
+            # The wire carries one 4-key bank per commit: successive
+            # commits rotate over the banks that hold claims, so every
+            # key's floor still advances (at half the per-key cadence
+            # past 4 keys) and the heartbeat cadence is unchanged.
+            offs_all = {k: sub["cursors"][k] - 1 for k in sub["keys"]
+                        if sub["cursors"].get(k, 0) > 0}
+            banks = sorted({k // BANK_KEYS for k in offs_all}) or [0]
+            cb = int(sub.get("cb", 0))
+            sub["cb"] = cb + 1
+            bank = banks[cb % len(banks)]
+            offs = {k: v for k, v in offs_all.items()
+                    if k // BANK_KEYS == bank}
             return {"type": "commit_group", "group": g,
                     "member": member, "gen": int(sub["gen"]),
-                    "offsets": offs}
-        return {"type": "list_group", "group": g}
+                    "bank": bank, "offsets": offs}
+        bank = 0
+        if self.K > BANK_KEYS:
+            # lists rotate banks too: floors past key 3 stay observable
+            bank = self._list_bank % ((self.K + BANK_KEYS - 1)
+                                      // BANK_KEYS)
+            self._list_bank += 1
+        return {"type": "list_group", "group": g, "bank": bank}
 
     def request_for_op(self, op):
         f = op["f"]
@@ -604,7 +670,8 @@ class KafkaProgram(NodeProgram):
         if t == "poll":
             return (T_POLL, 0, 0, 0)
         if t == "commit_offsets":
-            a, b, c = _pack_offsets(body["offsets"], self.K)
+            a, b, c = _pack_offsets(body["offsets"],
+                                    min(self.K, MAX_PACK_KEYS))
             return (T_COMMIT, a, b, c)
         if t == "subscribe":
             return (T_SUB,
@@ -621,13 +688,19 @@ class KafkaProgram(NodeProgram):
                     (int(body["key"]) << 16) | (cur + 1),
                     int(body["batch"]))
         if t == "commit_group":
-            w = _pack_offsets(body["offsets"], self.K)
+            bank = int(body.get("bank", 0))
+            w = _pack_offsets(body["offsets"],
+                              min(BANK_KEYS, self.K - BANK_KEYS * bank),
+                              base=BANK_KEYS * bank)
             return (T_GCOMMIT,
-                    (int(body["group"]) << 26)
+                    (bank << 30)
+                    | (int(body["group"]) << 26)
                     | (int(body["member"]) << 16)
                     | (int(body["gen"]) & 0xFFFF), w[0], w[1])
         if t == "list_group":
-            return (T_GLIST, int(body["group"]), 0, 0)
+            return (T_GLIST,
+                    (int(body.get("bank", 0)) << 30)
+                    | int(body["group"]), 0, 0)
         return (T_LIST, 0, 0, 0)
 
     def decode_body(self, t, a, b, c, intern):
@@ -636,15 +709,17 @@ class KafkaProgram(NodeProgram):
         if t == T_COMMIT_OK:
             return {"type": "commit_offsets_ok",
                     "offsets": _unpack_offsets(int(a), int(b), int(c),
-                                               self.K)}
+                                               min(self.K,
+                                                   MAX_PACK_KEYS))}
         if t == T_LIST_OK:
             return {"type": "list_committed_offsets_ok",
                     "offsets": _unpack_offsets(int(a), int(b), int(c),
-                                               self.K)}
+                                               min(self.K,
+                                                   MAX_PACK_KEYS))}
         if t == T_POLL_OK:
             return {"type": "poll_ok",
                     "lens": _unpack_offsets(int(a), int(b), int(c),
-                                            self.K)}
+                                            min(self.K, MAX_PACK_KEYS))}
         if t == T_SUB_OK:
             return {"type": "subscribe_ok", "gen": int(a),
                     "assign": _unpack_assign(int(b), int(c), self.K)}
@@ -652,16 +727,24 @@ class KafkaProgram(NodeProgram):
             return {"type": "fetch_ok", "key": int(a) >> 16,
                     "start": (int(a) & 0xFFFF) - 1, "n": int(b)}
         if t == T_GCOMMIT_OK:
-            return {"type": "commit_group_ok", "gen": int(a),
-                    "offsets": _unpack_offsets(int(b), int(c), 0,
-                                               self.K)}
+            bank = (int(a) >> 30) & 1
+            return {"type": "commit_group_ok",
+                    "gen": int(a) & 0x3FFFFFFF,
+                    "offsets": _unpack_offsets(
+                        int(b), int(c), 0,
+                        min(BANK_KEYS, self.K - BANK_KEYS * bank),
+                        base=BANK_KEYS * bank)}
         if t == T_REBAL:
             return {"type": "rebalance", "gen": int(a),
                     "assign": _unpack_assign(int(b), int(c), self.K)}
         if t == T_GLIST_OK:
-            return {"type": "list_group_ok", "gen": int(a),
-                    "offsets": _unpack_offsets(int(b), int(c), 0,
-                                               self.K)}
+            bank = (int(a) >> 30) & 1
+            return {"type": "list_group_ok",
+                    "gen": int(a) & 0x3FFFFFFF, "bank": bank,
+                    "offsets": _unpack_offsets(
+                        int(b), int(c), 0,
+                        min(BANK_KEYS, self.K - BANK_KEYS * bank),
+                        base=BANK_KEYS * bank)}
         if t == T_ERROR:
             return {"type": "error", "code": int(a),
                     "text": ("log full" if int(a) == 14 else
@@ -697,6 +780,7 @@ class KafkaProgram(NodeProgram):
                               "known_commit": dict(s["known_commit"]),
                               "keys": list(s.get("keys") or ())}
                           for m, s in self._subs.items()}
+            st["lb"] = self._list_bank
         return st
 
     def set_host_state(self, st):
@@ -706,6 +790,7 @@ class KafkaProgram(NodeProgram):
         if self.G:
             self._subs = {m: dict(s)
                           for m, s in (st.get("subs") or {}).items()}
+            self._list_bank = int(st.get("lb", 0))
 
     def _learn_commits(self, member: int, offsets: dict):
         sub = self._subs.get(member)
@@ -762,9 +847,18 @@ class KafkaProgram(NodeProgram):
             offs = {str(k): int(v)
                     for k, v in body.get("offsets", {}).items()}
             self._learn_commits(member, offs)
-            return {**op, "type": "ok",
-                    "value": {"group": member % self.G,
-                              "offsets": offs}}
+            value = {"group": member % self.G, "offsets": offs}
+            if self.K > BANK_KEYS:
+                # banked lists are PARTIAL observations: declare which
+                # keys this reply covers so the checker's floor rule
+                # audits exactly the observed bank (an absent key
+                # outside the bank is unobserved, not a regression)
+                bank = int(body.get("bank", 0))
+                value["keys"] = [
+                    str(k) for k in range(BANK_KEYS * bank,
+                                          min(BANK_KEYS * (bank + 1),
+                                              self.K))]
+            return {**op, "type": "ok", "value": value}
         if body["type"] == "send_ok":
             k, m = op["value"]
             return {**op, "type": "ok",

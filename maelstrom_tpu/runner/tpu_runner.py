@@ -217,6 +217,15 @@ class TpuNetStats(Checker):
             groups["servers"]["msgs-per-op"] = (
                 groups["servers"]["msg-count"] / op_count)
         out = dict(groups)
+        if getattr(getattr(self.runner, "cfg", None), "unit_words", ()):
+            # batched payload rows: logical client-op units transported,
+            # next to the raw message counters (ops-per-message is the
+            # batching win the bench records; doc/perf.md)
+            out["sent-units"] = c["sent_units"]
+            out["recv-units"] = c["recv_units"]
+            if c["recv_all"]:
+                out["units-per-msg"] = round(
+                    c["recv_units"] / c["recv_all"], 3)
         out["lost"] = c["lost"]
         out["dropped-partition"] = c["dropped_partition"]
         out["dropped-overflow"] = c["dropped_overflow"]
@@ -342,7 +351,13 @@ class TpuRunner:
             ms_per_round=self.ms_per_round,
             partition_groups=n if "partition" in faults else 1,
             enable_stall=bool({"kill", "pause"} & faults),
-            enable_duplication="duplicate" in faults)
+            enable_duplication="duplicate" in faults,
+            # batched payload rows (doc/perf.md): programs whose wire
+            # records carry multiple client ops per message declare the
+            # (type, count-word) mapping; the net books units next to
+            # raw message counts
+            unit_words=tuple(getattr(self.program, "unit_words", ())
+                             or ()))
         # continuous generator mode (doc/streams.md): client ops are
         # pre-scheduled onto their offered-rate rounds and injected
         # INSIDE the compiled scan window (the open-world stream), so

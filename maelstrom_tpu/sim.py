@@ -149,6 +149,14 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         mid=net.next_mid + jnp.cumsum(replies.valid.astype(I32)) - 1)
     net = net.replace(next_mid=net.next_mid + n_all)
     st0 = net.stats
+    if cfg.unit_words:
+        # reply units (batch acks carry their op count): booked on both
+        # sides — the zero-latency client channel sends and delivers in
+        # the same round
+        ru = T.payload_units(cfg, flat.type, (flat.a, flat.b, flat.c),
+                             flat.valid)
+        st0 = st0.replace(sent_units=st0.sent_units + ru,
+                          recv_units=st0.recv_units + ru)
     net = net.replace(stats=st0.replace(
         sent_all=st0.sent_all + n_all,
         recv_all=st0.recv_all + n_all,
@@ -243,6 +251,20 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
 
     n_sent = jnp.sum(edge_out.valid.astype(I32))
     st = net.stats
+    if cfg.unit_words:
+        # batch-expansion accounting (doc/perf.md "batched atomic
+        # broadcast"): a distilled range lane is ONE edge message
+        # carrying n client-op units; booking them here keeps the
+        # ops-per-message economics visible in every result next to the
+        # raw counters (the jaxpr gate audits this path like the rest
+        # of the round body)
+        st = st.replace(
+            sent_units=st.sent_units + T.payload_units(
+                cfg, edge_out.type, (edge_out.a, edge_out.b, edge_out.c),
+                edge_out.valid),
+            recv_units=st.recv_units + T.payload_units(
+                cfg, edge_in.type, (edge_in.a, edge_in.b, edge_in.c),
+                edge_in.valid))
     st = st.replace(
         sent_all=st.sent_all + n_sent,
         sent_servers=st.sent_servers + n_sent,

@@ -6,12 +6,13 @@ from __future__ import annotations
 
 
 def registry() -> dict:
-    from . import (broadcast, echo, g_counter, g_set, kafka, lin_kv,
-                   lin_mutex, pn_counter, txn_list_append,
+    from . import (broadcast, broadcast_batched, echo, g_counter, g_set,
+                   kafka, lin_kv, lin_mutex, pn_counter, txn_list_append,
                    txn_rw_register, unique_ids)
     return {
         "lin-mutex": lin_mutex.workload,
         "broadcast": broadcast.workload,
+        "broadcast-batched": broadcast_batched.workload,
         "echo": echo.workload,
         "g-set": g_set.workload,
         "g-counter": g_counter.workload,

@@ -1,0 +1,42 @@
+"""Batched atomic broadcast workload (serving
+`nodes/broadcast_batched.py`; doc/perf.md "batched atomic broadcast").
+
+The Chop Chop-shaped sibling of the broadcast workload: client values
+aggregate into *distilled* batches on the sending side — the columnar
+batch assembler (`generators.BatchCounting`) dedups and sorts each raw
+submission burst in one numpy pass — and one batch rides ONE simulated
+network message. Receivers expand batches under a server-side expansion
+proof, and `BatchedBroadcastChecker` both audits every proof and grades
+the expanded per-value stream with the stock set-full fold (verdict
+bit-equal to the unbatched broadcast checker on the same op stream).
+
+TPU-path only: batching is a property of the built-in batched node's
+wire format; the bin path's JSON protocol has no batch RPC."""
+
+from __future__ import annotations
+
+from .. import generators as g
+from ..checkers.set_full import BatchedBroadcastChecker
+from . import BaseClient
+
+
+class BatchedBroadcastClient(BaseClient):
+    def invoke(self, test, op):
+        raise RuntimeError(
+            "broadcast-batched is a TPU-path workload "
+            "(--node tpu:broadcast-batched); the bin path has no "
+            "distilled-batch RPC")
+
+
+def workload(opts: dict) -> dict:
+    batch_max = int(opts.get("batch_max") or 16)
+    dup_rate = float(opts.get("batch_dup_rate", 0.25))
+    return {
+        "client": BatchedBroadcastClient(opts["net"]),
+        "generator": g.mix([
+            g.BatchCounting(batch_max=batch_max, dup_rate=dup_rate,
+                            seed=int(opts.get("seed", 0))),
+            g.Repeat({"f": "read"})]),
+        "final_generator": g.each_thread({"f": "read", "final": True}),
+        "checker": BatchedBroadcastChecker(),
+    }

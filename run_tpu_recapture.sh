@@ -43,6 +43,15 @@ step artifacts/bench-fleet-r6.json 2400 env BENCH_MODE=fleet python bench.py
 #     host_cpus/devices ride the record
 step artifacts/bench-stream-r7.json 2400 env BENCH_MODE=stream python bench.py
 
+# 1d. batched atomic broadcast (BENCH_MODE=broadcast_batched, ISSUE 9):
+#     distilled-batch node vs eager-resend at equal node count —
+#     headline `value` = batched client-ops/s, `vs_baseline` = the
+#     speedup (>= 2x acceptance; CPU r01 measured 50x at 1024 nodes).
+#     The default run (step 1) also embeds the same record, so old and
+#     new metric land in one recapture either way
+step artifacts/bench-batched-r8.json 2400 \
+    env BENCH_MODE=broadcast_batched python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -51,4 +51,31 @@ if [ "${STREAM_SMOKE:-1}" = "1" ]; then
     echo "== stream smoke valid =="
 fi
 
+# Batched-broadcast smoke (ISSUE 9, doc/perf.md): the distilled-batch
+# node end to end — plain, sharded (--mesh 1,2 over the forced 2-device
+# CPU mesh), and under the combined nemesis soup — expansion proofs
+# verified and the set-full verdict graded on every path. The batcher
+# step fns themselves are traced by the static audit above (the
+# broadcast-batched entry in analyze's program set). BATCHED_SMOKE=0
+# skips.
+if [ "${BATCHED_SMOKE:-1}" = "1" ]; then
+    echo "== batched-broadcast smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w broadcast-batched \
+        --node tpu:broadcast-batched --node-count 5 --rate 20 \
+        --time-limit 2 --seed 7 --no-audit \
+        --store "$SMOKE_STORE" > /dev/null
+    python -m maelstrom_tpu test -w broadcast-batched \
+        --node tpu:broadcast-batched --node-count 5 --rate 20 \
+        --time-limit 2 --seed 7 --mesh 1,2 --no-audit \
+        --store "$SMOKE_STORE" > /dev/null
+    python -m maelstrom_tpu test -w broadcast-batched \
+        --node tpu:broadcast-batched --node-count 5 --rate 20 \
+        --time-limit 3 --seed 11 --no-audit \
+        --nemesis kill,pause,partition,duplicate \
+        --nemesis-interval 0.7 --store "$SMOKE_STORE" > /dev/null
+    rm -rf "$SMOKE_STORE"
+    echo "== batched-broadcast smoke valid =="
+fi
+
 echo "== static gate clean =="

@@ -1,0 +1,376 @@
+"""Batched atomic broadcast (ISSUE 9, doc/perf.md): the distilled-batch
+node, the columnar batch assembler, the expansion-proof checker —
+adversarial fixtures each a definite fail, batched-vs-unbatched verdict
+bit-equality on seeded soups — plus mesh and nemesis composition and the
+net-layer units accounting."""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import ops_projection as _ops
+from maelstrom_tpu import core
+from maelstrom_tpu import generators as g
+from maelstrom_tpu.checkers.set_full import (BatchedBroadcastChecker,
+                                             BroadcastChecker,
+                                             expand_batched_history,
+                                             verify_batch_proofs)
+from maelstrom_tpu.history import History
+from maelstrom_tpu.net import tpu as T
+from maelstrom_tpu.nodes import EncodeCapacityError, Intern, get_program
+from maelstrom_tpu.nodes.broadcast_batched import (T_BATCH,
+                                                   range_checksum)
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+from maelstrom_tpu.sim import make_run_fn, make_sim
+
+STORE = "/tmp/maelstrom-tpu-test-store"
+
+
+# --- the columnar distiller (generators.BatchCounting) ----------------------
+
+
+def _ctx(free=(0, 1), t=0):
+    return {"time": t, "free": list(free), "processes": list(free)}
+
+
+def test_batch_counting_distills_sorted_dedup_contiguous():
+    gen = g.BatchCounting(batch_max=8, dup_rate=0.9, seed=3)
+    seen, raw_total = [], 0
+    for _ in range(20):
+        res, gen = gen.op(_ctx())
+        vals = res["value"]
+        assert vals == sorted(set(vals))            # deduped + sorted
+        # fresh sequential values: each batch continues where the
+        # previous ended (contiguity is what id-compression relies on)
+        assert vals[0] == (seen[-1] + 1 if seen else 0)
+        assert vals == list(range(vals[0], vals[0] + len(vals)))
+        # the raw (pre-distill) stream was at-least-once: dup_rate=0.9
+        # makes raw-count > len(vals) on most draws
+        assert res["raw-count"] >= len(vals)
+        raw_total += res["raw-count"]
+        seen.extend(vals)
+    # distillation never leaks a duplicate downstream, and at dup_rate
+    # 0.9 over 20 batches the raw stream definitely contained some
+    assert len(seen) == len(set(seen))
+    assert raw_total > len(seen)        # dedup actually collapsed work
+
+
+def test_batch_counting_pending_poll_is_rng_neutral_and_picklable():
+    gen = g.BatchCounting(batch_max=8, dup_rate=0.5, seed=7)
+    # PENDING polls (no free worker) must not advance the stream
+    res, gen2 = gen.op(_ctx(free=()))
+    assert res == g.PENDING
+    r1, _ = gen2.op(_ctx())
+    gen_b = g.BatchCounting(batch_max=8, dup_rate=0.5, seed=7)
+    r2, _ = gen_b.op(_ctx())
+    assert r1["value"] == r2["value"]
+    # checkpointable: the generator tree pickles round trip
+    blob = pickle.dumps(gen2)
+    r3, _ = pickle.loads(blob).op(_ctx())
+    assert r3["value"] == r1["value"]
+
+
+# --- wire encode guards ------------------------------------------------------
+
+
+def _program(n=9, **opts):
+    o = {"topology": "grid", "max_values": 64, "latency": {"mean": 0}}
+    o.update(opts)
+    return get_program("broadcast-batched", o,
+                       [f"n{i}" for i in range(n)])
+
+
+def test_encode_rejects_malformed_batches():
+    p = _program()
+    intern = Intern()
+    t, a, b, c = p.encode_body({"type": "batch", "values": [0, 1, 2]},
+                               intern)
+    assert (t, a, b) == (T_BATCH, 0, 3) and c == range_checksum(0, 3)
+    with pytest.raises(EncodeCapacityError, match="duplicate"):
+        p.encode_body({"type": "batch", "values": [3, 3]}, Intern())
+    with pytest.raises(EncodeCapacityError, match="contiguous"):
+        # ids 0 and 2 fresh-interned in this order are contiguous, so
+        # force a gap through a pre-populated table
+        i2 = Intern()
+        i2.id(0), i2.id(1), i2.id(2)
+        p.encode_body({"type": "batch", "values": [0, 2]}, i2)
+    with pytest.raises(EncodeCapacityError, match="empty"):
+        p.encode_body({"type": "batch", "values": []}, Intern())
+
+
+# --- device protocol ---------------------------------------------------------
+
+
+def _converge(prog, n, inject_rows, rounds=64):
+    cfg = T.NetConfig(n_nodes=n, n_clients=1, pool_cap=64,
+                      inbox_cap=prog.inbox_cap, client_cap=8,
+                      unit_words=tuple(prog.unit_words))
+    sim = make_sim(prog, cfg, seed=0)
+    run_fn = make_run_fn(prog, cfg, collect_client_msgs=True)
+    plan = T.Msgs.empty((rounds, 1))
+    for r0, (lo, nn) in enumerate(inject_rows):
+        plan = plan.replace(
+            valid=plan.valid.at[r0, 0].set(True),
+            src=plan.src.at[r0, 0].set(n),
+            dest=plan.dest.at[r0, 0].set((lo * 7) % n),
+            type=plan.type.at[r0, 0].set(T_BATCH),
+            a=plan.a.at[r0, 0].set(lo),
+            b=plan.b.at[r0, 0].set(nn),
+            c=plan.c.at[r0, 0].set(range_checksum(lo, nn)))
+    sim2, cms = run_fn(sim, plan)
+    return sim2, cms
+
+
+def test_range_gossip_converges_with_fewer_messages_and_exact_proofs():
+    n, V = 9, 64
+    prog = _program(n=n)
+    sim2, cms = _converge(prog, n, [(0, 16), (16, 16), (32, 8)])
+    seen = np.asarray(jax.device_get(sim2.nodes["seen"][:, :40]))
+    assert seen.all()
+    st = T.stats_dict(sim2.net)
+    # one range message moves a whole run: total messages stay far
+    # below the 40 values x 12 grid edges an eager per-value flood pays
+    assert st["recv_all"] < 40 * 12
+    # units booked: every delivered range counts its op payload
+    assert st["recv_units"] > st["recv_all"]
+    # each batch ack carries the exact expansion proof
+    v = np.asarray(cms.valid)
+    acks = [(int(cms.a[r, j]), int(cms.b[r, j]), int(cms.c[r, j]))
+            for r, j in np.argwhere(v)
+            if int(cms.type[r, j]) == 21]
+    assert sorted(acks) == [
+        (0, 16, range_checksum(0, 16)),
+        (16, 16, range_checksum(16, 16)),
+        (32, 8, range_checksum(32, 8))]
+
+
+def test_eager_resend_mode_converges_too():
+    n = 9
+    prog = _program(n=n, eager_resend=True)
+    sim2, _ = _converge(prog, n, [(0, 32)])
+    assert np.asarray(jax.device_get(sim2.nodes["seen"][:, :32])).all()
+
+
+# --- expansion-proof checker: adversarial fixtures ---------------------------
+
+
+def _batch_pair(h, proc, t0, vals, lo=None, n=None, proof=None,
+                expanded=None):
+    lo = vals[0] if lo is None else lo
+    n = len(vals) if n is None else n
+    proof = range_checksum(lo, n) if proof is None else proof
+    expanded = list(vals) if expanded is None else expanded
+    h.append_row("invoke", "broadcast-batch", list(vals), proc, t0)
+    h.append_row("ok", "broadcast-batch",
+                 {"lo": lo, "n": n, "proof": proof,
+                  "expanded": expanded}, proc, t0 + 1)
+
+
+def _read_pair(h, proc, t0, vals):
+    h.append_row("invoke", "read", None, proc, t0)
+    h.append_row("ok", "read", list(vals), proc, t0 + 1, None, True)
+
+
+def _fixture(mutate=None):
+    h = History()
+    _batch_pair(h, 0, 0, [0, 1, 2])
+    _batch_pair(h, 1, 10, [3, 4])
+    _read_pair(h, 2, 20, [0, 1, 2, 3, 4])
+    if mutate:
+        mutate(h)
+    return h
+
+
+def _errs(h):
+    errors, _stats = verify_batch_proofs(h)
+    return sorted(e["error"] for e in errors)
+
+
+def test_clean_fixture_passes_and_grades():
+    res = BatchedBroadcastChecker().check({}, _fixture())
+    assert res["valid"] is True
+    assert res["proof-errors"] == []
+    assert res["batch-count"] == 2
+    assert res["batched-op-count"] == 5
+    assert res["stable-count"] == 5
+
+
+def test_forged_count_is_definite_fail():
+    def mutate(h):
+        _batch_pair(h, 0, 30, [5, 6, 7], n=9)
+    res = BatchedBroadcastChecker().check({}, _fixture(mutate))
+    assert res["valid"] is False
+    assert "forged-count" in [e["error"] for e in res["proof-errors"]]
+
+
+def test_truncated_batch_is_definite_fail():
+    def mutate(h):
+        # the server acked fewer values than the batch claimed
+        _batch_pair(h, 0, 30, [5, 6, 7], expanded=[5, 6], n=3)
+    assert "truncated-batch" in _errs(_fixture(mutate))
+
+
+def test_duplicated_id_inside_batch_is_definite_fail():
+    def mutate(h):
+        _batch_pair(h, 0, 30, [5, 5, 6])
+    errs = _errs(_fixture(mutate))
+    assert "duplicate-in-batch" in errs
+
+
+def test_forged_proof_is_definite_fail():
+    def mutate(h):
+        _batch_pair(h, 0, 30, [5, 6], proof=12345)
+    assert "forged-proof" in _errs(_fixture(mutate))
+
+
+def test_replayed_batch_is_definite_fail():
+    """The at-least-once hazard the `duplicate` nemesis models: the
+    same distilled range acknowledged twice."""
+    def mutate(h):
+        _batch_pair(h, 0, 30, [0, 1, 2])        # same range as t=0
+    errs = _errs(_fixture(mutate))
+    assert "replayed-batch" in errs
+    res = BatchedBroadcastChecker().check({}, _fixture(mutate))
+    assert res["valid"] is False
+
+
+def test_lost_batched_value_fails_through_setfull():
+    """A value acked inside a batch but absent from every later read is
+    data loss — surfaced by the expanded set-full fold, exactly as the
+    unbatched checker would."""
+    h = History()
+    _batch_pair(h, 0, 0, [0, 1, 2])
+    _read_pair(h, 1, 10, [0, 2])                # 1 vanished
+    _read_pair(h, 2, 20, [0, 2])
+    res = BatchedBroadcastChecker().check({}, h)
+    assert res["valid"] is False
+    assert res["lost"] == [1]
+    assert res["proof-errors"] == []            # proofs were fine
+
+
+# --- batched-vs-unbatched verdict bit-equality -------------------------------
+
+
+def _run(tmp_path, **over):
+    opts = {"workload": "broadcast-batched",
+            "node": "tpu:broadcast-batched", "node_count": 5,
+            "topology": "grid", "rate": 20.0, "time_limit": 2.0,
+            "recovery_s": 0.5, "seed": 11, "journal_rows": False,
+            "store_root": str(tmp_path), "audit": False}
+    opts.update(over)
+    test = core.build_test(opts)
+    test["store_dir"] = str(tmp_path)
+    runner = TpuRunner(test)
+    history = runner.run()
+    return runner, history, test
+
+
+SETFULL_KEYS = ("valid", "attempt-count", "acknowledged-count",
+                "stable-count", "lost-count", "lost", "never-read-count",
+                "never-read", "stale-count", "stale", "worst-stale",
+                "duplicated-count", "duplicated", "stable-latencies")
+
+
+def test_verdict_bit_equal_to_unbatched_checker_on_seeded_soup(tmp_path):
+    """The acceptance pin: on a real seeded run, the batched checker's
+    set-full section is bit-equal (dict equality, every field) to the
+    stock BroadcastChecker run over the expanded op stream."""
+    _runner, history, test = _run(tmp_path)
+    batched = BatchedBroadcastChecker().check(test, history)
+    unbatched = BroadcastChecker().check(
+        test, expand_batched_history(history))
+    assert {k: batched[k] for k in SETFULL_KEYS} == \
+        {k: unbatched[k] for k in SETFULL_KEYS}
+    assert batched["valid"] is True
+    assert batched["stable-count"] == batched["batched-op-count"] > 0
+
+
+def test_verdict_bit_equal_under_combined_nemesis(tmp_path):
+    """Same pin under --nemesis kill,partition,duplicate: proofs hold
+    and the expanded grade equals the stock checker's."""
+    _runner, history, test = _run(
+        tmp_path, time_limit=3.0, recovery_s=2.0,
+        nemesis={"kill", "partition", "duplicate"},
+        nemesis_interval=0.8, seed=13)
+    batched = BatchedBroadcastChecker().check(test, history)
+    unbatched = BroadcastChecker().check(
+        test, expand_batched_history(history))
+    assert {k: batched[k] for k in SETFULL_KEYS} == \
+        {k: unbatched[k] for k in SETFULL_KEYS}
+    assert batched["proof-errors"] == []
+    assert batched["lost-count"] == 0
+
+
+# --- e2e + composition -------------------------------------------------------
+
+
+def test_batched_broadcast_tpu_e2e():
+    res = core.run(dict(store_root=STORE, seed=7, rate=20.0,
+                        time_limit=2.0, journal_rows=False,
+                        workload="broadcast-batched",
+                        node="tpu:broadcast-batched",
+                        node_count=5, topology="grid", audit=False))
+    w = res["workload"]
+    assert res["valid"] is True, w
+    assert w["valid"] is True
+    assert w["proof-errors"] == []
+    assert w["stable-count"] == w["batched-op-count"] > 0
+    # batching on the wire: far fewer messages than client-op units
+    net = res["net"]
+    assert net["recv-units"] > net["all"]["recv-count"] > 0
+    assert net["units-per-msg"] > 1.0
+
+
+@pytest.mark.multichip
+def test_batched_broadcast_mesh_bit_identical(tmp_path):
+    """`--mesh 1,2` changes placement only: same-seed sharded runs are
+    op-for-op identical and grade identically."""
+    _r1, h1, t1 = _run(tmp_path / "a")
+    r2, h2, t2 = _run(tmp_path / "b", mesh="1,2")
+    assert len(h1) > 10
+    assert _ops(h1) == _ops(h2)
+    assert r2.mesh is not None and r2.mesh.shape["sp"] == 2
+    assert BatchedBroadcastChecker().check(t1, h1) == \
+        BatchedBroadcastChecker().check(t2, h2)
+
+
+@pytest.mark.slow
+def test_batched_broadcast_full_soup_and_mesh_nemesis(tmp_path):
+    """Heavy composition: the combined five-fault soup, plain and
+    sharded, stays valid with zero proof errors and zero losses."""
+    for sub, mesh in ((tmp_path / "p", None), (tmp_path / "m", "1,2")):
+        res = core.run(dict(
+            store_root=str(sub), seed=17, rate=25.0, time_limit=4.0,
+            recovery_s=2.0, journal_rows=False,
+            workload="broadcast-batched",
+            node="tpu:broadcast-batched", node_count=5,
+            topology="grid", mesh=mesh, audit=False,
+            nemesis={"kill", "pause", "partition", "duplicate"},
+            nemesis_interval=0.9))
+        w = res["workload"]
+        assert res["valid"] is True, (mesh, w)
+        assert w["proof-errors"] == [] and w["lost-count"] == 0
+
+
+# --- net-layer units parity --------------------------------------------------
+
+
+def test_hostnet_units_parity():
+    """The host net books the same batch-units convention as the TPU
+    net: a body with `batch_units: n` is one message carrying n ops."""
+    from maelstrom_tpu.net.host import HostNet
+    net = HostNet()
+    net.add_node("n0"), net.add_node("n1")
+    net.send({"src": "n0", "dest": "n1",
+              "body": {"type": "x", "msg_id": 1, "batch_units": 5}})
+    net.send({"src": "n1", "dest": "n0",
+              "body": {"type": "y", "msg_id": 2}})
+    assert net.sent_units == 6
+    assert net.batched_msgs == 1
+    assert net.recv("n1", 10).body["batch_units"] == 5
+    assert net.recv_units == 5

@@ -300,8 +300,62 @@ def test_kafka_rebalance_driven_by_kill():
     assert fenced or (gens and max(gens) > 1), (len(fenced), gens)
 
 
+def test_banked_offset_pack_roundtrip():
+    """The bank-split commit wire (key_count <= 8): offsets for keys
+    4..7 pack into the same two words as keys 0..3, labeled by the
+    header's bank bit on the way back."""
+    from maelstrom_tpu.nodes.kafka import (BANK_KEYS, _pack_offsets,
+                                           _unpack_offsets)
+    offs = {"4": 7, "6": 123, "7": 0}
+    a, b, _c = _pack_offsets(offs, BANK_KEYS, base=BANK_KEYS)
+    got = _unpack_offsets(a, b, 0, BANK_KEYS, base=BANK_KEYS)
+    assert got == offs
+    # bank 0 stays bit-identical to the pre-bank layout
+    offs0 = {"0": 1, "3": 9}
+    a0, b0, _ = _pack_offsets(offs0, BANK_KEYS)
+    assert _unpack_offsets(a0, b0, 0, BANK_KEYS) == offs0
+
+
+def test_wide_keys_kill_nemesis_regression():
+    """The PR 7 known restriction, lifted: key_count=8 group mode under
+    the kill nemesis grades valid, and committed floors advance in BOTH
+    banks (commits rotate banks, lists declare their observed bank)."""
+    res = core.run(dict(store_root=STORE, seed=5, rate=60.0,
+                        time_limit=4.0, journal_rows=False,
+                        workload="kafka", node="tpu:kafka",
+                        node_count=5, concurrency=8, key_count=8,
+                        kafka_groups=2, session_timeout_ms=400.0,
+                        timeout_ms=800, recovery_s=1.5,
+                        nemesis={"kill"}, nemesis_interval=0.9,
+                        audit=False))
+    w = res["workload"]
+    assert res["valid"] is True, w
+    assert w["valid"] is True
+    assert w["acked-sends"] > 10
+    banks = {0: set(), 1: set()}
+    for o in res_history(STORE):
+        if o.get("f") in ("commit", "list") and o["type"] == "ok" \
+                and isinstance(o.get("value"), dict):
+            for k in (o["value"].get("offsets") or {}):
+                banks[int(k) // 4].add(k)
+    assert banks[0] and banks[1], banks
+
+
+def res_history(store):
+    with open(f"{store}/latest/history.jsonl") as f:
+        return [json.loads(line) for line in f]
+
+
 def test_kafka_groups_rejects_bad_shapes():
-    with pytest.raises(ValueError, match="key_count"):
-        _program(groups=2, key_count=6)
+    # banked commits lifted the old key_count<=4 / groups<=8 caps: group
+    # mode now runs up to 8 keys (two 4-key commit banks) and 16 groups
+    # (4 header bits); past those the wire genuinely has no room
+    with pytest.raises(ValueError, match="keys"):
+        _program(groups=2, key_count=9)
     with pytest.raises(ValueError, match="kafka_groups"):
-        _program(groups=9)
+        _program(groups=17)
+    # classic mode keeps the 3-word cap (poll/commit replies ride a|b|c)
+    with pytest.raises(ValueError, match="keys"):
+        _program(groups=0, key_count=7)
+    _program(groups=2, key_count=8)     # the lifted shape builds
+    _program(groups=16, key_count=6)
