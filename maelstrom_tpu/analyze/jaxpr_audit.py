@@ -37,7 +37,11 @@ from dataclasses import dataclass, field
 from . import Finding
 
 # Workload -> built-in TPU node program (the `--node tpu:<x>` namespace;
-# lin-mutex rides the lin-kv program).
+# lin-mutex rides the lin-kv program). A dict value names a program
+# whose audit entry is not 1:1 with a workload — it carries the node
+# spec, the workload it serves, and any extra build options (e.g. the
+# role-partitioned compartment cluster, which derives its own node
+# count from the role spec).
 WORKLOAD_NODES = {
     "broadcast": "tpu:broadcast",
     "broadcast-batched": "tpu:broadcast-batched",
@@ -46,6 +50,14 @@ WORKLOAD_NODES = {
     "lin-kv": "tpu:lin-kv", "txn-list-append": "tpu:txn-list-append",
     "unique-ids": "tpu:unique-ids", "kafka": "tpu:kafka",
     "txn-rw-register": "tpu:txn-rw-register",
+    # role-partitioned families (doc/compartment.md): the compartment
+    # consensus cluster and the in-cluster service nodes — both step
+    # heterogeneous role slices inside the one compiled round, so the
+    # gate traces the RolePartition step path too
+    "compartment": {"workload": "lin-kv", "node": "tpu:compartment",
+                    "opts": {"node_count": None}},
+    "lin-tso": {"workload": "lin-tso", "node": "tpu:services",
+                "opts": {"node_count": None}},
 }
 DEFAULT_PROGRAMS = tuple(WORKLOAD_NODES)
 # mesh variants are traced for one pool-path and one edge-path program;
@@ -327,12 +339,19 @@ def production_step_specs(workload: str, mesh: str | None = None,
     from ..runner.tpu_runner import TpuRunner
     from ..sim import make_round_fn, make_scan_fn
 
-    node = WORKLOAD_NODES.get(workload)
-    if node is None:
+    entry = WORKLOAD_NODES.get(workload)
+    if entry is None:
         raise ValueError(f"unknown workload {workload!r}; expected one of "
                          f"{sorted(WORKLOAD_NODES)}")
-    opts = {"workload": workload, "node": node, "node_count": 5,
-            "time_limit": 1.0}
+    if isinstance(entry, dict):
+        node = entry["node"]
+        opts = {"workload": entry.get("workload", workload),
+                "node": node, "node_count": 5, "time_limit": 1.0,
+                **entry.get("opts", {})}
+    else:
+        node = entry
+        opts = {"workload": workload, "node": node, "node_count": 5,
+                "time_limit": 1.0}
     if mesh:
         opts["mesh"] = mesh
     with _force_donation(donate):

@@ -108,7 +108,13 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # batched atomic broadcast (doc/perf.md): the
                     # distiller's batch shape and the value-table
                     # capacity both change the op stream / wire records
-                    "batch_max", "batch_dup_rate", "max_values")
+                    "batch_max", "batch_dup_rate", "max_values",
+                    # role-partitioned clusters (doc/compartment.md):
+                    # tier sizes, capacities, and fault targeting all
+                    # shape the wire traffic and the nemesis schedule
+                    "roles", "service_roles", "nemesis_targets",
+                    "leader_slots", "proxy_slots", "compartment_inbox",
+                    "compartment_retry", "log_cap", "kv_keys")
 
 
 class CheckpointError(RuntimeError):

@@ -28,8 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["broadcast", "broadcast-batched", "echo",
                             "g-set", "g-counter",
                             "pn-counter", "lin-kv", "lin-mutex",
-                            "txn-list-append", "unique-ids", "kafka",
-                            "txn-rw-register"],
+                            "lin-tso", "txn-list-append", "unique-ids",
+                            "kafka", "txn-rw-register"],
                    help="What workload to run")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
@@ -59,6 +59,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "kill,pause,partition,duplicate,weather)")
     t.add_argument("--nemesis-interval", type=float, default=10.0,
                    help="Seconds between nemesis operations")
+    t.add_argument("--roles", default=None,
+                   help="Role-partitioned cluster tiers for --node "
+                        "tpu:compartment (doc/compartment.md): "
+                        "'proxies=P,acceptors=RxC,replicas=R' (a plain "
+                        "acceptor count is a 1-row grid). Sizes the "
+                        "cluster: 1 leader + P + R*C + R nodes — drop "
+                        "--node-count and let --roles derive it")
+    t.add_argument("--service-roles", default=None,
+                   help="In-cluster service tiers for --node "
+                        "tpu:services: 'lin-tso=1,seq-kv=1,lww-kv=N' "
+                        "(default 5 nodes; doc/compartment.md)")
+    t.add_argument("--nemesis-targets", default=None,
+                   help="Scope fault packages to named role groups "
+                        "(role-partitioned nodes only), e.g. "
+                        "'kill=proxies,partition=acceptor-col-0': kill/"
+                        "pause sample within the group, partition cuts "
+                        "the group off the rest of the cluster. Groups "
+                        "come from the node family's fault_groups "
+                        "(role names, acceptor grid rows/columns) or "
+                        "literal node names; '+' joins several")
     t.add_argument("--nemesis-seed", type=int, default=None,
                    help="Decouple the fault-schedule RNG from --seed "
                         "(default: follow --seed). This is how a single "
@@ -315,7 +335,8 @@ def opts_from_args(args) -> dict:
     for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
               "check_workers", "fleet", "fleet_sweep", "nemesis_seed",
               "kafka_groups", "session_timeout_ms", "poll_batch",
-              "continuous_window_ms", "batch_max", "max_values"):
+              "continuous_window_ms", "batch_max", "max_values",
+              "roles", "service_roles", "nemesis_targets"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

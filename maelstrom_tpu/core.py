@@ -91,6 +91,13 @@ DEFAULTS = dict(
     # up to batch_max fresh values per batch, a batch_dup_rate fraction
     # of duplicate re-submissions collapsed by distillation
     batch_max=16, batch_dup_rate=0.25,
+    # role-partitioned clusters (doc/compartment.md): `roles` sizes the
+    # compartmentalized consensus tiers (--node tpu:compartment;
+    # "proxies=P,acceptors=RxC,replicas=R"), `service_roles` the
+    # in-cluster service nodes (--node tpu:services), and
+    # `nemesis_targets` scopes fault packages to named role groups
+    # ("kill=proxies,partition=acceptor-col-0")
+    roles=None, service_roles=None, nemesis_targets=None,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user
@@ -180,10 +187,20 @@ class FleetSpec:
 
 def parse_nodes(opts: dict) -> list[str]:
     """--node-count N overrides --nodes, generating n0..n(N-1)
-    (reference `core.clj:197-204`)."""
+    (reference `core.clj:197-204`). Role-partitioned node families
+    (--node tpu:compartment / tpu:services) derive their node count
+    from the role spec when neither is given."""
     if opts.get("node_count"):
         return [f"n{i}" for i in range(opts["node_count"])]
-    return opts.get("nodes") or ["n0", "n1", "n2", "n3", "n4"]
+    if opts.get("nodes"):
+        return opts["nodes"]
+    spec = str(opts.get("node") or "")
+    if spec.startswith("tpu:"):
+        from .nodes import partition_node_count
+        n = partition_node_count(spec[len("tpu:"):], opts)
+        if n:
+            return [f"n{i}" for i in range(n)]
+    return ["n0", "n1", "n2", "n3", "n4"]
 
 
 def build_test(opts: dict) -> dict:
@@ -289,8 +306,13 @@ def _run(test: dict, net: HostNet, test_dir: str) -> dict:
 
     db = HostDB(net, test["bin"], test.get("bin_args") or [],
                 service_seed=test["seed"])
+    # host-path role targeting: bin processes have no role partition,
+    # so target groups resolve against literal node names only
+    targets = nem.resolve_targets(test.get("nemesis_targets"), {},
+                                  test["nodes"])
     test["nemesis"] = (nem.CombinedNemesis(net, test["nodes"],
-                                           seed=test["seed"], db=db)
+                                           seed=test["seed"], db=db,
+                                           targets=targets)
                        if test["nemesis_pkg"]["generator"] is not None
                        else None)
     log.info("Running test %s with nodes %s", test["name"], test["nodes"])

@@ -146,6 +146,89 @@ GRUDGES = [split_half, isolate_node, bridge, majorities_ring,
            one_way_halves]
 
 
+def isolate_set(nodes, cut):
+    """Role-targeted partition: the `cut` subset is severed from every
+    other node, both directions (the `--nemesis-targets
+    partition=<group>` shape — e.g. cutting one acceptor-grid column
+    off a compartmentalized cluster). Deterministic: no RNG draw."""
+    cs = set(cut)
+    cut = [n for n in nodes if n in cs]
+    rest = [n for n in nodes if n not in cs]
+    grudge = {d: set(cut) for d in rest}
+    grudge.update({d: set(rest) for d in cut})
+    return f"isolated {cut}", grudge
+
+
+# --- role-targeted fault scoping ------------------------------------------
+
+
+# faults whose decisions pick NODES and can therefore be scoped;
+# duplicate/weather are cluster-global knobs, so a target spec for them
+# would be silently meaningless — rejected up front instead
+TARGETABLE_FAULTS = ("kill", "pause", "partition")
+
+
+def parse_targets(spec) -> dict:
+    """`--nemesis-targets kill=proxies,partition=acceptor-col-0` ->
+    {fault: [group tokens]} ('+' joins multiple groups per fault)."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        items = {f: (list(v) if isinstance(v, (list, tuple))
+                     else str(v).split("+"))
+                 for f, v in spec.items()}
+    else:
+        items = {}
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            f, sep, val = part.partition("=")
+            if not sep or not val.strip():
+                raise ValueError(
+                    f"--nemesis-targets: expected fault=group, got "
+                    f"{part!r}")
+            items[f.strip()] = [t.strip() for t in val.split("+")
+                                if t.strip()]
+    for f in items:
+        if f not in TARGETABLE_FAULTS:
+            raise ValueError(
+                f"--nemesis-targets: {f!r} is not a targetable fault "
+                f"(node-picking faults only: {list(TARGETABLE_FAULTS)})")
+    return items
+
+
+def resolve_targets(spec, groups: dict, nodes) -> dict | None:
+    """Resolves a target spec's group tokens against the node family's
+    fault groups (`NodeProgram.fault_groups`: role names, acceptor grid
+    rows/columns, ...) plus literal node names. Returns
+    {fault: [node names]} for `NemesisDecisions`, or None when no
+    targeting was requested."""
+    parsed = parse_targets(spec)
+    if not parsed:
+        return None
+    node_set = set(nodes)
+    out: dict = {}
+    for fault, tokens in parsed.items():
+        names: list = []
+        for tok in tokens:
+            if tok in groups:
+                members = groups[tok]
+            elif tok in node_set:
+                members = [tok]
+            else:
+                raise ValueError(
+                    f"--nemesis-targets: unknown group {tok!r} for "
+                    f"{fault!r}; known groups: {sorted(groups)} "
+                    f"(or a literal node name)")
+            names += [n for n in members if n not in names]
+        if not names:
+            raise ValueError(f"--nemesis-targets: empty target set for "
+                             f"{fault!r}")
+        out[fault] = names
+    return out
+
+
 def grudge_matrix(nodes, grudge):
     """Converts a dest -> blocked-srcs grudge map into the directional
     block representation the TPU network installs
@@ -175,14 +258,21 @@ class NemesisDecisions:
     the decision sequence of each package does not depend on how the
     packages happen to interleave in real vs virtual time."""
 
-    def __init__(self, nodes, seed: int = 0):
+    def __init__(self, nodes, seed: int = 0, targets: dict | None = None):
         self.nodes = list(nodes)
         self.seed = seed
         self.rngs = {f: random.Random(f"{seed}:{f}") for f in FAULTS}
         # legacy alias: pre-combined checkpoints stored a single rng
         self.rng = self.rngs["partition"]
+        # role-targeted scoping (resolve_targets): {fault: [node names]}
+        # restricts kill/pause sampling to the named pool and turns
+        # partition draws into the deterministic isolate-the-set grudge
+        self.targets = dict(targets or {})
 
     def next_grudge(self):
+        tg = self.targets.get("partition")
+        if tg:
+            return isolate_set(self.nodes, tg)
         rng = self.rngs["partition"]
         return rng.choice(GRUDGES)(self.nodes, rng)
 
@@ -191,10 +281,13 @@ class NemesisDecisions:
         minority, so clusters of n >= 3 keep quorum through the fault
         window. Degenerate clusters (n <= 2) have no non-empty strict
         minority; there the package still targets one node, accepting a
-        transient quorum loss that heals at the stop op."""
+        transient quorum loss that heals at the stop op. With a
+        role-targeted pool (`--nemesis-targets`), the minority is taken
+        OF THE POOL — 'kill a proxy' kills within the proxy tier."""
         rng = self.rngs[fault]
-        k = rng.randint(1, max(1, (len(self.nodes) - 1) // 2))
-        return sorted(rng.sample(self.nodes, k))
+        pool = self.targets.get(fault) or self.nodes
+        k = rng.randint(1, max(1, (len(pool) - 1) // 2))
+        return sorted(rng.sample(pool, k))
 
     def next_kill_targets(self):
         return self._minority("kill")
@@ -237,8 +330,9 @@ class CombinedNemesis(NemesisDecisions):
     node processes (via the DB): the host-path analogue of
     jepsen.nemesis.combined/compose-packages."""
 
-    def __init__(self, net, nodes, seed: int = 0, db=None):
-        super().__init__(nodes, seed)
+    def __init__(self, net, nodes, seed: int = 0, db=None,
+                 targets: dict | None = None):
+        super().__init__(nodes, seed, targets=targets)
         self.net = net
         self.db = db
         self.killed: list = []

@@ -199,6 +199,14 @@ def involves_client(cfg: NetConfig, src, dest):
     return (src >= cfg.n_nodes) | (dest >= cfg.n_nodes)
 
 
+def cat_lanes(*batches: Msgs) -> Msgs:
+    """Concatenates [N, L_i] Msgs batches along the lane axis — the
+    outbox-assembly helper node programs use to join per-purpose lane
+    groups into one outbox."""
+    return jax.tree.map(lambda *fs: jnp.concatenate(fs, axis=1),
+                        *batches)
+
+
 def payload_units(cfg: NetConfig, types, words, valid):
     """Total client-op units over a masked message batch: 1 per valid
     message, except registered batch types (`cfg.unit_words`), which

@@ -317,10 +317,33 @@ def register(cls):
 def get_program(name: str, opts: dict, nodes: list[str]) -> NodeProgram:
     # import for side effect: program registration
     from . import (echo, broadcast, broadcast_batched,  # noqa: F401
-                   gset, pn_counter, raft,  # noqa: F401
-                   txn_list_append, txn_rw_register, unique_ids,  # noqa: F401
+                   compartment, gset, pn_counter, raft,  # noqa: F401
+                   services, txn_list_append,  # noqa: F401
+                   txn_rw_register, unique_ids,  # noqa: F401
                    kafka)  # noqa: F401
+    if name.startswith("solo:"):
+        # any built-in program wrapped as a ONE-role RolePartition:
+        # pure delegation, bit-identical histories (the role-partition
+        # regression-pin configuration, tests/test_role_partition.py)
+        from ..sim import RolePartition
+        inner = get_program(name[len("solo:"):], opts, nodes)
+        return RolePartition(opts, nodes, [("r0", inner)])
     if name not in PROGRAMS:
         raise ValueError(f"no built-in TPU node program {name!r}; "
                          f"have {sorted(PROGRAMS)}")
     return PROGRAMS[name](opts, nodes)
+
+
+def partition_node_count(name: str, opts: dict) -> int | None:
+    """Node count a role-partitioned program family derives from its
+    role spec (None for homogeneous programs, whose count the user
+    picks freely). `core.parse_nodes` consults this so
+    `--node tpu:compartment --roles proxies=2,...` sizes the cluster
+    without a redundant --node-count."""
+    if name == "compartment":
+        from .compartment import roles_node_count
+        return roles_node_count(opts.get("roles"))
+    if name == "services":
+        from .services import roles_node_count
+        return roles_node_count(opts.get("service_roles"))
+    return None

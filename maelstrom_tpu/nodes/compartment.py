@@ -1,0 +1,650 @@
+"""Compartmentalized consensus: role-partitioned proxy/acceptor/replica
+tiers serving lin-kv.
+
+"Scaling Replicated State Machines with Compartmentalization" (PAPERS.md,
+arxiv 2012.15762) decouples MultiPaxos' leader into independently-scalable
+compartments: the leader only SEQUENCES (assigns log slots — O(1) messages
+per command), stateless proxy leaders take over the quadratic work
+(broadcast phase-2a to the acceptor grid, collect the quorum, teach the
+replicas), and a replica tier applies the chosen log and answers clients.
+Client throughput then scales with the PROXY count, not the leader's
+message budget — the claim `bench.py BENCH_MODE=compartment` measures
+(doc/compartment.md).
+
+This is the first user of `sim.RolePartition` (the multi-program
+node-state tree): four roles over contiguous node-id ranges,
+
+    node 0                      leader     (sequencer, durable)
+    nodes [1, 1+P)              proxies    (stateless, VOLATILE: a kill
+                                            wipes them; the leader's
+                                            resend rebuilds their work)
+    nodes [1+P, 1+P+A)          acceptors  (rows x cols grid, durable)
+    nodes [1+P+A, N)            replicas   (apply the log, durable)
+
+selected with `--node tpu:compartment --roles proxies=P,acceptors=RxC,
+replicas=R` and graded by the stock linearizable register checker.
+
+Protocol (stable-leader MultiPaxos phase 2, simplified: the leader never
+changes, so ballots are unnecessary — slot ownership is unique by
+construction and every stage is idempotent):
+
+  1. clients send read/write/cas to the leader (reads are logged too, so
+     every op linearizes at its apply point, like `nodes/raft.py`);
+  2. the leader assigns the next slot, parks the command in a durable
+     in-flight table, and sends T_ASSIGN to proxy `slot % P` — resending
+     on a retry tick until the command is fully executed, which makes
+     the leader the retry root: a crashed (volatile) proxy loses
+     nothing, the next resend rebuilds its state;
+  3. the proxy broadcasts T_P2A to all acceptors and collects T_P2B acks
+     per GRID ROW; any complete row is a write quorum (the paper's
+     flexible grid quorums: phase-1 — which we never run — would read
+     columns, so killing a full column stalls writes but loses nothing);
+  4. on quorum the proxy teaches all replicas (T_LEARN) until every
+     replica acks STORAGE (T_EXEC), then reports T_DONE to the leader;
+  5. replicas store learned commands at their slots — EVERY deduped
+     learn is acked the moment it is durably stored, so a slot's
+     leader->proxy->replica chain completes independently of every
+     other slot (acking at the apply point instead deadlocks: the
+     proxy table fills with high slots that can never apply while the
+     low slots they wait on can never be admitted) — and apply strictly
+     in slot order, the DESIGNATED replica (`slot % R`) answering the
+     client with the value computed at the apply point. Re-learns of
+     stored slots re-ack (never re-reply), so lost acks always recover;
+     liveness holds because the leader retires a slot only once all
+     replicas stored it, so every gap below a stored slot is itself a
+     slot the leader is still pushing to storage.
+
+Loss, partitions, duplication, pause, and kill therefore only delay:
+duplicates are slot-keyed no-ops, resends are idempotent overwrites of
+identical values, and the only permanent state is fsynced-before-action
+(leader table, acceptor grid, replica log — `durable_keys = None`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..net.tpu import I32, Msgs, cat_lanes as _cat_lanes
+from ..sim import RolePartition
+from . import NodeProgram, register
+from .raft import (LinKVWire, T_READ, T_WRITE, T_CAS,
+                   OP_WRITE, OP_CAS, OP_READ)
+
+# client wire codes (shared with raft via LinKVWire): 10..15
+T_ERR = 1
+T_READ_OK = 11
+T_WRITE_OK = 13
+T_CAS_OK = 15
+# compartment RPCs
+T_ASSIGN = 30    # leader -> proxy:    a = client<<16|slot, b = cmd, c = mid
+T_P2A = 31       # proxy -> acceptor:  a = slot, b = cmd
+T_P2B = 32       # acceptor -> proxy:  a = slot, b = acceptor grid index
+T_LEARN = 33     # proxy -> replica:   a = client<<16|slot, b = cmd, c = mid
+T_EXEC = 34      # replica -> proxy:   a = slot, b = replica index
+T_DONE = 35      # proxy -> leader:    a = slot
+
+_DEFAULT_ROLES = {"proxies": 2, "rows": 2, "cols": 2, "replicas": 2}
+DEFAULT_ROLES = "proxies=2,acceptors=2x2,replicas=2"
+
+
+def parse_roles(spec) -> dict:
+    """`--roles proxies=P,acceptors=RxC,replicas=R` -> {proxies, rows,
+    cols, replicas}; omitted roles keep their defaults. A plain
+    acceptor count A is a 1 x A grid (single row: the write quorum is
+    all acceptors)."""
+    spec = spec or DEFAULT_ROLES
+    out = {"proxies": None, "rows": None, "cols": None, "replicas": None}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, val = part.partition("=")
+        k, val = k.strip(), val.strip()
+        if not sep or not val:
+            raise ValueError(f"--roles: expected name=count, got {part!r}")
+        if k == "proxies":
+            out["proxies"] = int(val)
+        elif k == "acceptors":
+            if "x" in val:
+                r, c = val.split("x", 1)
+                out["rows"], out["cols"] = int(r), int(c)
+            else:
+                out["rows"], out["cols"] = 1, int(val)
+        elif k == "replicas":
+            out["replicas"] = int(val)
+        else:
+            raise ValueError(
+                f"--roles: unknown role {k!r} (expected proxies, "
+                f"acceptors, replicas)")
+    for k, v in out.items():
+        if v is None:
+            out[k] = _DEFAULT_ROLES[k]
+        elif v < 1:
+            raise ValueError(f"--roles: {k} must be >= 1, got {v}")
+    return out
+
+
+def roles_node_count(spec) -> int:
+    r = parse_roles(spec)
+    return 1 + r["proxies"] + r["rows"] * r["cols"] + r["replicas"]
+
+
+class Layout:
+    """Static shape of one compartmentalized cluster, shared by every
+    role program so bases, capacities, and retry pacing can never
+    disagree."""
+
+    def __init__(self, opts: dict, n_nodes: int):
+        r = parse_roles(opts.get("roles"))
+        self.P = r["proxies"]
+        self.rows, self.cols = r["rows"], r["cols"]
+        self.A = self.rows * self.cols
+        self.R = r["replicas"]
+        self.n_nodes = n_nodes
+        self.leader = 0
+        self.p_base = 1
+        self.a_base = 1 + self.P
+        self.r_base = 1 + self.P + self.A
+        want = 1 + self.P + self.A + self.R
+        if want != n_nodes:
+            raise ValueError(
+                f"--roles {opts.get('roles')!r} needs {want} nodes "
+                f"(1 leader + {self.P} proxies + {self.A} acceptors + "
+                f"{self.R} replicas) but the cluster has {n_nodes}; "
+                f"drop --node-count/--nodes and let --roles size it")
+        # slot capacity scales with the expected op count like raft's
+        # log (every client op, reads included, takes a slot)
+        rate = float(opts.get("rate") or 0.0)
+        tl = float(opts.get("time_limit") or 0.0)
+        expected = int(2 * rate * tl) + 256
+        self.cap = int(opts.get("log_cap",
+                                min(max(256, expected), 0x7FFF)))
+        self.keys = int(opts.get("kv_keys", 256))
+        conc = int(opts.get("concurrency") or n_nodes)
+        # leader in-flight table: the sequencer's fixed capacity (the
+        # bench sweep holds it constant while P varies)
+        self.QL = int(opts.get("leader_slots", max(32, 2 * conc)))
+        # per-proxy in-flight table: the proxy tier's unit of capacity
+        self.QP = int(opts.get("proxy_slots", 8))
+        self.K = int(opts.get("compartment_inbox", 8))
+        self.AP = self.K              # replica apply chunk per round
+        self.retry = int(opts.get("compartment_retry", 10))
+        # packed-word field widths: slot 15 bits, client 15 bits,
+        # key 12 bits + 2-bit op + two value bytes in the cmd word
+        if self.cap > 0x7FFF:
+            raise ValueError("log_cap must fit 15-bit slots")
+        if self.keys > 4095:
+            raise ValueError("kv_keys must fit the 12-bit key field")
+        if conc > 0x7FFF:
+            raise ValueError("concurrency must fit 15-bit client ids")
+        self.AR = max(self.A, self.R)
+
+
+def _pack_cmd(key, op, v1, v2):
+    return (key << 18) | (op << 16) | (v1 << 8) | v2
+
+
+def _unpack_cmd(cmd):
+    return ((cmd >> 18) & 0xFFF, (cmd >> 16) & 0x3,
+            (cmd >> 8) & 0xFF, cmd & 0xFF)
+
+
+def _alloc_rows(occupied, want):
+    """Free-row allocation without a sort: rank free rows and wanted
+    entries by prefix sum and pair rank-for-rank. Returns (ok, row):
+    `ok` marks entries that found a row, `row` its index. Scatter
+    targets are unique by construction (distinct ranks -> distinct
+    rows; parked columns get distinct out-of-bounds targets), so the
+    writes may soundly promise unique_indices."""
+    n, Q = occupied.shape
+    free = ~occupied
+    n_free = jnp.sum(free.astype(I32), axis=1)
+    free_rank = jnp.cumsum(free.astype(I32), axis=1) - 1
+    rows_ar = jnp.broadcast_to(jnp.arange(Q, dtype=I32)[None, :], (n, Q))
+    nn = jnp.arange(n, dtype=I32)[:, None]
+    row_by_rank = jnp.zeros((n, Q), I32).at[
+        nn, jnp.where(free, free_rank, Q + rows_ar)].set(
+            rows_ar, mode="drop", unique_indices=True)
+    want_rank = jnp.cumsum(want.astype(I32), axis=1) - 1
+    ok = want & (want_rank < n_free[:, None])
+    row = jnp.take_along_axis(row_by_rank,
+                              jnp.clip(want_rank, 0, Q - 1), axis=1)
+    return ok, row
+
+
+def _put_rows(dst, ok, row, val):
+    """Scatter per-entry values into allocated rows ([n, K] -> [n, Q]);
+    parked entries target distinct out-of-bounds rows (drop)."""
+    n, Q = dst.shape[0], dst.shape[1]
+    K = ok.shape[1]
+    nn = jnp.arange(n, dtype=I32)[:, None]
+    kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+    return dst.at[nn, jnp.where(ok, row, Q + kk)].set(
+        val, mode="drop", unique_indices=True)
+
+
+def _first_per_key(valid, key):
+    """In-round dedup: keeps only the first valid entry per key among
+    the K inbox lanes (duplicated RPCs — resends, the duplicate
+    nemesis — must not double-apply within one round, and deduped
+    writes may promise unique scatter indices)."""
+    K = valid.shape[1]
+    earlier = (jnp.arange(K, dtype=I32)[None, :]
+               < jnp.arange(K, dtype=I32)[:, None])        # [k, j]: j < k
+    same = valid[:, None, :] & (key[:, :, None] == key[:, None, :])
+    dup = (same & earlier[None]).any(axis=2)
+    return valid & ~dup
+
+
+def _match_rows(row_valid, row_slot, msg_valid, msg_slot):
+    """[n, Q, K] mask: table row q matches inbox entry k on slot."""
+    return (row_valid[:, :, None] & msg_valid[:, None, :]
+            & (row_slot[:, :, None] == msg_slot[:, None, :]))
+
+
+def _out(shape, **fields) -> Msgs:
+    out = Msgs.empty(shape)
+    return out.replace(**fields)
+
+
+class LeaderRole(NodeProgram):
+    """The sequencer: assigns slots, parks commands in a durable
+    in-flight table, resends T_ASSIGN on the retry tick until T_DONE —
+    the retry root that makes volatile proxies safe. O(1) messages per
+    command: its fixed table/inbox budget is the 'leader capacity' the
+    proxy tier scales past."""
+
+    name = "compartment-leader"
+    durable_keys = None          # sequencer state fsyncs before acting
+
+    def __init__(self, opts, nodes, lay: Layout):
+        super().__init__(opts, nodes)
+        self.lay = lay
+        self.inbox_cap = lay.K
+        self.outbox_cap = lay.QL + lay.K
+
+    def init_state(self):
+        n, Q = self.n_nodes, self.lay.QL
+        z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+        return {"next_slot": z(n),
+                "t_valid": jnp.zeros((n, Q), bool),
+                "t_slot": z(n, Q), "t_cmd": z(n, Q),
+                "t_client": z(n, Q), "t_mid": z(n, Q),
+                "t_last": jnp.full((n, Q), -(1 << 20), I32)}
+
+    def step(self, state, inbox, ctx):
+        lay, rnd = self.lay, ctx["round"]
+        n, Q, K, C = self.n_nodes, lay.QL, lay.K, lay.cap
+        s = dict(state)
+        v = inbox.valid
+
+        # T_DONE: the command executed everywhere — retire its row
+        done = v & (inbox.type == T_DONE)
+        hit = _match_rows(s["t_valid"], s["t_slot"], done, inbox.a)
+        s["t_valid"] = s["t_valid"] & ~hit.any(axis=2)
+
+        # new client commands -> slots + table rows
+        creq = v & ((inbox.type == T_READ) | (inbox.type == T_WRITE)
+                    | (inbox.type == T_CAS))
+        op_of = jnp.where(inbox.type == T_WRITE, OP_WRITE,
+                          jnp.where(inbox.type == T_CAS, OP_CAS, OP_READ))
+        keyk = jnp.clip(inbox.a, 0, lay.keys - 1)
+        wc = (inbox.type == T_WRITE) | (inbox.type == T_CAS)
+        v1 = jnp.clip(jnp.where(wc, inbox.b + 1, 0), 0, 0xFF)
+        v2 = jnp.clip(jnp.where(inbox.type == T_CAS, inbox.c + 1, 0),
+                      0, 0xFF)
+        cmd = _pack_cmd(keyk, op_of, v1, v2)
+        client = jnp.clip(inbox.src - lay.n_nodes, 0, 0x7FFF)
+        ok, row = _alloc_rows(s["t_valid"], creq)
+        ok_rank = jnp.cumsum(ok.astype(I32), axis=1) - 1
+        slot = s["next_slot"][:, None] + ok_rank
+        do = ok & (slot < C)
+        s["t_valid"] = _put_rows(s["t_valid"], do, row, True)
+        s["t_slot"] = _put_rows(s["t_slot"], do, row, slot)
+        s["t_cmd"] = _put_rows(s["t_cmd"], do, row, cmd)
+        s["t_client"] = _put_rows(s["t_client"], do, row, client)
+        s["t_mid"] = _put_rows(s["t_mid"], do, row, inbox.mid)
+        # fresh rows are due immediately (t_last = rnd - retry)
+        s["t_last"] = _put_rows(s["t_last"], do, row, rnd - lay.retry)
+        s["next_slot"] = s["next_slot"] + jnp.sum(do.astype(I32), axis=1)
+
+        # table/slot exhaustion sheds DEFINITELY (error 11: temporarily
+        # unavailable) — visible backpressure, never a silent drop
+        shed = creq & ~do
+        shed_out = _out((n, K), valid=shed, dest=inbox.src,
+                        type=jnp.full((n, K), T_ERR, I32),
+                        a=jnp.full((n, K), 11, I32),
+                        reply_to=inbox.mid)
+
+        # T_ASSIGN resends: every live row on the retry tick
+        due = s["t_valid"] & (rnd - s["t_last"] >= lay.retry)
+        s["t_last"] = jnp.where(due, rnd, s["t_last"])
+        assign_out = _out(
+            (n, Q), valid=due,
+            dest=lay.p_base + (s["t_slot"] % lay.P),
+            type=jnp.full((n, Q), T_ASSIGN, I32),
+            a=(s["t_client"] << 16) | s["t_slot"],
+            b=s["t_cmd"], c=s["t_mid"])
+        return s, _cat_lanes(assign_out, shed_out)
+
+    def quiescent(self, state):
+        return ~state["t_valid"].any()
+
+
+class ProxyRole(NodeProgram):
+    """The stateless fan-out tier: phase-2a broadcast to the acceptor
+    grid, row-quorum collection, then learn-until-every-replica-acks.
+    VOLATILE (`durable_keys = ()`): a crash wipes the table and the
+    leader's resends rebuild it — kill faults exercise exactly the
+    paper's 'any proxy can do any command' property."""
+
+    name = "compartment-proxy"
+    durable_keys = ()            # stateless tier: nothing survives
+
+    def __init__(self, opts, nodes, lay: Layout):
+        super().__init__(opts, nodes)
+        self.lay = lay
+        self.inbox_cap = lay.K
+        self.outbox_cap = lay.QP * lay.AR + lay.QP
+
+    def init_state(self):
+        n, Q, AR = self.n_nodes, self.lay.QP, self.lay.AR
+        z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+        return {"p_valid": jnp.zeros((n, Q), bool),
+                "p_learn": jnp.zeros((n, Q), bool),
+                "p_slot": z(n, Q), "p_cmd": z(n, Q),
+                "p_client": z(n, Q), "p_mid": z(n, Q),
+                "p_last": jnp.full((n, Q), -(1 << 20), I32),
+                "p_acks": jnp.zeros((n, Q, AR), bool)}
+
+    def step(self, state, inbox, ctx):
+        lay, rnd = self.lay, ctx["round"]
+        n, Q, K, AR = self.n_nodes, lay.QP, lay.K, lay.AR
+        s = dict(state)
+        v = inbox.valid
+        idx_ar = jnp.arange(AR, dtype=I32)[None, :]
+        onehot = (inbox.b[:, :, None] == idx_ar[None])        # [n, K, AR]
+
+        # acceptor acks onto phase-2 rows; replica acks onto learn rows
+        p2b = _match_rows(s["p_valid"] & ~s["p_learn"], s["p_slot"],
+                          v & (inbox.type == T_P2B), inbox.a)
+        ex = _match_rows(s["p_valid"] & s["p_learn"], s["p_slot"],
+                         v & (inbox.type == T_EXEC), inbox.a)
+        s["p_acks"] = s["p_acks"] | (
+            ((p2b | ex)[:, :, :, None]) & onehot[:, None]).any(axis=2)
+
+        # every replica acked: retire the row and report T_DONE
+        done = (s["p_valid"] & s["p_learn"]
+                & s["p_acks"][:, :, :lay.R].all(axis=2))
+        done_out = _out(
+            (n, Q), valid=done,
+            dest=jnp.full((n, Q), lay.leader, I32),
+            type=jnp.full((n, Q), T_DONE, I32), a=s["p_slot"])
+        s["p_valid"] = s["p_valid"] & ~done
+
+        # flexible grid quorum: any complete acceptor ROW chooses
+        grid = s["p_acks"][:, :, :lay.A].reshape(n, Q, lay.rows, lay.cols)
+        chosen = (s["p_valid"] & ~s["p_learn"]
+                  & grid.all(axis=3).any(axis=2))
+        s["p_learn"] = s["p_learn"] | chosen
+        s["p_acks"] = jnp.where(chosen[:, :, None], False, s["p_acks"])
+        s["p_last"] = jnp.where(chosen, rnd - lay.retry, s["p_last"])
+
+        # new assignments (slot-keyed dedup: duplicates and re-deliveries
+        # of slots already in the table are no-ops; a full table drops —
+        # the leader's retry tick re-delivers)
+        asg = _first_per_key(v & (inbox.type == T_ASSIGN), inbox.a)
+        slot_in = inbox.a & 0x7FFF
+        known = _match_rows(s["p_valid"], s["p_slot"], asg,
+                            slot_in).any(axis=1)
+        asg = asg & ~known
+        ok, row = _alloc_rows(s["p_valid"], asg)
+        s["p_valid"] = _put_rows(s["p_valid"], ok, row, True)
+        s["p_learn"] = _put_rows(s["p_learn"], ok, row, False)
+        s["p_slot"] = _put_rows(s["p_slot"], ok, row, slot_in)
+        s["p_cmd"] = _put_rows(s["p_cmd"], ok, row, inbox.b)
+        s["p_client"] = _put_rows(s["p_client"], ok, row, inbox.a >> 16)
+        s["p_mid"] = _put_rows(s["p_mid"], ok, row, inbox.c)
+        s["p_last"] = _put_rows(s["p_last"], ok, row, rnd - lay.retry)
+        nn = jnp.arange(n, dtype=I32)[:, None]
+        kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+        s["p_acks"] = s["p_acks"].at[
+            nn, jnp.where(ok, row, Q + kk)].set(False, mode="drop",
+                                                unique_indices=True)
+
+        # fan-out lanes: row q, lane j -> acceptor j (phase 2a) or
+        # replica j (learn), on the retry tick
+        due = s["p_valid"] & (rnd - s["p_last"] >= lay.retry)
+        s["p_last"] = jnp.where(due, rnd, s["p_last"])
+        jj = jnp.broadcast_to(idx_ar[None], (n, Q, AR))
+        learn = s["p_learn"][:, :, None]
+        lane_valid = due[:, :, None] & jnp.where(
+            learn, jj < lay.R, jj < lay.A)
+        lane_dest = jnp.where(learn, lay.r_base + jj, lay.a_base + jj)
+        lane_type = jnp.where(learn, T_LEARN, T_P2A)
+        lane_a = jnp.where(learn,
+                           (s["p_client"][:, :, None] << 16)
+                           | s["p_slot"][:, :, None],
+                           jnp.broadcast_to(s["p_slot"][:, :, None],
+                                            (n, Q, AR)))
+        lane_b = jnp.broadcast_to(s["p_cmd"][:, :, None], (n, Q, AR))
+        lane_c = jnp.where(learn, s["p_mid"][:, :, None], 0)
+        fan_out = _out(
+            (n, Q * AR),
+            valid=lane_valid.reshape(n, Q * AR),
+            dest=lane_dest.reshape(n, Q * AR),
+            type=jnp.broadcast_to(lane_type, (n, Q, AR)
+                                  ).reshape(n, Q * AR),
+            a=lane_a.reshape(n, Q * AR),
+            b=lane_b.reshape(n, Q * AR),
+            c=jnp.broadcast_to(lane_c, (n, Q, AR)).reshape(n, Q * AR))
+        return s, _cat_lanes(fan_out, done_out)
+
+    def quiescent(self, state):
+        return ~state["p_valid"].any()
+
+
+class AcceptorRole(NodeProgram):
+    """One grid cell: stores the command proposed for each slot (single
+    stable proposer: first write is the only value ever proposed;
+    re-accepts are idempotent overwrites) and acks with its grid index
+    so proxies can assemble row quorums. Durable: accepted state
+    fsyncs before the ack leaves."""
+
+    name = "compartment-acceptor"
+    durable_keys = None
+
+    def __init__(self, opts, nodes, lay: Layout):
+        super().__init__(opts, nodes)
+        self.lay = lay
+        self.inbox_cap = lay.K
+        self.outbox_cap = lay.K
+
+    def init_state(self):
+        n, C = self.n_nodes, self.lay.cap
+        return {"acc_cmd": jnp.zeros((n, C), I32),
+                "acc_has": jnp.zeros((n, C), bool)}
+
+    def step(self, state, inbox, ctx):
+        lay = self.lay
+        n, K, C = self.n_nodes, lay.K, lay.cap
+        s = dict(state)
+        p2a = _first_per_key(inbox.valid & (inbox.type == T_P2A),
+                             inbox.a)
+        in_cap = p2a & (inbox.a >= 0) & (inbox.a < C)
+        nn = jnp.arange(n, dtype=I32)[:, None]
+        kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+        tgt = jnp.where(in_cap, jnp.clip(inbox.a, 0, C - 1), C + kk)
+        s["acc_cmd"] = s["acc_cmd"].at[nn, tgt].set(
+            inbox.b, mode="drop", unique_indices=True)
+        s["acc_has"] = s["acc_has"].at[nn, tgt].set(
+            True, mode="drop", unique_indices=True)
+        me = jnp.arange(n, dtype=I32)[:, None]
+        acks = _out((n, K), valid=in_cap, dest=inbox.src,
+                    type=jnp.full((n, K), T_P2B, I32), a=inbox.a,
+                    b=jnp.broadcast_to(me, (n, K)))
+        return s, acks
+
+    def quiescent(self, state):
+        return jnp.array(True)
+
+
+class ReplicaRole(NodeProgram):
+    """The apply tier: learned commands land at their slots and every
+    deduped learn acks back (T_EXEC) the moment it is durably stored —
+    storage acks, NOT apply acks, so one slot's completion never waits
+    on another's (see the module docstring's deadlock note). Commands
+    apply strictly in slot order, and the designated replica
+    (`slot % R`) answers the client with the apply-point value.
+    Re-learns of stored slots re-ack — never re-reply (a duplicate
+    client reply would be stale anyway, but the ack must always be
+    recoverable)."""
+
+    name = "compartment-replica"
+    durable_keys = None
+
+    def __init__(self, opts, nodes, lay: Layout):
+        super().__init__(opts, nodes)
+        self.lay = lay
+        self.inbox_cap = lay.K
+        self.outbox_cap = lay.AP + lay.K
+
+    def init_state(self):
+        n, C = self.n_nodes, self.lay.cap
+        z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
+        return {"r_cmd": z(n, C), "r_client": z(n, C), "r_mid": z(n, C),
+                "r_has": jnp.zeros((n, C), bool),
+                "applied": jnp.full((n,), -1, I32),
+                "kv": z(n, self.lay.keys)}
+
+    def step(self, state, inbox, ctx):
+        lay = self.lay
+        n, K, C = self.n_nodes, lay.K, lay.cap
+        s = dict(state)
+        me = jnp.arange(n, dtype=I32)
+        lr = _first_per_key(inbox.valid & (inbox.type == T_LEARN),
+                            inbox.a & 0x7FFF)
+        slot_in = inbox.a & 0x7FFF
+        in_cap = lr & (slot_in < C)
+        nn = me[:, None]
+        kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+        tgt = jnp.where(in_cap, jnp.clip(slot_in, 0, C - 1), C + kk)
+
+        def put(dst, val):
+            return dst.at[nn, tgt].set(val, mode="drop",
+                                       unique_indices=True)
+        s["r_cmd"] = put(s["r_cmd"], inbox.b)
+        s["r_client"] = put(s["r_client"], inbox.a >> 16)
+        s["r_mid"] = put(s["r_mid"], inbox.c)
+        s["r_has"] = put(s["r_has"], True)
+
+        # storage acks: EVERY deduped learn acks once stored (covers
+        # fresh stores and re-learns of already-stored slots — lost-ack
+        # recovery), so a slot's chain completes independently of the
+        # in-order apply frontier
+        ack_out = _out((n, K), valid=in_cap, dest=inbox.src,
+                       type=jnp.full((n, K), T_EXEC, I32), a=slot_in,
+                       b=jnp.broadcast_to(me[:, None], (n, K)))
+
+        # in-order apply, one chunk per round (a CAS may read the key
+        # the previous step wrote: the kv chain is inherently sequential)
+        lanes = []
+        for _j in range(lay.AP):
+            idx = s["applied"] + 1
+            safe = jnp.clip(idx, 0, C - 1)
+            active = (idx < C) & jnp.take_along_axis(
+                s["r_has"], safe[:, None], axis=1)[:, 0]
+            cmd = jnp.take_along_axis(s["r_cmd"], safe[:, None],
+                                      axis=1)[:, 0]
+            client = jnp.take_along_axis(s["r_client"], safe[:, None],
+                                         axis=1)[:, 0]
+            mid = jnp.take_along_axis(s["r_mid"], safe[:, None],
+                                      axis=1)[:, 0]
+            key, op, v1, v2 = _unpack_cmd(cmd)
+            cur_v = jnp.take_along_axis(s["kv"], key[:, None],
+                                        axis=1)[:, 0]
+            cas_ok = (op == OP_CAS) & (cur_v == v1) & (cur_v > 0)
+            do_write = active & ((op == OP_WRITE) | cas_ok)
+            new_v = jnp.where(op == OP_WRITE, v1, v2)
+            s["kv"] = s["kv"].at[
+                me, jnp.where(do_write, key, lay.keys)].set(
+                    new_v, mode="drop", unique_indices=True)
+            s["applied"] = jnp.where(active, idx, s["applied"])
+            # the designated replica answers the client with the
+            # apply-point value (storage was acked at the learn)
+            desig = active & ((idx % lay.R) == me)
+            rtype = jnp.where(
+                op == OP_READ,
+                jnp.where(cur_v > 0, T_READ_OK, T_ERR),
+                jnp.where(op == OP_WRITE, T_WRITE_OK,
+                          jnp.where(cas_ok, T_CAS_OK, T_ERR)))
+            ra = jnp.where(
+                op == OP_READ, jnp.where(cur_v > 0, cur_v, 20),
+                jnp.where((op == OP_CAS) & ~cas_ok,
+                          jnp.where(cur_v > 0, 22, 20), 0))
+            rep = (desig, lay.n_nodes + client, rtype, ra,
+                   jnp.zeros((n,), I32), mid)
+            lanes.append(rep)
+        AL = len(lanes)
+        apply_out = _out(
+            (n, AL),
+            valid=jnp.stack([ln[0] for ln in lanes], axis=1),
+            dest=jnp.stack([jnp.broadcast_to(ln[1], (n,))
+                            for ln in lanes], axis=1),
+            type=jnp.stack([jnp.broadcast_to(ln[2], (n,))
+                            for ln in lanes], axis=1),
+            a=jnp.stack([jnp.broadcast_to(ln[3], (n,))
+                         for ln in lanes], axis=1),
+            b=jnp.stack([jnp.broadcast_to(ln[4], (n,))
+                         for ln in lanes], axis=1),
+            reply_to=jnp.stack([jnp.broadcast_to(ln[5], (n,))
+                                for ln in lanes], axis=1))
+        return s, _cat_lanes(apply_out, ack_out)
+
+    def quiescent(self, state):
+        nxt = jnp.clip(state["applied"] + 1, 0, self.lay.cap - 1)
+        pending = jnp.take_along_axis(state["r_has"], nxt[:, None],
+                                      axis=1)[:, 0]
+        return ~pending.any()
+
+
+class GridAcceptors(AcceptorRole):
+    """Acceptor role with named fault subgroups: the grid's rows and
+    columns, for `--nemesis-targets partition=acceptor-col-0` style
+    role-targeted faults."""
+
+    def fault_subgroups(self, names):
+        lay = self.lay
+        out = {}
+        for c in range(lay.cols):
+            out[f"acceptor-col-{c}"] = [names[r * lay.cols + c]
+                                        for r in range(lay.rows)]
+        for r in range(lay.rows):
+            out[f"acceptor-row-{r}"] = list(
+                names[r * lay.cols:(r + 1) * lay.cols])
+        return out
+
+
+@register
+class CompartmentProgram(LinKVWire, RolePartition):
+    """`--node tpu:compartment`: the role-partitioned compartmentalized
+    consensus cluster (see module docstring). Serves lin-kv through the
+    shared wire vocabulary; clients talk to the leader (node 0)."""
+
+    name = "compartment"
+
+    def __init__(self, opts, nodes):
+        lay = Layout(opts, len(nodes))
+        self.lay = lay
+        roles = [
+            ("leader", LeaderRole(opts, nodes[:1], lay)),
+            ("proxies",
+             ProxyRole(opts, nodes[lay.p_base:lay.a_base], lay)),
+            ("acceptors",
+             GridAcceptors(opts, nodes[lay.a_base:lay.r_base], lay)),
+            ("replicas", ReplicaRole(opts, nodes[lay.r_base:], lay)),
+        ]
+        RolePartition.__init__(self, opts, nodes, roles)
+
+    def node_for_op(self, op):
+        return self.lay.leader

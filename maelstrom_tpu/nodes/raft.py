@@ -62,8 +62,49 @@ OP_NOOP, OP_WRITE, OP_CAS, OP_READ, OP_TXN = 0, 1, 2, 3, 4
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
 
+class LinKVWire:
+    """The lin-kv host-boundary wire vocabulary (RPC surface per
+    `workload/lin_kv.clj`): shared by every node family that serves the
+    workload — raft here, the compartmentalized consensus family
+    (`nodes/compartment.py`) — so the protocol JSON <-> word encoding
+    cannot drift between backends."""
+
+    def request_for_op(self, op):
+        k, v = op["value"]
+        if op["f"] == "read":
+            return {"type": "read", "key": k}
+        if op["f"] == "write":
+            return {"type": "write", "key": k, "value": v}
+        return {"type": "cas", "key": k, "from": v[0], "to": v[1]}
+
+    def encode_body(self, body, intern):
+        if body["type"] == "read":
+            return (T_READ, int(body["key"]), 0, 0)
+        if body["type"] == "write":
+            return (T_WRITE, int(body["key"]), int(body["value"]), 0)
+        return (T_CAS, int(body["key"]), int(body["from"]),
+                int(body["to"]))
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_READ_OK:
+            return {"type": "read_ok", "value": int(a) - 1}
+        if t == T_WRITE_OK:
+            return {"type": "write_ok"}
+        if t == T_CAS_OK:
+            return {"type": "cas_ok"}
+        if t == 1:
+            return {"type": "error", "code": int(a), "text": "kv error"}
+        return super().decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        if body["type"] == "read_ok":
+            k = op["value"][0]
+            return {**op, "type": "ok", "value": [k, body["value"]]}
+        return {**op, "type": "ok"}
+
+
 @register
-class RaftProgram(NodeProgram):
+class RaftProgram(LinKVWire, NodeProgram):
     name = "lin-kv"
     needs_state_reads = False
     is_edge = True
@@ -637,37 +678,4 @@ class RaftProgram(NodeProgram):
         # raft is never quiescent: heartbeats and election timers tick
         return jnp.array(False)
 
-    # --- host boundary (RPC surface per workload/lin_kv.clj) ---
-
-    def request_for_op(self, op):
-        k, v = op["value"]
-        if op["f"] == "read":
-            return {"type": "read", "key": k}
-        if op["f"] == "write":
-            return {"type": "write", "key": k, "value": v}
-        return {"type": "cas", "key": k, "from": v[0], "to": v[1]}
-
-    def encode_body(self, body, intern):
-        if body["type"] == "read":
-            return (T_READ, int(body["key"]), 0, 0)
-        if body["type"] == "write":
-            return (T_WRITE, int(body["key"]), int(body["value"]), 0)
-        return (T_CAS, int(body["key"]), int(body["from"]),
-                int(body["to"]))
-
-    def decode_body(self, t, a, b, c, intern):
-        if t == T_READ_OK:
-            return {"type": "read_ok", "value": int(a) - 1}
-        if t == T_WRITE_OK:
-            return {"type": "write_ok"}
-        if t == T_CAS_OK:
-            return {"type": "cas_ok"}
-        if t == 1:
-            return {"type": "error", "code": int(a), "text": "kv error"}
-        return super().decode_body(t, a, b, c, intern)
-
-    def completion(self, op, body, read_state, intern):
-        if body["type"] == "read_ok":
-            k = op["value"][0]
-            return {**op, "type": "ok", "value": [k, body["value"]]}
-        return {**op, "type": "ok"}
+    # host boundary (RPC surface per workload/lin_kv.clj): LinKVWire

@@ -1,0 +1,329 @@
+"""The reference's built-in service nodes as role-partitioned in-cluster
+programs (PAPER.md layer 5, `service.clj:289-295`).
+
+Where the reference runs lin-tso / seq-kv / lww-kv as host threads
+(`maelstrom_tpu/services.py`, which stays as the PURE ORACLE — the role
+programs here are pinned against those state machines in
+tests/test_services_roles.py), `--node tpu:services` runs them as
+heterogeneous IN-CLUSTER nodes on the TPU path: one `RolePartition` with
+
+    node 0                 lin-tso   (linearizable timestamp oracle)
+    node 1                 seq-kv    (single-copy KV: linearizable, hence
+                                      trivially sequentially consistent)
+    nodes [2, 2+n)         lww-kv    (n last-write-wins replicas with
+                                      Lamport clocks, converging by
+                                      per-key dirty-set gossip)
+
+selected with `--service-roles lin-tso=1,seq-kv=1,lww-kv=3` (the default
+5-node layout). The `lin-tso` workload smokes the TSO tier end to end
+(`-w lin-tso --node tpu:services`, graded by `checkers/tso.py`); the KV
+tiers serve the shared lin-kv wire codes for in-cluster callers and the
+oracle suites — mixed-workload clusters ride the same RolePartition
+machinery as follow-ons (ROADMAP)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..net.tpu import I32, Msgs, cat_lanes
+from ..sim import RolePartition
+from . import NodeProgram, register
+from .raft import T_READ, T_WRITE, T_CAS
+
+T_ERR = 1
+T_READ_OK = 11
+T_WRITE_OK = 13
+T_CAS_OK = 15
+T_TS = 40        # -> lin-tso
+T_TS_OK = 41     # a = timestamp
+T_MERGE = 45     # lww gossip: a = key, b = write ts, c = value+1
+
+DEFAULT_SERVICE_ROLES = "lin-tso=1,seq-kv=1,lww-kv=3"
+_SERVICE_NAMES = ("lin-tso", "seq-kv", "lww-kv")
+
+
+def parse_service_roles(spec) -> dict:
+    spec = spec or DEFAULT_SERVICE_ROLES
+    out: dict = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, val = part.partition("=")
+        k = k.strip()
+        if k not in _SERVICE_NAMES:
+            raise ValueError(f"--service-roles: unknown service {k!r} "
+                             f"(expected {list(_SERVICE_NAMES)})")
+        n = int(val) if sep else 1
+        if n < 1:
+            raise ValueError(f"--service-roles: {k} must be >= 1")
+        out[k] = n
+    for name in _SERVICE_NAMES:
+        out.setdefault(name, 0)
+    if out["lin-tso"] != 1 or out["seq-kv"] > 1:
+        raise ValueError(
+            "--service-roles: lin-tso and seq-kv are single-copy "
+            "services (exactly one lin-tso, at most one seq-kv)")
+    return out
+
+
+def roles_node_count(spec) -> int:
+    r = parse_service_roles(spec)
+    return r["lin-tso"] + r["seq-kv"] + r["lww-kv"]
+
+
+def _kv_reply(t, cur, cas_ok):
+    """Shared lin-kv reply encoding for wire-type-dispatched KV tiers:
+    (rtype, ra) per the raft conventions — READ_OK carries value+1,
+    absent keys error 20, failed cas 22 (or 20 when absent)."""
+    rtype = jnp.where(
+        t == T_READ, jnp.where(cur > 0, T_READ_OK, T_ERR),
+        jnp.where(t == T_WRITE, T_WRITE_OK,
+                  jnp.where(cas_ok, T_CAS_OK, T_ERR)))
+    ra = jnp.where(
+        t == T_READ, jnp.where(cur > 0, cur, 20),
+        jnp.where((t == T_CAS) & ~cas_ok,
+                  jnp.where(cur > 0, 22, 20), 0))
+    return rtype, ra
+
+
+class TSORole(NodeProgram):
+    """`PersistentTSO` on device: a strictly monotonic timestamp oracle
+    (reply carries the pre-increment value, like `service.clj:116-122`).
+    Multiple requests landing in one round are linearized by inbox lane
+    order — their op windows overlap, so any total order is legal."""
+
+    name = "lin-tso"
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        self.inbox_cap = int(opts.get("service_inbox", 8))
+        self.outbox_cap = self.inbox_cap
+
+    def init_state(self):
+        return {"ts": jnp.zeros((self.n_nodes,), I32)}
+
+    def step(self, state, inbox, ctx):
+        req = inbox.valid & (inbox.type == T_TS)
+        rank = jnp.cumsum(req.astype(I32), axis=1) - req.astype(I32)
+        out = inbox.replace(
+            valid=req, dest=inbox.src, reply_to=inbox.mid,
+            type=jnp.full_like(inbox.type, T_TS_OK),
+            a=state["ts"][:, None] + rank,
+            b=jnp.zeros_like(inbox.b), c=jnp.zeros_like(inbox.c))
+        return ({"ts": state["ts"]
+                 + jnp.sum(req.astype(I32), axis=1)}, out)
+
+    def quiescent(self, state):
+        return jnp.array(True)
+
+
+class SeqKVRole(NodeProgram):
+    """`PersistentKV` on device, single copy: read/write/cas applied in
+    arrival order (a linearizable implementation, which is a legal
+    refinement of the reference's sequential adapter). Values are small
+    ints stored as value+1 (0 = absent), the lin-kv wire convention."""
+
+    name = "seq-kv"
+
+    def __init__(self, opts, nodes):
+        super().__init__(opts, nodes)
+        self.keys = int(opts.get("kv_keys", 256))
+        self.inbox_cap = int(opts.get("service_inbox", 8))
+        self.outbox_cap = self.inbox_cap
+
+    def init_state(self):
+        return {"kv": jnp.zeros((self.n_nodes, self.keys), I32)}
+
+    def step(self, state, inbox, ctx):
+        n, K = self.n_nodes, inbox.valid.shape[1]
+        kv = state["kv"]
+        me = jnp.arange(n, dtype=I32)
+        lanes = []
+        # lanes apply strictly in order: a cas may read the key the
+        # previous lane wrote, so the chain is sequential like a log
+        for k in range(K):
+            valid = inbox.valid[:, k]
+            t = inbox.type[:, k]
+            key = jnp.clip(inbox.a[:, k], 0, self.keys - 1)
+            req = valid & ((t == T_READ) | (t == T_WRITE) | (t == T_CAS))
+            cur = jnp.take_along_axis(kv, key[:, None], axis=1)[:, 0]
+            frm = jnp.clip(inbox.b[:, k] + 1, 0, 0xFF)
+            cas_ok = (t == T_CAS) & (cur > 0) & (cur == frm)
+            new_v = jnp.where(t == T_WRITE,
+                              jnp.clip(inbox.b[:, k] + 1, 0, 0xFF),
+                              jnp.clip(inbox.c[:, k] + 1, 0, 0xFF))
+            do = req & ((t == T_WRITE) | cas_ok)
+            kv = kv.at[me, jnp.where(do, key, self.keys)].set(
+                new_v, mode="drop", unique_indices=True)
+            rtype, ra = _kv_reply(t, cur, cas_ok)
+            lanes.append((req, inbox.src[:, k], rtype, ra,
+                          inbox.mid[:, k]))
+        out = Msgs.empty((n, K)).replace(
+            valid=jnp.stack([ln[0] for ln in lanes], axis=1),
+            dest=jnp.stack([ln[1] for ln in lanes], axis=1),
+            type=jnp.stack([ln[2] for ln in lanes], axis=1),
+            a=jnp.stack([ln[3] for ln in lanes], axis=1),
+            reply_to=jnp.stack([ln[4] for ln in lanes], axis=1))
+        return {"kv": kv}, out
+
+    def quiescent(self, state):
+        return jnp.array(True)
+
+
+class LWWKVRole(NodeProgram):
+    """`LWWKV` on device: n replicas, Lamport write timestamps, per-key
+    last-write-wins merge (ties keep ours), converging by dirty-set
+    gossip — each round every replica ships up to `gossip_keys` dirty
+    (key, ts, value) triples to its ring successor, and adoption marks
+    the key dirty at the receiver, so an update propagates the whole
+    ring and the dirty set drains (the quiescence signal)."""
+
+    name = "lww-kv"
+
+    def __init__(self, opts, nodes, base: int = 0):
+        super().__init__(opts, nodes)
+        self.base = base
+        self.keys = int(opts.get("kv_keys", 256))
+        self.G = int(opts.get("gossip_keys", 8))
+        self.inbox_cap = int(opts.get("service_inbox", 8))
+        self.outbox_cap = self.inbox_cap + self.G
+
+    def init_state(self):
+        n = self.n_nodes
+        return {"kv": jnp.zeros((n, self.keys), I32),
+                "vts": jnp.full((n, self.keys), -1, I32),
+                "clock": jnp.zeros((n,), I32),
+                "dirty": jnp.zeros((n, self.keys), bool)}
+
+    def step(self, state, inbox, ctx):
+        n, K, keys = self.n_nodes, inbox.valid.shape[1], self.keys
+        s = dict(state)
+        me = jnp.arange(n, dtype=I32)
+        lanes = []
+        for k in range(K):
+            valid = inbox.valid[:, k]
+            t = inbox.type[:, k]
+            key = jnp.clip(inbox.a[:, k], 0, keys - 1)
+            cur = jnp.take_along_axis(s["kv"], key[:, None], axis=1)[:, 0]
+            kts = jnp.take_along_axis(s["vts"], key[:, None],
+                                      axis=1)[:, 0]
+            # gossip merge: adopt strictly-newer stamps (ties keep ours)
+            mg = valid & (t == T_MERGE)
+            adopt = mg & (inbox.b[:, k] > kts)
+            frm = jnp.clip(inbox.b[:, k] + 1, 0, 0xFF)
+            cas_ok = valid & (t == T_CAS) & (cur > 0) & (cur == frm)
+            wr = valid & ((t == T_WRITE) | cas_ok)
+            new_v = jnp.where(
+                adopt, inbox.c[:, k],
+                jnp.where(t == T_WRITE,
+                          jnp.clip(inbox.b[:, k] + 1, 0, 0xFF),
+                          jnp.clip(inbox.c[:, k] + 1, 0, 0xFF)))
+            new_ts = jnp.where(adopt, inbox.b[:, k], s["clock"])
+            do = adopt | wr
+            tgt = jnp.where(do, key, keys)
+            s["kv"] = s["kv"].at[me, tgt].set(new_v, mode="drop",
+                                              unique_indices=True)
+            s["vts"] = s["vts"].at[me, tgt].set(new_ts, mode="drop",
+                                                unique_indices=True)
+            s["dirty"] = s["dirty"].at[me, tgt].set(
+                True, mode="drop", unique_indices=True)
+            s["clock"] = jnp.where(
+                wr, s["clock"] + 1,
+                jnp.maximum(s["clock"],
+                            jnp.where(mg, inbox.b[:, k] + 1, 0)))
+            rtype, ra = _kv_reply(t, cur, cas_ok)
+            req = valid & ((t == T_READ) | (t == T_WRITE) | (t == T_CAS))
+            lanes.append((req, inbox.src[:, k], rtype, ra,
+                          inbox.mid[:, k]))
+        reply_out = Msgs.empty((n, K)).replace(
+            valid=jnp.stack([ln[0] for ln in lanes], axis=1),
+            dest=jnp.stack([ln[1] for ln in lanes], axis=1),
+            type=jnp.stack([ln[2] for ln in lanes], axis=1),
+            a=jnp.stack([ln[3] for ln in lanes], axis=1),
+            reply_to=jnp.stack([ln[4] for ln in lanes], axis=1))
+
+        # dirty-set gossip to the ring successor (skipped for a single
+        # replica, where there is nobody to converge with)
+        G = self.G
+        if n > 1 and G > 0:
+            dirty = s["dirty"]
+            rank = jnp.cumsum(dirty.astype(I32), axis=1) - 1
+            sel = dirty & (rank < G)
+            key_ar = jnp.broadcast_to(
+                jnp.arange(keys, dtype=I32)[None, :], (n, keys))
+            nn = me[:, None]
+            lane_tgt = jnp.where(sel, rank, G + key_ar)
+
+            def pick(src, fill):
+                buf = jnp.full((n, G), fill, src.dtype)
+                return buf.at[nn, lane_tgt].set(src, mode="drop",
+                                                unique_indices=True)
+            g_key = pick(key_ar, 0)
+            g_ts = pick(s["vts"], 0)
+            g_val = pick(s["kv"], 0)
+            g_valid = pick(sel, False)
+            s["dirty"] = dirty & ~sel
+            succ = self.base + (me + 1) % n
+            gossip_out = Msgs.empty((n, G)).replace(
+                valid=g_valid,
+                dest=jnp.broadcast_to(succ[:, None], (n, G)),
+                type=jnp.full((n, G), T_MERGE, I32),
+                a=g_key, b=g_ts, c=g_val)
+            reply_out = cat_lanes(reply_out, gossip_out)
+        return s, reply_out
+
+    def quiescent(self, state):
+        if self.n_nodes <= 1:
+            return jnp.array(True)
+        return ~state["dirty"].any()
+
+
+@register
+class ServicesProgram(RolePartition):
+    """`--node tpu:services`: the built-in service nodes as one
+    role-partitioned in-cluster tree (see module docstring). The client
+    role is lin-tso — the `lin-tso` workload's smoke surface."""
+
+    name = "services"
+
+    def __init__(self, opts, nodes):
+        r = parse_service_roles(opts.get("service_roles"))
+        roles = []
+        base = 0
+        if r["lin-tso"]:
+            roles.append(("lin-tso",
+                          TSORole(opts, nodes[base:base + r["lin-tso"]])))
+            base += r["lin-tso"]
+        if r["seq-kv"]:
+            roles.append(("seq-kv",
+                          SeqKVRole(opts,
+                                    nodes[base:base + r["seq-kv"]])))
+            base += r["seq-kv"]
+        if r["lww-kv"]:
+            roles.append(("lww-kv",
+                          LWWKVRole(opts,
+                                    nodes[base:base + r["lww-kv"]],
+                                    base=base)))
+            base += r["lww-kv"]
+        RolePartition.__init__(self, opts, nodes, roles)
+
+    # --- host boundary: the lin-tso RPC surface -------------------------
+
+    def request_for_op(self, op):
+        return {"type": "ts"}
+
+    def node_for_op(self, op):
+        return 0
+
+    def encode_body(self, body, intern):
+        assert body["type"] == "ts"
+        return (T_TS, 0, 0, 0)
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_TS_OK:
+            return {"type": "ts_ok", "ts": int(a)}
+        return NodeProgram.decode_body(self, t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        return {**op, "type": "ok", "value": int(body["ts"])}

@@ -100,8 +100,8 @@ class TpuCombinedNemesis(NemesisDecisions):
     per-package seeded streams shared with the host path
     (`NemesisDecisions`), so both paths draw identical schedules."""
 
-    def __init__(self, runner, nodes, seed=0):
-        super().__init__(nodes, seed)
+    def __init__(self, runner, nodes, seed=0, targets=None):
+        super().__init__(nodes, seed, targets=targets)
         self.runner = runner
         self.killed: list = []
         self.paused_nodes: list = []
@@ -814,7 +814,15 @@ class TpuRunner:
         nem_seed = test.get("nemesis_seed")
         if nem_seed is None:
             nem_seed = test.get("seed", 0)
-        nemesis = (TpuCombinedNemesis(self, self.nodes, nem_seed)
+        # role-targeted faults (--nemesis-targets): group tokens resolve
+        # against the node family's fault groups (role ranges, acceptor
+        # grid rows/columns) plus literal node names
+        from .. import nemesis as nem
+        groups = getattr(self.program, "fault_groups", lambda: {})()
+        targets = nem.resolve_targets(test.get("nemesis_targets"),
+                                      groups, self.nodes)
+        nemesis = (TpuCombinedNemesis(self, self.nodes, nem_seed,
+                                      targets=targets)
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
         self.nemesis = nemesis

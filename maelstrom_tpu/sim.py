@@ -23,6 +23,7 @@ from flax import struct
 from .net import static
 from .net import tpu as T
 from .net.tpu import I32, Msgs, NetConfig, NetState
+from .nodes import NodeProgram
 
 
 @struct.dataclass
@@ -74,6 +75,279 @@ def _freeze(stall, old, new):
     return jax.tree.map(pick, old, new)
 
 
+def _freeze_nodes(program, stall, old, new):
+    """Role-aware freeze: a `RolePartition` state tree nests per-role
+    subtrees whose leaves lead with the ROLE's node count, not the
+    global node axis, so the partition slices the [N] stall mask per
+    role (`freeze_select`); homogeneous programs keep the flat select."""
+    sel = getattr(program, "freeze_select", None)
+    if sel is not None:
+        return sel(stall, old, new)
+    return _freeze(stall, old, new)
+
+
+class RolePartition(NodeProgram):
+    """A multi-program node-state tree: contiguous node-id ranges run
+    DISTINCT `NodeProgram`s inside the one jitted round.
+
+    Today's `make_sim` takes exactly one program and every node runs it;
+    a RolePartition maps role name -> (contiguous node range, program)
+    and `step` slices the global inbox per role, steps each role's
+    program on its own state subtree (`{role: subtree}`, leaves leading
+    with the ROLE's node count), and concatenates the outboxes back to
+    the global node axis — one compiled scan, same donated-carry / mesh
+    / fleet machinery, NetConfig routing, durable views, kill/restart
+    and freeze masks all role-aware:
+
+      - `freeze_select` slices the [N] kill/pause stall mask per role
+        (`sim._freeze_nodes` dispatches here);
+      - `durable_view`/`restore` delegate per role, so a partition can
+        mix fully-persistent roles (acceptors) with volatile ones
+        (stateless proxies rebuilt from `init_state` on restart);
+      - `fault_groups` names each role's node range (plus any program-
+        declared subgroups, e.g. acceptor grid rows/columns) for
+        role-targeted nemesis scheduling (`--nemesis-targets`).
+
+    The host boundary (request/encode/decode/completion, smart-client
+    routing) delegates to the CLIENT role — the first role, by
+    convention the tier clients talk to. A single-role partition is pure
+    delegation: same PRNG stream, same inbox/outbox shapes, bit-identical
+    histories to running the inner program directly (pinned by
+    tests/test_role_partition.py), including edge programs (raft,
+    broadcast), which are only legal as a partition's sole role.
+
+    Built-in families: `--node tpu:compartment` (nodes/compartment.py,
+    role-partitioned compartmentalized consensus), `--node tpu:services`
+    (nodes/services.py, the reference's built-in service nodes), and
+    `--node tpu:solo:<program>` (any program wrapped as a one-role
+    partition — the regression-pin configuration)."""
+
+    name = "role-partition"
+
+    def __init__(self, opts: dict, nodes: list, roles: list):
+        """`roles` is an ordered list of (name, program) with each
+        program already constructed over its contiguous slice of
+        `nodes`; ranges are assigned in order. Role programs address
+        the POOL globally (dest indices are global node ids; clients
+        are >= len(nodes))."""
+        super().__init__(opts, nodes)
+        if not roles:
+            raise ValueError("RolePartition needs at least one role")
+        if any(isinstance(p, RolePartition) for _n, p in roles):
+            raise ValueError(
+                "RolePartition roles must be leaf programs (nest roles "
+                "by listing them, not by wrapping a partition)")
+        self.roles = list(roles)
+        self._single = len(self.roles) == 1
+        self._bounds = []
+        base = 0
+        for rname, prog in self.roles:
+            c = prog.n_nodes
+            self._bounds.append((base, base + c))
+            base += c
+        if base != self.n_nodes:
+            raise ValueError(
+                f"role sizes sum to {base} nodes but the cluster has "
+                f"{self.n_nodes} ({[(n, p.n_nodes) for n, p in roles]})")
+        self.inbox_cap = max(p.inbox_cap for _, p in self.roles)
+        self.outbox_cap = max(p.outbox_cap for _, p in self.roles)
+        self._client_name, self._client_prog = self.roles[0]
+        self._client_base = 0
+        cp = self._client_prog
+        self.needs_state_reads = bool(
+            getattr(cp, "needs_state_reads", False))
+        if self.needs_state_reads and not self._single:
+            # host state reads index the GLOBAL node axis into every
+            # role's (role-local) leaves — only sound when the partition
+            # IS the whole cluster (single role)
+            raise ValueError(
+                "needs_state_reads programs are only supported as a "
+                "partition's single role (host state reads index the "
+                "global node axis)")
+        self.state_reads_final = bool(
+            getattr(cp, "state_reads_final", False))
+        self.reply_payload_words = int(
+            getattr(cp, "reply_payload_words", 0) or 0)
+        self.unit_words = tuple(getattr(cp, "unit_words", ()) or ())
+        for rname, prog in self.roles[1:]:
+            if getattr(prog, "unit_words", ()):
+                raise ValueError(
+                    f"role {rname!r}: unit_words on a non-client role "
+                    f"would collide in the shared NetConfig table")
+            if getattr(prog, "needs_state_reads", False):
+                raise ValueError(
+                    f"role {rname!r}: needs_state_reads is only "
+                    f"supported on the client role (host state reads "
+                    f"index the global node axis)")
+        # edge programs read per-program topology state (neighbors,
+        # channels) that has no per-role slicing yet: legal only as the
+        # sole role, where the partition is pure delegation
+        self.is_edge = bool(getattr(cp, "is_edge", False))
+        if any(getattr(p, "is_edge", False) for _, p in self.roles[1:]) \
+                or (self.is_edge and not self._single):
+            raise ValueError(
+                "edge programs are only supported as a partition's "
+                "single role (pool-path roles have no static topology)")
+        if self.is_edge:
+            self.neighbors = cp.neighbors
+            self.rev = cp.rev
+            self.D = cp.D
+            self.lanes = cp.lanes
+            self.edge_cfg = cp.edge_cfg
+            self.edge_atomic_rpc = cp.edge_atomic_rpc
+            self.edge_lanes_symmetric = cp.edge_lanes_symmetric
+        self.tolerates_channel_overwrites = any(
+            getattr(p, "tolerates_channel_overwrites", False)
+            for _, p in self.roles)
+        self.tolerates_latency_clipping = any(
+            getattr(p, "tolerates_latency_clipping", False)
+            for _, p in self.roles)
+
+    # --- device side -----------------------------------------------------
+
+    def _role_ctx(self, ctx, i):
+        # single role: the inner program sees the EXACT round ctx (the
+        # bit-identity contract); multi-role: independent per-role keys
+        if self._single:
+            return ctx
+        return {**ctx, "key": jax.random.fold_in(ctx["key"], i)}
+
+    @staticmethod
+    def _pad_lanes(out: Msgs, O: int) -> Msgs:
+        L = out.valid.shape[1]
+        if L == O:
+            return out
+        pad = Msgs.empty((out.valid.shape[0], O - L))
+        return jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=1), out, pad)
+
+    def init_state(self):
+        return {name: prog.init_state() for name, prog in self.roles}
+
+    def step(self, state, inbox, ctx):
+        new_state = {}
+        outs = []
+        for i, (name, prog) in enumerate(self.roles):
+            lo, hi = self._bounds[i]
+            ib = jax.tree.map(lambda f: f[lo:hi], inbox)
+            st, out = prog.step(state[name], ib, self._role_ctx(ctx, i))
+            new_state[name] = st
+            outs.append(self._pad_lanes(out, self.outbox_cap))
+        if self._single:
+            return new_state, outs[0]
+        outbox = jax.tree.map(
+            lambda *fs: jnp.concatenate(fs, axis=0), *outs)
+        return new_state, outbox
+
+    def edge_step(self, state, edge_in, client_in, ctx):
+        name, prog = self.roles[0]
+        st, edge_out, client_out = prog.edge_step(
+            state[name], edge_in, client_in, ctx)
+        return {name: st}, edge_out, client_out
+
+    def freeze_select(self, stall, old, new):
+        return {name: _freeze(stall[lo:hi], old[name], new[name])
+                for (name, prog), (lo, hi)
+                in zip(self.roles, self._bounds)}
+
+    def quiescent(self, state):
+        # roles without a quiescent hook are stateless between messages
+        # (the runner's pool-empty probe covers them): they contribute
+        # True, so wrapping never blocks an inner program's fast-forward
+        q = jnp.array(True)
+        for name, prog in self.roles:
+            f = getattr(prog, "quiescent", None)
+            if f is not None:
+                q = q & f(state[name])
+        return q
+
+    def reply_payload(self, state, node_idx):
+        lo, hi = self._bounds[0]
+        local = jnp.clip(node_idx - lo, 0, self._client_prog.n_nodes - 1)
+        return self._client_prog.reply_payload(
+            state[self._client_name], local)
+
+    def invalid_counters(self, state) -> dict:
+        out = {}
+        for name, prog in self.roles:
+            for k, v in prog.invalid_counters(state[name]).items():
+                out[k if self._single else f"{name}:{k}"] = v
+        return out
+
+    # --- durability (kill/restart) ---------------------------------------
+
+    def durable_view(self, state):
+        return {name: prog.durable_view(state[name])
+                for name, prog in self.roles}
+
+    def restore(self, fresh, durable, state, mask):
+        return {name: prog.restore(
+                    fresh[name],
+                    None if durable is None else durable.get(name),
+                    state[name], mask[lo:hi])
+                for (name, prog), (lo, hi)
+                in zip(self.roles, self._bounds)}
+
+    # --- host boundary: delegated to the client role ----------------------
+
+    def request_for_op(self, op):
+        return self._client_prog.request_for_op(op)
+
+    def node_for_op(self, op):
+        local = self._client_prog.node_for_op(op)
+        if local is not None:
+            return self._client_base + int(local)
+        if self._single:
+            return None
+        # heterogeneous cluster: an unrouted op goes to the client tier,
+        # never to a worker-bound internal node
+        return self._client_base
+
+    def encode_body(self, body, intern):
+        return self._client_prog.encode_body(body, intern)
+
+    def decode_body(self, t, a, b, c, intern):
+        return self._client_prog.decode_body(t, a, b, c, intern)
+
+    def completion(self, op, body, read_state, intern):
+        return self._client_prog.completion(
+            op, body, lambda: read_state()[self._client_name], intern)
+
+    def completion_payload(self, op, body, payload, intern):
+        return self._client_prog.completion_payload(op, body, payload,
+                                                    intern)
+
+    def host_op(self, op, read_state, intern):
+        return self._client_prog.host_op(
+            op, lambda: read_state()[self._client_name], intern)
+
+    def host_state(self):
+        st = {name: prog.host_state() for name, prog in self.roles}
+        return None if all(v is None for v in st.values()) else st
+
+    def set_host_state(self, st):
+        if st is None:
+            return
+        for name, prog in self.roles:
+            prog.set_host_state(st.get(name))
+
+    # --- role-targeted faults ---------------------------------------------
+
+    def fault_groups(self) -> dict:
+        """{group-name: [node names]} for `--nemesis-targets`: every
+        role's contiguous slice, plus any subgroups the role program
+        declares over its own slice (`fault_subgroups`, e.g. the
+        compartment acceptor grid's rows and columns)."""
+        out = {}
+        for (name, prog), (lo, hi) in zip(self.roles, self._bounds):
+            names = list(self.nodes[lo:hi])
+            out[name] = names
+            sub = getattr(prog, "fault_subgroups", None)
+            if sub is not None:
+                out.update(sub(names))
+        return out
+
+
 def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     """One simulation round. `inject` is a flat Msgs batch of client
     requests (src = client index >= n_nodes). Returns
@@ -95,7 +369,7 @@ def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         # killed/paused nodes don't act: state frozen, sends suppressed
         # (their inbox rows are already empty — _deliver defers/drops)
         stall = sim.net.down | sim.net.paused
-        nodes = _freeze(stall, sim.nodes, nodes)
+        nodes = _freeze_nodes(program, stall, sim.nodes, nodes)
         outbox = outbox.replace(valid=outbox.valid & ~stall[:, None])
     flat = jax.tree.map(lambda f: f.reshape((N * O,) + f.shape[2:]), outbox)
     flat = flat.replace(src=jnp.repeat(jnp.arange(N, dtype=I32), O))
@@ -127,7 +401,7 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         # toward a stalled node is lost, not deferred — every edge
         # protocol retransmits, and raft explicitly tolerates it)
         stall = sim.net.down | sim.net.paused
-        nodes = _freeze(stall, sim.nodes, nodes)
+        nodes = _freeze_nodes(program, stall, sim.nodes, nodes)
         edge_out = edge_out.replace(
             valid=edge_out.valid & ~stall[:, None, None])
         client_out = client_out.replace(

@@ -7,10 +7,11 @@ from __future__ import annotations
 
 def registry() -> dict:
     from . import (broadcast, broadcast_batched, echo, g_counter, g_set,
-                   kafka, lin_kv, lin_mutex, pn_counter, txn_list_append,
-                   txn_rw_register, unique_ids)
+                   kafka, lin_kv, lin_mutex, lin_tso, pn_counter,
+                   txn_list_append, txn_rw_register, unique_ids)
     return {
         "lin-mutex": lin_mutex.workload,
+        "lin-tso": lin_tso.workload,
         "broadcast": broadcast.workload,
         "broadcast-batched": broadcast_batched.workload,
         "echo": echo.workload,

@@ -1,0 +1,51 @@
+"""Linearizable timestamp-oracle workload (the reference's built-in
+`lin-tso` service, `service.clj:116-122` / `service.clj:289-295`).
+
+Clients request timestamps; the oracle must hand out unique,
+real-time-monotonic values (`checkers/tso.py`). On the TPU path this is
+served by the role-partitioned services cluster
+(`-w lin-tso --node tpu:services`, nodes/services.py)."""
+
+from __future__ import annotations
+
+from .. import generators as g
+from .. import schema as S
+from ..client import defrpc
+from ..checkers.tso import TSOChecker
+from . import BaseClient
+
+ts_rpc = defrpc(
+    "ts",
+    "Requests a fresh timestamp from the oracle. The response carries a "
+    "unique, strictly monotonic `ts`: if one request completes before "
+    "another begins, the earlier request's timestamp is smaller.",
+    {"type": S.Eq("ts")},
+    {"type": S.Eq("ts_ok"), "ts": S.Any},
+    ns="maelstrom_tpu.workloads.lin_tso")
+
+
+class LinTSOClient(BaseClient):
+    def invoke(self, test, op):
+        def go():
+            res = ts_rpc(self.conn, self.node, {}, 1000)
+            return {**op, "type": "ok", "value": res["ts"]}
+        return self.with_errors(op, {"ts"}, go)
+
+
+class TSOpGen:
+    """Picklable (checkpoint/resume) timestamp-request stream."""
+
+    def __call__(self):
+        return {"f": "ts", "value": None}
+
+
+def generator(opts):
+    return g.Fn(TSOpGen())
+
+
+def workload(opts: dict) -> dict:
+    return {
+        "client": LinTSOClient(opts["net"]),
+        "generator": generator(opts),
+        "checker": TSOChecker(),
+    }

@@ -52,6 +52,15 @@ step artifacts/bench-stream-r7.json 2400 env BENCH_MODE=stream python bench.py
 step artifacts/bench-batched-r8.json 2400 \
     env BENCH_MODE=broadcast_batched python bench.py
 
+# 1e. compartmentalized consensus (BENCH_MODE=compartment, ISSUE 10):
+#     lin-kv client-ops/s vs proxy count (P=1/2/4/8) at fixed
+#     leader/acceptor capacity on --node tpu:compartment — headline
+#     `value` = client-ops/vsec at the largest proxy count,
+#     `scaling_1_to_4` the >= 2x acceptance figure (doc/compartment.md).
+#     CPU fallback honest: host_cpus/devices ride the record
+step artifacts/bench-compartment-r9.json 2400 \
+    env BENCH_MODE=compartment python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -78,4 +78,33 @@ if [ "${BATCHED_SMOKE:-1}" = "1" ]; then
     echo "== batched-broadcast smoke valid =="
 fi
 
+# Compartmentalized-consensus smoke (ISSUE 10, doc/compartment.md):
+# lin-kv on the role-partitioned proxy/acceptor/replica cluster —
+# plain, sharded (--mesh 1,2 over the forced 2-device CPU mesh), and a
+# role-targeted kill+partition soup that kills a proxy and cuts an
+# acceptor column, verdict valid post-heal. The compartment and
+# services step fns are traced by the static audit above (the
+# `compartment` / `lin-tso` entries in analyze's program set).
+# COMPARTMENT_SMOKE=0 skips.
+if [ "${COMPARTMENT_SMOKE:-1}" = "1" ]; then
+    echo "== compartmentalized-consensus smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w lin-kv --node tpu:compartment \
+        --roles proxies=2,acceptors=2x2,replicas=2 --rate 20 \
+        --time-limit 2 --seed 7 --no-audit \
+        --store "$SMOKE_STORE" > /dev/null
+    python -m maelstrom_tpu test -w lin-kv --node tpu:compartment \
+        --roles proxies=2,acceptors=2x2,replicas=2 --rate 20 \
+        --time-limit 2 --seed 7 --mesh 1,2 --no-audit \
+        --store "$SMOKE_STORE" > /dev/null
+    python -m maelstrom_tpu test -w lin-kv --node tpu:compartment \
+        --roles proxies=2,acceptors=2x2,replicas=2 --rate 20 \
+        --time-limit 3 --seed 11 --no-audit \
+        --nemesis kill,partition --nemesis-interval 0.7 \
+        --nemesis-targets kill=proxies,partition=acceptor-col-0 \
+        --store "$SMOKE_STORE" > /dev/null
+    rm -rf "$SMOKE_STORE"
+    echo "== compartment smoke valid =="
+fi
+
 echo "== static gate clean =="

@@ -1,0 +1,211 @@
+"""Compartmentalized consensus (ISSUE 10, doc/compartment.md): the
+role-partitioned proxy/acceptor/replica cluster serving lin-kv, graded
+by the stock linearizable checker — plain, sharded, and under
+role-targeted fault soups."""
+
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu import nemesis as nem
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.nodes.compartment import (parse_roles,
+                                             roles_node_count)
+
+STORE = "/tmp/maelstrom-compartment-store"
+
+
+def run(opts):
+    base = dict(store_root=STORE, seed=7, rate=20.0, time_limit=2.0,
+                journal_rows=False, audit=False,
+                node="tpu:compartment", workload="lin-kv")
+    return core.run({**base, **opts})
+
+
+# --- role spec / layout ----------------------------------------------------
+
+def test_parse_roles():
+    assert parse_roles("proxies=4,acceptors=2x3,replicas=2") == {
+        "proxies": 4, "rows": 2, "cols": 3, "replicas": 2}
+    # a plain acceptor count is a single-row grid
+    assert parse_roles("acceptors=3") == {
+        "proxies": 2, "rows": 1, "cols": 3, "replicas": 2}
+    assert roles_node_count(None) == 9          # 1 + 2 + 2x2 + 2
+    assert roles_node_count("proxies=4,acceptors=2x3,replicas=3") == 14
+    with pytest.raises(ValueError, match="unknown role"):
+        parse_roles("leaders=2")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_roles("proxies=0")
+
+
+def test_roles_size_the_cluster():
+    nodes = core.parse_nodes({"node": "tpu:compartment",
+                              "roles": "proxies=1,acceptors=1x2,"
+                                       "replicas=1"})
+    assert nodes == ["n0", "n1", "n2", "n3", "n4"]
+    # a mismatched explicit node count is rejected with a clear error
+    with pytest.raises(ValueError, match="needs 9 nodes"):
+        get_program("compartment", {"rate": 5, "time_limit": 1},
+                    [f"n{i}" for i in range(7)])
+
+
+def test_fault_groups_name_roles_and_grid_lines():
+    prog = get_program("compartment", {"rate": 5, "time_limit": 1},
+                       [f"n{i}" for i in range(9)])
+    g = prog.fault_groups()
+    assert g["leader"] == ["n0"]
+    assert g["proxies"] == ["n1", "n2"]
+    assert g["acceptors"] == ["n3", "n4", "n5", "n6"]
+    assert g["replicas"] == ["n7", "n8"]
+    # grid: acceptor local idx = row * cols + col over n3..n6
+    assert g["acceptor-col-0"] == ["n3", "n5"]
+    assert g["acceptor-col-1"] == ["n4", "n6"]
+    assert g["acceptor-row-0"] == ["n3", "n4"]
+    assert g["acceptor-row-1"] == ["n5", "n6"]
+
+
+def test_resolve_targets_and_isolate_set():
+    groups = {"proxies": ["n1", "n2"], "acceptor-col-0": ["n3", "n5"]}
+    nodes = [f"n{i}" for i in range(9)]
+    t = nem.resolve_targets("kill=proxies,partition=acceptor-col-0",
+                            groups, nodes)
+    assert t == {"kill": ["n1", "n2"], "partition": ["n3", "n5"]}
+    # '+' unions groups and literal node names resolve too
+    t2 = nem.resolve_targets("pause=proxies+n7", groups, nodes)
+    assert t2 == {"pause": ["n1", "n2", "n7"]}
+    with pytest.raises(ValueError, match="unknown group"):
+        nem.resolve_targets("kill=replicas", groups, nodes)
+    name, grudge = nem.isolate_set(nodes, ["n3", "n5"])
+    assert "n3" in name
+    assert grudge["n0"] == {"n3", "n5"}
+    assert grudge["n3"] == set(nodes) - {"n3", "n5"}
+
+
+def test_targeted_decisions_stay_in_pool():
+    d = nem.NemesisDecisions([f"n{i}" for i in range(9)], seed=3,
+                             targets={"kill": ["n1", "n2"],
+                                      "partition": ["n3", "n5"]})
+    for _ in range(8):
+        assert set(d.next_kill_targets()) <= {"n1", "n2"}
+    name, grudge = d.next_grudge()
+    assert grudge["n3"] == set(f"n{i}" for i in range(9)) - {"n3", "n5"}
+
+
+# --- end to end ------------------------------------------------------------
+
+def test_compartment_lin_kv_plain():
+    res = run({})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+    assert res["stats"]["ok-count"] > 10
+    # the tiers actually talked: inter-server traffic dominates
+    assert res["net"]["servers"]["send-count"] > \
+        res["stats"]["count"] * 4
+
+
+def test_compartment_targeted_kill_partition_soup():
+    """Kills sample the proxy tier only, the partition cuts acceptor
+    column 0 off the cluster, and the verdict stays valid post-heal."""
+    res = run({"seed": 11, "time_limit": 3.0,
+               "nemesis": {"kill", "partition"},
+               "nemesis_interval": 0.7,
+               "nemesis_targets": "kill=proxies,"
+                                  "partition=acceptor-col-0",
+               "recovery_s": 2})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+    assert res["stats"]["ok-count"] > 10
+    # the recorded kill ops targeted proxies (n1/n2) exclusively
+    import json
+    import os
+    with open(os.path.join(STORE, "latest", "history.jsonl")) as f:
+        kills = [json.loads(ln) for ln in f
+                 if '"start-kill"' in ln and '"info"' in ln]
+    assert kills, "no kill windows fired"
+    for k in kills:
+        v = str(k.get("value"))
+        assert "n1" in v or "n2" in v
+        for other in ("n0", "n3", "n4", "n5", "n6", "n7", "n8"):
+            assert f"'{other}'" not in v
+
+
+@pytest.mark.slow
+def test_compartment_combined_soup():
+    """The full four-package soup (untargeted): kills may wipe volatile
+    proxies, pause anyone, partition arbitrarily, duplicate at-least-
+    once — linearizability must hold through recovery."""
+    res = run({"seed": 13, "time_limit": 3.0,
+               "nemesis": {"kill", "pause", "partition", "duplicate"},
+               "nemesis_interval": 0.7, "recovery_s": 2})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+
+
+@pytest.mark.multichip
+def test_compartment_lin_kv_mesh():
+    res = run({"mesh": "1,2"})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+def test_compartment_soup_mesh():
+    res = run({"seed": 11, "time_limit": 3.0, "mesh": "1,2",
+               "nemesis": {"kill", "partition"},
+               "nemesis_interval": 0.7,
+               "nemesis_targets": "kill=proxies,"
+                                  "partition=acceptor-col-0",
+               "recovery_s": 2})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+
+
+def test_compartment_checkpoints_heterogeneous_tree():
+    """Checkpointing a role-partitioned run snapshots the whole
+    {role: subtree} state (plus the mixed durable views) into a
+    loadable crash-consistent file whose fingerprint pins the role
+    spec."""
+    import os
+
+    from maelstrom_tpu import checkpoint as cp
+    res = run({"checkpoint_every": 0.5, "sync_checkpoint": True})
+    assert res["valid"] is True, res.get("workload")
+    latest = os.path.join(STORE, "latest")
+    state = cp.load(os.path.realpath(latest))
+    assert set(state["sim"].nodes) == {"leader", "proxies",
+                                       "acceptors", "replicas"}
+    assert state["fingerprint"]["roles"] is None      # default spec
+    # a different role spec must refuse to resume this checkpoint
+    with pytest.raises(ValueError, match="roles"):
+        cp.check_fingerprint(
+            state, core.build_test({
+                "workload": "lin-kv", "node": "tpu:compartment",
+                "roles": "proxies=4,acceptors=2x2,replicas=2",
+                "seed": 7, "rate": 20.0, "time_limit": 2.0}))
+
+
+def test_leader_backpressure_sheds_definitely():
+    """A full sequencer table sheds with error 11 (definite fail) —
+    visible backpressure, never a silent drop, and still
+    linearizable."""
+    res = run({"rate": 200.0, "time_limit": 1.0, "leader_slots": 2,
+               "proxy_slots": 2, "concurrency": 16})
+    assert res["valid"] is True, res.get("workload")
+    assert res["stats"]["fail-count"] > 0
+    assert res["stats"]["ok-count"] > 0
+
+
+@pytest.mark.slow
+def test_proxy_scaling_more_ok_ops_at_saturation():
+    """The bench claim in miniature: at an offered rate far above the
+    P=1 tier's capacity, 4 proxies complete materially more ops than 1
+    at the SAME leader/acceptor budget (the full sweep with the >= 2x
+    acceptance floor is BENCH_MODE=compartment)."""
+    fixed = dict(rate=2000.0, time_limit=1.0, concurrency=48,
+                 leader_slots=64, proxy_slots=4, compartment_inbox=16,
+                 kv_keys=256, timeout_ms=20000, seed=11)
+    r1 = run({**fixed, "roles": "proxies=1,acceptors=2x2,replicas=2"})
+    r4 = run({**fixed, "roles": "proxies=4,acceptors=2x2,replicas=2"})
+    assert r1["valid"] is True and r4["valid"] is True
+    ok1, ok4 = r1["stats"]["ok-count"], r4["stats"]["ok-count"]
+    assert ok4 > 1.5 * ok1, (ok1, ok4)

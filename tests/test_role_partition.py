@@ -1,0 +1,117 @@
+"""Role-partitioned cluster regression pins (ISSUE 10).
+
+A `RolePartition` with a single homogeneous role is PURE DELEGATION:
+same PRNG stream, same inbox/outbox shapes, bit-identical histories to
+running the inner program directly — for the edge path (raft,
+broadcast), plain and `--mesh 1,2`, and under the combined nemesis.
+`--node tpu:solo:<program>` is the CLI surface for this configuration.
+"""
+
+import os
+
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.sim import RolePartition
+
+STORE = "/tmp/maelstrom-role-partition-store"
+
+
+def _run(store, opts):
+    base = dict(store_root=store, seed=7, rate=20.0, time_limit=2.0,
+                journal_rows=False, audit=False)
+    return core.run({**base, **opts})
+
+
+def _history(store):
+    with open(os.path.join(store, "latest", "history.jsonl"),
+              "rb") as f:
+        return f.read()
+
+
+def _pin_identity(opts, tag):
+    a = f"{STORE}-{tag}-a"
+    b = f"{STORE}-{tag}-b"
+    res1 = _run(a, opts)
+    res2 = _run(b, {**opts, "node": "tpu:solo:"
+                    + opts["node"][len("tpu:"):]})
+    assert res1["valid"] is True, res1.get("workload")
+    assert res2["valid"] is True, res2.get("workload")
+    assert _history(a) == _history(b), \
+        f"solo-wrapped {opts['node']} diverged from the direct run"
+    assert res1["workload"] == res2["workload"]
+
+
+def test_solo_wrapper_is_role_partition():
+    prog = get_program("solo:lin-kv",
+                       {"rate": 5, "time_limit": 1}, [f"n{i}"
+                                                      for i in range(5)])
+    assert isinstance(prog, RolePartition)
+    assert prog.is_edge                      # raft delegates its edges
+    assert prog.fault_groups() == {"r0": [f"n{i}" for i in range(5)]}
+
+
+def test_solo_raft_bit_identical_plain():
+    """lin-kv on raft: the edge path through a one-role partition is
+    bit-identical to today's single-program sim."""
+    _pin_identity({"workload": "lin-kv", "node": "tpu:lin-kv"}, "raft")
+
+
+def test_solo_broadcast_bit_identical_combined_nemesis():
+    """broadcast under kill,pause,partition,duplicate: durable views,
+    kill/restart, freeze masks, and duplication all flow through the
+    partition's delegation unchanged."""
+    _pin_identity({"workload": "broadcast", "node": "tpu:broadcast",
+                   "topology": "grid", "time_limit": 3.0,
+                   "nemesis": {"kill", "pause", "partition",
+                               "duplicate"},
+                   "nemesis_interval": 0.7, "recovery_s": 2},
+                  "broadcast-soup")
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+def test_solo_raft_bit_identical_mesh():
+    """`--mesh 1,2`: the sharded scan sees identical shapes and
+    shardings through the partition wrapper."""
+    _pin_identity({"workload": "lin-kv", "node": "tpu:lin-kv",
+                   "mesh": "1,2"}, "raft-mesh")
+
+
+@pytest.mark.slow
+def test_solo_raft_bit_identical_combined_nemesis():
+    _pin_identity({"workload": "lin-kv", "node": "tpu:lin-kv",
+                   "time_limit": 3.0,
+                   "nemesis": {"kill", "pause", "partition",
+                               "duplicate"},
+                   "nemesis_interval": 0.7, "recovery_s": 2},
+                  "raft-soup")
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+def test_solo_broadcast_bit_identical_mesh_nemesis():
+    _pin_identity({"workload": "broadcast", "node": "tpu:broadcast",
+                   "topology": "grid", "time_limit": 3.0, "mesh": "1,2",
+                   "nemesis": {"kill", "pause", "partition",
+                               "duplicate"},
+                   "nemesis_interval": 0.7, "recovery_s": 2},
+                  "broadcast-mesh-soup")
+
+
+def test_partition_rejects_bad_role_sum():
+    import jax.numpy as jnp  # noqa: F401
+
+    inner = get_program("echo", {}, ["n0", "n1", "n2"])
+    with pytest.raises(ValueError, match="role sizes"):
+        RolePartition({}, ["n0", "n1"], [("r0", inner)])
+
+
+def test_partition_rejects_multi_role_edge():
+    opts = {"rate": 5, "time_limit": 1}
+    raft = get_program("lin-kv", opts, ["n0", "n1", "n2"])
+    echo = get_program("echo", opts, ["n3", "n4"])
+    with pytest.raises(ValueError, match="single role"):
+        RolePartition(opts, [f"n{i}" for i in range(5)],
+                      [("kv", raft), ("echo", echo)])

@@ -1,0 +1,234 @@
+"""In-cluster service nodes (ISSUE 10 satellite): the lin-tso / seq-kv /
+lww-kv role programs pinned against the PURE reference state machines in
+`maelstrom_tpu/services.py` (the oracles), plus the lin-tso workload
+smoke on the role-partitioned services cluster."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu.history import History
+from maelstrom_tpu.checkers.tso import TSOChecker
+from maelstrom_tpu.net.tpu import Msgs
+from maelstrom_tpu.nodes import get_program
+from maelstrom_tpu.nodes.services import (
+    LWWKVRole, SeqKVRole, TSORole, T_CAS, T_MERGE, T_READ, T_TS,
+    T_TS_OK, T_WRITE, parse_service_roles, roles_node_count)
+from maelstrom_tpu.services import (LWWKV, Linearizable, PersistentKV,
+                                    PersistentTSO)
+
+STORE = "/tmp/maelstrom-services-store"
+
+
+class _Msg:
+    """Shape of the host services' message argument."""
+
+    def __init__(self, body, src="c1"):
+        self.body = body
+        self.src = src
+
+
+def _inbox(rows, K=8, n=1):
+    """[n, K] inbox with `rows` = [(node, type, a, b, c)] packed into
+    successive lanes of their node."""
+    ib = {f: np.zeros((n, K), np.int32) for f in
+          ("src", "dest", "due", "mid", "reply_to", "type", "a", "b",
+           "c")}
+    valid = np.zeros((n, K), bool)
+    lane = [0] * n
+    for i, (node, t, a, b, c) in enumerate(rows):
+        k = lane[node]
+        lane[node] += 1
+        valid[node, k] = True
+        ib["type"][node, k] = t
+        ib["a"][node, k] = a
+        ib["b"][node, k] = b
+        ib["c"][node, k] = c
+        ib["src"][node, k] = 100 + i
+        ib["mid"][node, k] = 1000 + i
+    return Msgs(valid=jnp.asarray(valid),
+                **{f: jnp.asarray(v) for f, v in ib.items()})
+
+
+def _replies(out):
+    o = jax.device_get(out)
+    v = np.asarray(o.valid)
+    rows = []
+    for n, k in zip(*np.nonzero(v)):
+        rows.append((int(o.reply_to[n, k]), int(o.type[n, k]),
+                     int(o.a[n, k])))
+    return rows
+
+
+def _ctx(r=0):
+    return {"round": jnp.int32(r), "key": jax.random.PRNGKey(0)}
+
+
+# --- oracles ---------------------------------------------------------------
+
+def test_tso_role_matches_persistent_tso_oracle():
+    prog = TSORole({}, ["n0"])
+    st = prog.init_state()
+    oracle = Linearizable(PersistentTSO())
+    got = []
+    for rnd in range(4):
+        st, out = prog.step(st, _inbox([(0, T_TS, 0, 0, 0),
+                                        (0, T_TS, 0, 0, 0)]), _ctx(rnd))
+        got += [a for _m, t, a in _replies(out) if t == T_TS_OK]
+    want = [oracle.handle(_Msg({"type": "ts"}))["ts"] for _ in range(8)]
+    assert got == want
+    assert len(set(got)) == len(got)
+
+
+def test_seq_kv_role_matches_linearizable_kv_oracle():
+    import random
+    rng = random.Random(5)
+    prog = SeqKVRole({"kv_keys": 8}, ["n0"])
+    st = prog.init_state()
+    oracle = Linearizable(PersistentKV())
+    for rnd in range(16):
+        ops = []
+        for _ in range(3):
+            k, v = rng.randrange(4), rng.randrange(5)
+            ops.append(rng.choice([
+                (0, T_READ, k, 0, 0),
+                (0, T_WRITE, k, v, 0),
+                (0, T_CAS, k, v, rng.randrange(5)),
+            ]))
+        st, out = prog.step(st, _inbox(ops), _ctx(rnd))
+        reps = {m: (t, a) for m, t, a in _replies(out)}
+        for i, (node, t, a, b, c) in enumerate(ops):
+            if t == T_READ:
+                body = {"type": "read", "key": a}
+            elif t == T_WRITE:
+                body = {"type": "write", "key": a, "value": b}
+            else:
+                body = {"type": "cas", "key": a, "from": b, "to": c}
+            want = oracle.handle(_Msg(body))
+            rt, ra = reps[1000 + i]
+            if want["type"] == "read_ok":
+                assert (rt, ra - 1) == (11, want["value"])
+            elif want["type"] == "error":
+                assert (rt, ra) == (1, want["code"])
+            else:
+                assert rt in (13, 15)
+
+
+def test_lww_role_single_replica_matches_lww_oracle():
+    import random
+    rng = random.Random(9)
+    prog = LWWKVRole({"kv_keys": 8}, ["n0"])
+    st = prog.init_state()
+    oracle = LWWKV()
+    for rnd in range(24):
+        k, v = rng.randrange(4), rng.randrange(5)
+        t = rng.choice([T_READ, T_WRITE])
+        st, out = prog.step(st, _inbox([(0, t, k, v, 0)]), _ctx(rnd))
+        body = ({"type": "read", "key": k} if t == T_READ
+                else {"type": "write", "key": k, "value": v})
+        oracle, want = oracle.handle(_Msg(body))
+        ((_m, rt, ra),) = _replies(out)
+        if want["type"] == "read_ok":
+            assert (rt, ra - 1) == (11, want["value"])
+        elif want["type"] == "error":
+            assert (rt, ra) == (1, want["code"])
+        else:
+            assert rt == 13
+
+
+def test_lww_gossip_converges_and_quiesces():
+    """Three replicas: a write at replica 0 propagates the ring via
+    dirty-set gossip; all copies converge and the dirty sets drain
+    (the quiescence signal)."""
+    prog = LWWKVRole({"kv_keys": 8, "gossip_keys": 4},
+                     ["n0", "n1", "n2"], base=0)
+    st = prog.init_state()
+    st, out = prog.step(
+        st, _inbox([(0, T_WRITE, 3, 7, 0), (1, T_WRITE, 5, 2, 0)],
+                   n=3), _ctx(0))
+    # the write's dirty bits drained into in-flight gossip the same
+    # round (the POOL keeps the runner non-quiescent while they fly)
+    o0 = jax.device_get(out)
+    assert (np.asarray(o0.valid)
+            & (np.asarray(o0.type) == T_MERGE)).sum() == 2
+    for rnd in range(1, 12):
+        # hand-route the gossip: T_MERGE lanes target dest node
+        o = jax.device_get(out)
+        rows = []
+        v = np.asarray(o.valid)
+        for n, k in zip(*np.nonzero(v)):
+            if int(o.type[n, k]) == T_MERGE:
+                rows.append((int(o.dest[n, k]), T_MERGE,
+                             int(o.a[n, k]), int(o.b[n, k]),
+                             int(o.c[n, k])))
+        st, out = prog.step(st, _inbox(rows, n=3), _ctx(rnd))
+    kv = np.asarray(jax.device_get(st["kv"]))
+    assert (kv[:, 3] == 8).all() and (kv[:, 5] == 3).all()  # value+1
+    assert bool(prog.quiescent(st))
+
+
+# --- services partition + workload ----------------------------------------
+
+def test_parse_service_roles():
+    assert parse_service_roles(None) == {"lin-tso": 1, "seq-kv": 1,
+                                         "lww-kv": 3}
+    assert roles_node_count(None) == 5
+    assert roles_node_count("lin-tso=1,lww-kv=2") == 3
+    with pytest.raises(ValueError, match="unknown service"):
+        parse_service_roles("tso=1")
+    with pytest.raises(ValueError, match="single-copy"):
+        parse_service_roles("lin-tso=1,seq-kv=2")
+
+
+def test_lin_tso_e2e_on_services_cluster():
+    res = core.run(dict(store_root=STORE, seed=7, workload="lin-tso",
+                        node="tpu:services", rate=20.0, time_limit=2.0,
+                        journal_rows=False, audit=False))
+    assert res["valid"] is True, res.get("workload")
+    w = res["workload"]
+    assert w["valid"] is True and w["monotonic"] is True
+    assert w["granted-count"] > 10
+
+
+def test_services_fault_groups():
+    prog = get_program("services", {}, [f"n{i}" for i in range(5)])
+    g = prog.fault_groups()
+    assert g["lin-tso"] == ["n0"]
+    assert g["seq-kv"] == ["n1"]
+    assert g["lww-kv"] == ["n2", "n3", "n4"]
+
+
+# --- TSO checker -----------------------------------------------------------
+
+def _tso_history(rows):
+    """rows: (process, invoke_ns, complete_ns, ts) — appended in global
+    time order, the way a real runner interleaves them."""
+    events = []
+    for p, inv, comp, ts in rows:
+        events.append((inv, "invoke", p, None))
+        events.append((comp, "ok", p, ts))
+    h = History()
+    for t, kind, p, val in sorted(events, key=lambda e: e[0]):
+        h.append_row(kind, "ts", val, p, t)
+    return h
+
+
+def test_tso_checker_accepts_witness_order():
+    h = _tso_history([(0, 0, 10, 0), (1, 20, 30, 1), (0, 25, 40, 2)])
+    res = TSOChecker().check({}, h, {})
+    assert res["valid"] is True
+
+
+def test_tso_checker_rejects_realtime_violation():
+    # op with ts=5 completed before the ts=1 op invoked: violation
+    h = _tso_history([(0, 0, 10, 5), (1, 20, 30, 1)])
+    res = TSOChecker().check({}, h, {})
+    assert res["valid"] is False and res["violations"]
+
+
+def test_tso_checker_rejects_duplicates():
+    h = _tso_history([(0, 0, 10, 3), (1, 20, 30, 3)])
+    res = TSOChecker().check({}, h, {})
+    assert res["valid"] is False and res["duplicate-ts"] == [3]

@@ -329,6 +329,22 @@ def test_gate_production_plain_round_and_scan_fns():
     assert suppressed, "baseline entries stopped matching: stale baseline"
 
 
+def test_gate_traces_role_partitioned_step_fns():
+    """ISSUE 10: the default program set traces the role-partitioned
+    families — the compartmentalized consensus cluster and the
+    in-cluster service nodes — so the PR 5 rules cover the
+    RolePartition step path (per-role slicing, heterogeneous state
+    tree, scatter-heavy table allocation) with zero non-baselined
+    findings."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["compartment", "lin-tso"], mesh=None, fleet=False)
+    assert any(e.startswith("round_fn[compartment") for e in entries)
+    assert any(e.startswith("scan_fn[lin-tso") for e in entries)
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+
+
 def test_gate_traces_continuous_scan_variant():
     """ISSUE 7: the default program set now traces the continuous-mode
     (`--continuous`) sched-inject scan, so the PR 5 rules cover the new
