@@ -183,11 +183,154 @@ def run_with_env_retry(fn, attempts=None, backoff_s=None,
     sys.exit(3)
 
 
+def elle_synthetic(elle_ops):
+    """The checker bench's synthetic list-append transaction set:
+    per-key serial version chains plus random prefix reads, ~elle_ops
+    micro-ops total. Key count scales DOWN with tiny elle_ops so the
+    version-construction floor (2 appends per key) never eats the whole
+    budget — small sizes keep a read-bearing, multi-version workload
+    instead of degenerating to appends-only single-version keys.
+    Returns (txns, longest, appender, micro_ops)."""
+    ekeys = min(64, max(1, elle_ops // 10))
+    versions_per_key = max(2, elle_ops // (5 * ekeys))
+    rng = np.random.RandomState(7)
+    txns, longest, appender = [], {}, {}
+    micro_ops = 0
+    for ki in range(ekeys):
+        kk = repr(ki)
+        order = []
+        for vi in range(versions_per_key):
+            vv = repr(ki * versions_per_key + vi)
+            tid = len(txns)
+            txns.append({"id": tid, "ok": True, "inv": micro_ops,
+                         "ret": micro_ops + 1,
+                         "micro": [["append", ki,
+                                    ki * versions_per_key + vi]]})
+            appender[(kk, vv)] = tid
+            order.append(vv)
+            micro_ops += 1
+        longest[kk] = order
+    # reads fill whatever the version floor left of the budget
+    n_reads = max(0, elle_ops - micro_ops)
+    read_keys = rng.randint(0, ekeys, n_reads)
+    read_lens = rng.randint(0, versions_per_key + 1, n_reads)
+    for ki, ln in zip(read_keys.tolist(), read_lens.tolist()):
+        tid = len(txns)
+        txns.append({"id": tid, "ok": True, "inv": micro_ops,
+                     "ret": micro_ops + 1,
+                     "micro": [["r", ki,
+                                list(range(ki * versions_per_key,
+                                           ki * versions_per_key
+                                           + ln))]]})
+        micro_ops += 1
+    return txns, longest, appender, micro_ops
+
+
+def bench_elle_device_record(txns, longest, appender, micro_ops,
+                             py_s, ev) -> dict:
+    """The device-resident edge build + cycle screen
+    (checkers/elle_device.py, doc/perf.md "device-resident grading")
+    against the pure-Python baseline time `py_s`:
+
+      - flatten_s: the one-shot host columnarization of the read table
+        (on overlapped production runs the stream observer builds this
+        incrementally, concurrent with device compute);
+      - table_s: the per-key version-table merge + gather positions
+        (host numpy);
+      - build_s: the jitted edge construction, post-compile, timed to
+        `block_until_ready` — the at-check cost when the pipeline
+        pre-fed the columns;
+      - screen_s: the jitted data-stage cycle screen (this synthetic's
+        stale prefix reads make it realtime-CYCLIC by design, so only
+        the data stage is meaningful here; the decided-fraction
+        fixtures below exercise the realtime stage on valid shapes).
+
+    `speedup` (the acceptance figure) = python_s / build_s;
+    `speedup_total` = python_s / (flatten + table + build), the honest
+    one-shot post-hoc number. The edge set is asserted equal to the
+    vectorized build (`match`)."""
+    from maelstrom_tpu.checkers import elle_device as ed
+    if not ed.available():
+        return {"available": False}
+    import jax
+
+    t0 = time.perf_counter()
+    cols = ed.build_columns(txns)
+    flatten_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    writers, slot_key, slot_idx, offsets, lens, key_idx = \
+        ed._writer_table(longest, appender, repr)
+    tid, n_, wr_pos, rw_pos = ed.read_positions(cols, key_idx, offsets,
+                                                lens, repr)
+    # the production assembly (ed.device_args — the same padding +
+    # index scatter screen_arrays dispatches through), with no rt
+    # inputs: this synthetic's stale prefix reads make it
+    # realtime-cyclic by design, so only the data stage is timed
+    no_rt = np.zeros(0, np.int64)
+    eargs, sargs, tp, have_rt = ed.device_args(
+        writers, slot_key, slot_idx, tid, n_, wr_pos, rw_pos, no_rt,
+        no_rt, len(txns))
+    table_s = time.perf_counter() - t0
+
+    fns = ed._fns()
+    jax.block_until_ready(fns["edges"](*eargs))     # compile
+    t0 = time.perf_counter()
+    earrs = fns["edges"](*eargs)
+    jax.block_until_ready(earrs)
+    build_s = time.perf_counter() - t0
+
+    jax.block_until_ready(fns["screen"](*sargs, n_txns_pad=tp,
+                                        do_rt=have_rt))   # compile
+    t0 = time.perf_counter()
+    data_ok, _full, it_a, _it_b = jax.device_get(
+        fns["screen"](*sargs, n_txns_pad=tp, do_rt=have_rt))
+    screen_s = time.perf_counter() - t0
+
+    es = ed.DeviceElle(earrs, data_ok, False,
+                       (int(it_a), 0), {}).edge_set()
+    total_s = flatten_s + table_s + build_s
+    rec = {
+        "flatten_s": round(flatten_s, 4),
+        "table_s": round(table_s, 4),
+        "build_s": round(build_s, 4),
+        "screen_s": round(screen_s, 4),
+        "total_s": round(total_s, 4),
+        "build_ops_per_s": round(micro_ops / max(build_s, 1e-9), 1),
+        "match": es == ev,
+        "speedup": round(py_s / max(build_s, 1e-9), 2),
+        "speedup_total": round(py_s / max(total_s, 1e-9), 2),
+        "screen_data_decided": bool(data_ok),
+        "screen_iters": int(it_a),
+    }
+
+    # screen decided-fraction: valid (acyclic) concurrent histories
+    # from the shared generator — the screen must certify >= 90% of
+    # them end to end (realtime stage included), skipping Tarjan
+    from maelstrom_tpu.checkers.elle import (_fail_appends, _txn_ops,
+                                             analyze_txns)
+    from maelstrom_tpu.testing.histories import random_append_history
+    n_fix = int(os.environ.get("BENCH_CHECKER_SCREEN_FIXTURES", 12))
+    decided = 0
+    for seed in range(n_fix):
+        h = random_append_history(seed, n_txn=150)
+        rep = {}
+        analyze_txns(_txn_ops(h), _fail_appends(h), device="on",
+                     report=rep)
+        if rep.get("screen", {}).get("realtime") == "acyclic":
+            decided += 1
+    rec["screen_fixtures"] = {
+        "histories": n_fix, "decided": decided,
+        "decided_fraction": round(decided / max(n_fix, 1), 3),
+    }
+    return rec
+
+
 def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
-    """Checker-throughput section: the analysis pipeline's two hot
-    paths on synthetic histories, each against its pure-Python
-    baseline, so checker perf rides the BENCH_*.json trajectory next to
-    simulation msgs/s.
+    """Checker-throughput section: the analysis pipeline's hot paths on
+    synthetic histories, each against its pure-Python baseline, so
+    checker perf rides the BENCH_*.json trajectory next to simulation
+    msgs/s.
 
       - register: a 1M-row lin-kv history through
         LinearizableRegisterChecker — columnar partition + vectorized
@@ -195,10 +338,15 @@ def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
       - elle: ww/wr/rw dependency-edge construction on a ~1M-micro-op
         list-append transaction set — sorted-index-array build vs. the
         nested-loop build
+      - elle.device: the SAME edge set built by the jitted device
+        constructor plus the on-device cycle screen
+        (doc/perf.md "device-resident grading"), with a
+        screen-decided-fraction sweep over valid synthetic histories
 
-    Pure host/numpy work (no JAX backend), so it runs identically on
-    the CPU fallback. Both halves assert verdict/edge equality; a
-    mismatch marks the record invalid."""
+    The register/elle halves are pure host/numpy (identical on the CPU
+    fallback); the device block runs on whatever backend jax has. All
+    halves assert verdict/edge equality; a mismatch marks the record
+    invalid."""
     from maelstrom_tpu.checkers.elle import (_edges_python,
                                              _edges_vectorized)
     from maelstrom_tpu.checkers.linearizable import \
@@ -252,38 +400,7 @@ def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
     # elle: synthetic append/read transaction set -> edge build only
     elle_ops = elle_ops or int(
         os.environ.get("BENCH_CHECKER_ELLE_OPS", 1_000_000))
-    ekeys = 64
-    versions_per_key = max(2, elle_ops // (5 * ekeys))
-    rng = np.random.RandomState(7)
-    txns, longest, appender = [], {}, {}
-    micro_ops = 0
-    for ki in range(ekeys):
-        kk = repr(ki)
-        order = []
-        for vi in range(versions_per_key):
-            vv = repr(ki * versions_per_key + vi)
-            tid = len(txns)
-            txns.append({"id": tid, "ok": True, "inv": micro_ops,
-                         "ret": micro_ops + 1,
-                         "micro": [["append", ki,
-                                    ki * versions_per_key + vi]]})
-            appender[(kk, vv)] = tid
-            order.append(vv)
-            micro_ops += 1
-        longest[kk] = order
-    # version construction has a floor of 2*ekeys appends; a tiny
-    # elle_ops must clamp instead of asking for negative reads
-    n_reads = max(0, elle_ops - micro_ops)
-    read_keys = rng.randint(0, ekeys, n_reads)
-    read_lens = rng.randint(0, versions_per_key + 1, n_reads)
-    for ki, ln in zip(read_keys.tolist(), read_lens.tolist()):
-        tid = len(txns)
-        txns.append({"id": tid, "ok": True, "inv": micro_ops,
-                     "ret": micro_ops + 1,
-                     "micro": [["r", ki,
-                                list(range(ki * versions_per_key,
-                                           ki * versions_per_key + ln))]]})
-        micro_ops += 1
+    txns, longest, appender, micro_ops = elle_synthetic(elle_ops)
     t0 = time.perf_counter()
     ev = _edges_vectorized(txns, longest, appender)
     vec_s = time.perf_counter() - t0
@@ -291,7 +408,7 @@ def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
     ep = _edges_python(txns, longest, appender)
     py_s = time.perf_counter() - t0
     elle = {
-        "micro_ops": micro_ops, "keys": ekeys,
+        "micro_ops": micro_ops, "keys": len(longest),
         "edges": len(ev), "match": ev == ep,
         "vectorized_s": round(vec_s, 4),
         "vectorized_ops_per_s": round(micro_ops / vec_s, 1),
@@ -299,9 +416,22 @@ def bench_checkers_record(n_rows=None, elle_ops=None) -> dict:
         "python_ops_per_s": round(micro_ops / py_s, 1),
         "speedup": round(py_s / vec_s, 2),
     }
+
+    # device path (BENCH_CHECKER_DEVICE=0 to skip): jitted edge build
+    # + on-device cycle screen vs the same python baseline
+    device = None
+    if os.environ.get("BENCH_CHECKER_DEVICE", "1") == "1":
+        device = bench_elle_device_record(txns, longest, appender,
+                                          micro_ops, py_s, ev)
+        elle["device"] = device
+
+    dev_ok = (device is None or not device.get("available", True)
+              or (device["match"]
+                  and device["screen_fixtures"]["decided_fraction"]
+                  >= 0.9))
     return {"register": register, "elle": elle,
             "valid": bool(register["verdicts_match"] and elle["match"]
-                          and register["valid"] is True)}
+                          and register["valid"] is True and dev_ok)}
 
 
 def bench_raft_clusters():
@@ -949,6 +1079,10 @@ def main():
     if mode == "fleet":
         metric, unit = "fleet_agg_msgs_per_sec", "msgs/sec"
         fn = _main_fleet
+    elif mode == "checker":
+        metric = "checker_elle_device_edge_build_ops_per_sec"
+        unit = "micro-ops/sec"
+        fn = _main_checker
     elif mode == "compartment":
         metric, unit = "compartment_client_ops_per_vsec", "client-ops/vsec"
         fn = _main_compartment
@@ -1222,6 +1356,29 @@ def _main_broadcast():
     # a batched-broadcast side that fails to converge is a protocol
     # bug in the range-gossip node, not a perf datum
     if batched is not None and not batched["valid"]:
+        sys.exit(1)
+
+
+def _main_checker():
+    """`BENCH_MODE=checker`: the checker-throughput record as its own
+    artifact (run_tpu_recapture.sh step 1f), headline `value` = the
+    device edge build's micro-ops/sec at 1M micro-ops, `vs_baseline` =
+    its speedup over `_edges_python` — the ISSUE 11 acceptance figure —
+    with the register/elle host ratios and the screen decided-fraction
+    riding the same record. Exits nonzero when any half mismatches its
+    baseline or the screen decides < 90% of the acyclic fixtures."""
+    rec = bench_checkers_record()
+    dev = (rec["elle"].get("device") or {})
+    record = {
+        "metric": "checker_elle_device_edge_build_ops_per_sec",
+        "value": dev.get("build_ops_per_s"),
+        "unit": "micro-ops/sec",
+        "vs_baseline": dev.get("speedup"),
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"]:
         sys.exit(1)
 
 

@@ -470,6 +470,48 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
     return specs
 
 
+def checker_step_specs() -> list[StepSpec]:
+    """The device-resident checker's jitted entry points (doc/perf.md
+    "device-resident grading"): the elle edge constructor and the
+    cycle-screen fixed point (`checkers/elle_device.py`). Small example
+    shape buckets — the kernels are shape-polymorphic over pow-2
+    buckets, so one trace covers the hazard surface. No donation: the
+    checker runs between dispatches on throwaway arrays."""
+    import numpy as np
+
+    from ..checkers import elle_device as ed
+
+    vp, rp, tp = 32, 32, 32
+    writers = np.full(vp, -1, np.int32)
+    writers[:8] = np.arange(8)
+    slot_key = np.full(vp, -1, np.int32)
+    slot_key[:8] = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+    slot_idx = np.zeros(vp, np.int32)
+    slot_idx[:8] = np.array([0, 1, 2, 3, 0, 1, 2, 3])
+    r_tid = np.full(rp, -1, np.int32)
+    r_tid[:4] = np.array([8, 9, 10, 11])
+    r_n = np.zeros(rp, np.int32)
+    r_n[:4] = np.array([1, 2, 0, 4])
+    wr_pos = np.full(rp, -1, np.int32)
+    wr_pos[:4] = np.array([0, 1, -1, 3])
+    rw_pos = np.full(rp, -1, np.int32)
+    rw_pos[:4] = np.array([1, 2, 0, -1])
+    ret_tid = np.full(tp, -1, np.int32)
+    ret_tid[:12] = np.arange(12)
+    before_idx = np.full(tp, -1, np.int32)
+    before_idx[:12] = np.arange(12) - 1
+    fns = ed._build_fns()
+    return [
+        StepSpec(name="elle_edges_fn",
+                 fn=fns["edges_raw"],
+                 args=(writers, slot_key, r_tid, wr_pos, rw_pos)),
+        StepSpec(name="elle_screen_fn",
+                 fn=lambda *a: fns["screen_raw"](*a, n_txns_pad=tp),
+                 args=(writers, slot_key, slot_idx, r_tid, r_n, wr_pos,
+                       rw_pos, ret_tid, before_idx)),
+    ]
+
+
 def audit_production(programs=None, mesh: str | None = "auto",
                      fleet: bool = True):
     """Traces and audits the production step functions for each
@@ -523,6 +565,14 @@ def audit_production(programs=None, mesh: str | None = "auto",
             for spec in fleet_step_specs(workload, mesh=mesh_spec):
                 findings += audit_step(spec)
                 entries.append(spec.name)
+
+    # device-resident checker kernels (doc/perf.md "device-resident
+    # grading"): traced whenever the program set includes the elle
+    # workload — the checker is part of that workload's hot path now
+    if "txn-list-append" in programs:
+        for spec in checker_step_specs():
+            findings += audit_step(spec)
+            entries.append(spec.name)
     return findings, entries, notes
 
 

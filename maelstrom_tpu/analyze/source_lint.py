@@ -36,6 +36,7 @@ from . import Finding
 DEFAULT_LINT_PATHS = (
     "runner", "net", "sim.py", "nemesis.py", "history.py",
     "checkers/pipeline.py", "checkers/linearizable.py",
+    "checkers/elle.py", "checkers/elle_device.py",
 )
 
 _RANDOM_DRAWS = {"random", "randint", "randrange", "choice", "choices",

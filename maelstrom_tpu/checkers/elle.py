@@ -208,11 +208,30 @@ def _edges_vectorized(txns, longest, appender):
     return edges
 
 
-def analyze(history, *, edges_impl=None) -> dict:
+def analyze(history, *, edges_impl=None, device=None) -> dict:
     history = coerce_history(history)
-    txns = _txn_ops(history)
-    failed_appends = _fail_appends(history)
+    return analyze_txns(_txn_ops(history), _fail_appends(history),
+                        edges_impl=edges_impl, device=device)
 
+
+def analyze_txns(txns, failed_appends, *, edges_impl=None, device=None,
+                 columns=None, transfer=None, report=None) -> dict:
+    """The anomaly analysis over a pre-extracted transaction set
+    (`_txn_ops`-shaped dicts + the failed-append set). `analyze` wraps
+    it for plain histories; the checker's stream observer serves the
+    same inputs pre-collected by the overlapped pipeline.
+
+    `device` selects the device-resident path (doc/perf.md
+    "device-resident grading"): "on"/"off"/"auto" (None = auto). When
+    it engages, ww/wr/rw edge construction runs jitted on the device
+    (`checkers/elle_device.py`, bit-equal to `_edges_vectorized`) and
+    an on-device cycle screen certifies acyclic dependency graphs —
+    a definite pass that skips Tarjan entirely; any undecided graph
+    falls back to the host Tarjan/classification path on the identical
+    edge set, so verdicts are bit-equal by construction. `columns`
+    optionally carries the pipeline-prebuilt read table; `transfer`
+    books device wall time into the runner's TransferStats; `report`
+    (a dict) receives the deterministic device stats block."""
     anomalies: dict[str, list] = {}
 
     def add_anom(kind, item):
@@ -373,31 +392,61 @@ def analyze(history, *, edges_impl=None) -> dict:
                      {"key": k, "loaded": raw, "txns": ids})
 
     # --- dependency graph ---
+    # Realtime structure first (shared by the device screen and the host
+    # barrier construction): ok txns in completion order, plus each
+    # txn's latest-completion-strictly-before-invocation index — one
+    # batched searchsorted over the ret-sorted completion times.
+    ok_txns = sorted((t for t in txns if t["ok"]), key=lambda t: t["ret"])
+    m = len(ok_txns)
+    rets = np.fromiter((t["ret"] for t in ok_txns), np.float64, m)
+    invs = np.fromiter((t["inv"] for t in ok_txns), np.float64, m)
+    before = np.searchsorted(rets, invs, side="left") - 1
+
+    # Device path (doc/perf.md "device-resident grading"): jitted edge
+    # construction + the on-device cycle screen. The screen is sound
+    # one-way — "acyclic" is a definite pass that skips Tarjan; any
+    # undecided graph falls through to the host walk over the IDENTICAL
+    # edge set, so verdicts stay bit-equal by construction.
+    from . import elle_device as _device
+    dev = None
+    if edges_impl is None and _device.resolve(device, len(txns)):
+        ok_tids = np.fromiter((t["id"] for t in ok_txns), np.int64, m)
+        dev = _device.run(txns, longest, appender, _hk, columns=columns,
+                          rt=(ok_tids, before), transfer=transfer)
+    if report is not None and dev is not None:
+        report.update(dev.report())
+
     # edges: (src, dst, kind) with kind in ww/wr/rw, built from sorted
-    # index arrays (`_edges_vectorized`); tests/benches inject
-    # `_edges_python` to pin equivalence / measure the speedup
-    edges = (edges_impl or _edges_vectorized)(txns, longest, appender)
+    # index arrays (`_edges_vectorized`) or fetched off the device
+    # arrays; tests/benches inject `_edges_python` to pin equivalence /
+    # measure the speedup. Materialized lazily: a screened-acyclic run
+    # never builds the Python edge set at all.
+    _edge_cache: list = []
+
+    def edge_set() -> set:
+        if not _edge_cache:
+            if dev is not None:
+                _edge_cache.append(dev.edge_set())
+            else:
+                _edge_cache.append((edges_impl or _edges_vectorized)(
+                    txns, longest, appender))
+        return _edge_cache[0]
 
     # Real-time edges via a barrier chain rather than the O(n^2) transitive
     # closure: each txn points at the barrier for its completion time;
     # barriers chain forward; each txn is pointed at by the latest barrier
     # before its invocation. t1 reaches t2 through barriers iff
-    # ret(t1) < inv(t2), preserving exactly the realtime cycles. The
-    # latest-barrier-before-invocation search is one batched
-    # searchsorted over the ret-sorted completion times.
-    rt_edges = set()
-    ok_txns = sorted((t for t in txns if t["ok"]), key=lambda t: t["ret"])
-    for i in range(len(ok_txns) - 1):
-        rt_edges.add((("b", i), ("b", i + 1), "rt"))
-    for i, t in enumerate(ok_txns):
-        rt_edges.add((t["id"], ("b", i), "rt"))
-    if ok_txns:
-        m = len(ok_txns)
-        rets = np.fromiter((t["ret"] for t in ok_txns), np.float64, m)
-        invs = np.fromiter((t["inv"] for t in ok_txns), np.float64, m)
-        before = np.searchsorted(rets, invs, side="left") - 1
+    # ret(t1) < inv(t2), preserving exactly the realtime cycles. Built
+    # only when the screen did not already certify the combined graph.
+    def realtime_edges() -> set:
+        rt_edges = set()
+        for i in range(len(ok_txns) - 1):
+            rt_edges.add((("b", i), ("b", i + 1), "rt"))
+        for i, t in enumerate(ok_txns):
+            rt_edges.add((t["id"], ("b", i), "rt"))
         for i in np.flatnonzero(before >= 0):
             rt_edges.add((("b", int(before[i])), ok_txns[i]["id"], "rt"))
+        return rt_edges
 
     def cycles_with(edge_set):
         """Tarjan SCC; returns list of cycles (as lists of txn ids)."""
@@ -583,22 +632,33 @@ def analyze(history, *, edges_impl=None) -> dict:
                 return "G-nonadjacent"
         return "G2"
 
-    base_sccs = cycles_with(edges)
+    # The screen's "acyclic" is definite: a Tarjan pass over the same
+    # graph would find zero multi-node SCCs, so skipping it preserves
+    # bit-equal verdicts. An undecided screen (or no device) walks the
+    # host path unchanged.
+    if dev is not None and dev.data_acyclic:
+        base_sccs = []
+    else:
+        base_sccs = cycles_with(edge_set())
     for scc in base_sccs:
-        text, ops, kinds_used = explain(scc, edges)
+        text, ops, kinds_used = explain(scc, edge_set())
         add_anom(classify_steps(kinds_used),
                  {"txns": txn_ids(scc), "cycle": text, "txn-ops": ops})
     base_cycle_ids = {frozenset(txn_ids(s)) for s in base_sccs}
-    for scc in cycles_with(edges | rt_edges):
-        if frozenset(txn_ids(scc)) not in base_cycle_ids:
-            rendered = explain_realtime(scc, edges | rt_edges)
-            if rendered is None:
-                # no rt edge in the SCC: it's a data anomaly whose SCC
-                # boundary merely shifted; the base pass covers its cycles
-                continue
-            text, ops, kinds_used = rendered
-            add_anom(classify_steps(kinds_used) + "-realtime",
-                     {"txns": txn_ids(scc), "cycle": text, "txn-ops": ops})
+    if not (dev is not None and dev.full_acyclic):
+        combined = edge_set() | realtime_edges()
+        for scc in cycles_with(combined):
+            if frozenset(txn_ids(scc)) not in base_cycle_ids:
+                rendered = explain_realtime(scc, combined)
+                if rendered is None:
+                    # no rt edge in the SCC: it's a data anomaly whose
+                    # SCC boundary merely shifted; the base pass covers
+                    # its cycles
+                    continue
+                text, ops, kinds_used = rendered
+                add_anom(classify_steps(kinds_used) + "-realtime",
+                         {"txns": txn_ids(scc), "cycle": text,
+                          "txn-ops": ops})
 
     return anomalies
 
@@ -630,19 +690,255 @@ ILLEGAL = {
 }
 
 
+class ElleStreamObserver:
+    """Incremental transaction collection for the overlapped analysis
+    pipeline (doc/streams.md): fed every completed (invoke, completion)
+    pair as drained segments land, it builds the columnar read table
+    the device edge constructor consumes (`elle_device.ElleColumns`) —
+    so on overlapped runs the host-side flatten cost runs concurrently
+    with device compute instead of serializing behind the run — plus
+    the failed-append set and the per-txn records `analyze_txns` needs.
+    At check time `finish_txns()` re-sorts to invoke order (provisional
+    ids remap in one numpy pass), so verdicts are bit-equal to the
+    post-hoc `_txn_ops` path by construction.
+
+    With `--device-checker on` each window close additionally runs the
+    on-device cycle screen over the prefix collected so far — an
+    early-warning per-window verdict ("acyclic so far" vs "candidate
+    cycle, Tarjan will classify at check time")."""
+
+    # past this many collected micro-ops the per-window screen stops
+    # (its per-close rebuild is O(prefix)); check time is unaffected
+    WINDOW_SCREEN_CAP = 200_000
+
+    def __init__(self, test):
+        from . import elle_device
+        self._ed = elle_device
+        dev = (test or {}).get("device_checker")
+        self._screen_windows = dev in (True, "on", "1") \
+            and elle_device.available()
+        self._rows: list = []       # invoke row per collected txn
+        self._recs: list = []       # (ok, micro, inv_t, ret_t)
+        self.columns = elle_device.ElleColumns()    # provisional tids
+        self.failed: set = set()
+        self._win_txns = 0
+        # provisional-id structures for the window screen only
+        self._app_raw: dict = {}    # (key id, value) -> prov txn id
+        self._longest_raw: dict = {}            # key id -> raw list
+        self._ok_inv: list = []
+        self._ok_ret: list = []
+        self._ok_pid: list = []
+        self._finished = None       # memoized finish_txns result
+
+    def observe(self, inv_row: int, invoke, complete):
+        if invoke.f != "txn":
+            return
+        if complete is not None and complete.is_fail():
+            for f, k, v in invoke.value or []:
+                if f == "append":
+                    self.failed.add((_hk(k), _hv(v)))
+            return
+        ok = complete is not None and complete.is_ok()
+        micro = (complete.value if ok else invoke.value) or []
+        pid = len(self._recs)
+        self._rows.append(inv_row)
+        self._recs.append((ok, micro, invoke.time,
+                           complete.time if ok else float("inf")))
+        self._win_txns += 1
+        if ok:
+            self.columns.add_txn(pid, micro)
+            self._ok_inv.append(invoke.time)
+            self._ok_ret.append(complete.time)
+            self._ok_pid.append(pid)
+        else:
+            self.columns.micro_ops += len(micro)
+        if self._screen_windows \
+                and self.columns.micro_ops <= self.WINDOW_SCREEN_CAP:
+            cols = self.columns
+            for m in micro:
+                if m[0] == "append":
+                    try:
+                        vk = (cols.key_id(m[1]), m[2])
+                        hash(vk)
+                    except TypeError:
+                        vk = (cols.key_id(m[1]), repr(m[2]))
+                    self._app_raw[vk] = pid
+                elif ok and m[0] == "r" and isinstance(m[2], list):
+                    ki = cols.key_id(m[1])
+                    if len(m[2]) > len(self._longest_raw.get(ki, ())):
+                        self._longest_raw[ki] = m[2]
+
+    def observe_open(self, inv_row: int, invoke):
+        """Still-open invokes at pipeline finish: indeterminate txns
+        (they may have executed — `_txn_ops` includes them)."""
+        self.observe(inv_row, invoke, None)
+
+    def window_close(self) -> dict:
+        out = {"txns": self._win_txns}
+        self._win_txns = 0
+        if not self._screen_windows:
+            return out
+        if self.columns.micro_ops > self.WINDOW_SCREEN_CAP:
+            out["screen"] = "deferred"
+            return out
+        try:
+            out["screen"] = self._screen_prefix()
+        except Exception as e:      # advisory only; check time decides
+            out["screen"] = f"error: {e!r}"
+        return out
+
+    def _screen_prefix(self) -> str:
+        """Runs the device screen over the prefix collected so far
+        (provisional ids — cycle existence is labeling-invariant)."""
+        ed = self._ed
+        n = len(self._recs)
+        app, lng = self._app_raw, self._longest_raw
+        lens = np.fromiter((len(v) for v in lng.values()), np.int64,
+                           len(lng))
+        total = int(lens.sum())
+
+        def wlookup(ki, v):
+            try:
+                return app.get((ki, v), -1)
+            except TypeError:       # unhashable value: stored by repr
+                return app.get((ki, repr(v)), -1)
+
+        writers = np.fromiter(
+            (wlookup(ki, v) for ki, lst in lng.items() for v in lst),
+            np.int64, total) if total else np.zeros(0, np.int64)
+        slot_key = np.repeat(np.arange(len(lng), dtype=np.int64), lens)
+        offsets = np.zeros(len(lng) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        slot_idx = (np.arange(total, dtype=np.int64)
+                    - offsets[slot_key]) if total else \
+            np.zeros(0, np.int64)
+        key_pos = {ki: i for i, ki in enumerate(lng)}
+        cols = self.columns
+        tid = np.asarray(cols.tid, np.int64)
+        ki = np.fromiter((key_pos.get(k, -1) for k in cols.kid),
+                         np.int64, len(cols.kid))
+        n_ = np.asarray(cols.n, np.int64)
+        ks = np.maximum(ki, 0)
+        has = (ki >= 0) & (n_ > 0)
+        wr_pos = np.where(has, offsets[ks] + n_ - 1, -1) \
+            if len(lens) else np.full(len(tid), -1)
+        can = (ki >= 0) & (n_ < lens[ks]) if len(lens) else \
+            np.zeros(len(tid), bool)
+        rw_pos = np.where(can, offsets[ks] + n_, -1) \
+            if len(lens) else np.full(len(tid), -1)
+        rets = np.asarray(self._ok_ret, np.float64)
+        invs = np.asarray(self._ok_inv, np.float64)
+        order = np.argsort(rets, kind="stable")
+        before = np.searchsorted(rets[order], invs[order],
+                                 side="left") - 1
+        ok_tids = np.asarray(self._ok_pid, np.int64)[order]
+        out = ed.screen_arrays(writers, slot_key, slot_idx, tid, n_,
+                               wr_pos, rw_pos, ok_tids, before, n,
+                               want_edges=False)
+        if out is None:
+            return "unavailable"
+        if out.full_acyclic:
+            return "acyclic"
+        if out.data_acyclic:
+            return "data-acyclic"
+        return "undecided"
+
+    def finish_txns(self):
+        """(txns, failed_appends, columns) in invoke order — the exact
+        `_txn_ops`/`_fail_appends` shape, with the read table's
+        provisional ids remapped in one vectorized pass. Memoized: a
+        second check() call must not remap the (already-final) ids
+        again."""
+        if self._finished is not None:
+            return self._finished
+        n = len(self._recs)
+        rows = np.asarray(self._rows, np.int64)
+        order = np.argsort(rows, kind="stable")
+        final_of = np.empty(n, np.int64)
+        final_of[order] = np.arange(n)
+        txns = [None] * n
+        recs = self._recs
+        for newid, p in enumerate(order.tolist()):
+            ok, micro, inv_t, ret_t = recs[p]
+            txns[newid] = {"id": newid, "micro": micro, "ok": ok,
+                           "inv": inv_t, "ret": ret_t}
+        cols = self.columns
+        if len(cols.tid):
+            cols.tid = final_of[np.asarray(cols.tid, np.int64)]
+        self._finished = (txns, self.failed, cols)
+        return self._finished
+
+
 class ElleListAppendChecker(Checker):
     name = "elle"
+    # the overlapped pipeline feeds this checker's stream observer (the
+    # columnar read table the device edge build consumes + windowed
+    # early-warning screens); verdicts stay bit-identical to the
+    # post-hoc path either way
+    consumes_analysis = True
 
-    def __init__(self, consistency_models=("strict-serializable",)):
+    def __init__(self, consistency_models=("strict-serializable",),
+                 device=None):
         self.models = list(consistency_models)
+        self.device = device
+
+    def _mode(self, test):
+        if self.device is not None:
+            return self.device
+        return (test or {}).get("device_checker") \
+            if isinstance(test, dict) else None
+
+    def make_stream_observer(self, test):
+        from . import elle_device
+        mode = self._mode(test)
+        if mode in (False, "off", "host", "0") \
+                or not elle_device.available():
+            return None
+        return ElleStreamObserver({**(test if isinstance(test, dict)
+                                      else {}),
+                                   "device_checker": mode})
 
     def check(self, test, history, opts=None):
-        anomalies = analyze(history)
+        opts = opts or {}
+        mode = opts.get("device_checker", self._mode(test))
+        transfer = test.get("transfer") if isinstance(test, dict) \
+            else None
+        report: dict = {}
+        served = None
+        pipe = test.get("analysis") if isinstance(test, dict) else None
+        if pipe is not None and hasattr(pipe, "stream_results"):
+            served = pipe.stream_results("elle", len(history))
+        if served is not None:
+            observer, windows = served
+            txns, failed, columns = observer.finish_txns()
+            anomalies = analyze_txns(txns, failed, device=mode,
+                                     columns=columns, transfer=transfer,
+                                     report=report)
+        else:
+            windows = None
+            history = coerce_history(history)
+            anomalies = analyze_txns(_txn_ops(history),
+                                     _fail_appends(history),
+                                     device=mode, transfer=transfer,
+                                     report=report)
         illegal = set()
         for m in self.models:
             illegal |= ILLEGAL.get(m, ILLEGAL["strict-serializable"])
         found = {k: v for k, v in anomalies.items() if k in illegal}
-        return {"valid": not found,
-                "anomaly-types": sorted(anomalies),
-                "anomalies": found or None,
-                "models-checked": self.models}
+        out = {"valid": not found,
+               "anomaly-types": sorted(anomalies),
+               "anomalies": found or None,
+               "models-checked": self.models}
+        if report:
+            out["device"] = report
+        if windows is not None:
+            lags = [w.get("lag-rounds") for w in windows
+                    if w.get("lag-rounds") is not None]
+            out["windows"] = windows
+            out["checker-lag"] = {
+                "windows": len(windows),
+                "max-lag-rounds": max(lags) if lags else 0,
+                "mean-lag-rounds": (round(sum(lags) / len(lags), 1)
+                                    if lags else 0.0),
+            }
+        return out

@@ -1,0 +1,528 @@
+"""Device-resident Elle: jitted dependency-edge construction and an
+on-device cycle SCREEN for the txn-list-append checker
+(doc/perf.md "device-resident grading").
+
+The host checker (`checkers/elle.py`) builds ww/wr/rw dependency edges
+and runs Tarjan SCC in Python — fine at thousands of transactions,
+minutes at a million. This module moves the two hot pieces under XLA:
+
+1. **Edge construction** (`_edges_fn`): the per-key version tables
+   concatenate into one writer table on the host (a single columnar
+   flatten, fed incrementally by the analysis pipeline's stream
+   observer on overlapped runs), and the ww consecutive-writer pairs
+   plus every read's wr/rw gathers run as one jitted batch of gathers —
+   producing the same `(src, dst, kind)` edge set as
+   `elle._edges_vectorized` (bit-equality pinned by
+   tests/test_elle_device.py and the tests/test_edge_oracle.py property
+   suite, with `elle._edges_python` as the oracle).
+
+2. **Cycle screen** (`_screen_fn`): iterative label propagation to a
+   fixed point in a `lax.while_loop`. The screen looks for a *strict
+   potential* phi: an integer label per transaction that increases
+   along every dependency edge (and, for the realtime stage, along the
+   realtime closure). Each iteration raises phi by one `segment_max`
+   over the edge list (forward reachability coloring) plus one
+   `cummax` over the ret-ordered labels (the whole realtime closure in
+   one step — the barrier-chain trick of `elle.analyze`, done as a
+   prefix max instead of explicit barrier nodes). If the loop reaches
+   zero violated constraints, phi is a topological certificate and the
+   graph is **definitely acyclic** — Tarjan is skipped outright. If the
+   iteration cap is hit or phi stops changing, the screen answers
+   *undecided* and the host Tarjan/classification path runs unchanged.
+   The screen is sound one-way by construction: a cyclic graph admits
+   no strict potential, so it can never converge to zero violations —
+   "acyclic" is a definite pass, and G0/G1c/G-single/G2 rendering stays
+   bit-equal because it only ever runs on the exact same edge set.
+
+   Two stages, two seeds:
+     - data stage: phi0 from the *version potential* (2*version-index+1
+       for writers, 2*observed-length for readers) — per-key version
+       chains of any depth are satisfied analytically, so typical
+       acyclic data graphs certify in a handful of iterations;
+     - realtime stage: phi0 from the *ret-rank potential* (position in
+       completion order), which satisfies every realtime constraint
+       analytically — serial histories certify immediately, and only
+       genuine data-vs-time entanglement costs iterations.
+
+Everything here stays int32/bool (no 64-bit widening), uses no device
+sorts (the ret order is precomputed on the host with a stable argsort),
+and both jitted entry points are traced by the static auditor
+(`analyze/jaxpr_audit.checker_step_specs`) under the zero-new-findings
+gate.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+KIND_WW, KIND_WR, KIND_RW = 0, 1, 2
+KIND_NAMES = ("ww", "wr", "rw")
+
+# relaxation cap per screen stage: valid histories converge in a
+# handful of iterations (the seeds satisfy the deep constraint families
+# analytically); anything still violated after this many rounds falls
+# back to host Tarjan
+SCREEN_CAP = 32
+
+# `--device-checker auto`: the device path only engages past this many
+# transactions — below it, jit dispatch overhead beats the win and the
+# host path is already instant
+AUTO_MIN_TXNS = 1024
+
+_NEG = -(2 ** 30)
+
+
+def available() -> bool:
+    try:
+        import jax  # noqa: F401
+        return True
+    except Exception:       # pragma: no cover - jax is baked into CI
+        return False
+
+
+def resolve(mode, n_txns: int) -> bool:
+    """Maps a `--device-checker` value (on/off/auto, None = auto) to a
+    concrete use-the-device decision for this history."""
+    if mode in (False, "off", "host", "0"):
+        return False
+    if mode in (True, "on", "1"):
+        return available()
+    # auto
+    return n_txns >= AUTO_MIN_TXNS and available()
+
+
+def _pad_to(n: int) -> int:
+    """Pow-2 shape buckets bound the number of jit retraces."""
+    return max(16, 1 << max(0, int(n - 1).bit_length()))
+
+
+# ---------------------------------------------------------------------------
+# Columnar read table (the host-side flatten)
+# ---------------------------------------------------------------------------
+
+class ElleColumns:
+    """The columnar view of a transaction set's reads: per read, the
+    transaction id, an interned key id, and the observed list length —
+    everything the jitted edge constructor needs from the read side.
+    Built either in one flatten pass (`build_columns`) or incrementally
+    by the analysis pipeline's stream observer (`elle.ElleStreamObserver`),
+    in which case the flatten cost overlaps device compute."""
+
+    __slots__ = ("tid", "kid", "n", "key_objs", "_key_ids", "micro_ops")
+
+    def __init__(self):
+        self.tid: list = []         # txn id per read
+        self.kid: list = []         # interned key id per read
+        self.n: list = []           # observed list length per read
+        self.key_objs: list = []    # interned raw key objects
+        self._key_ids: dict = {}    # raw key (or repr fallback) -> id
+        self.micro_ops = 0
+
+    def key_id(self, k) -> int:
+        try:
+            ki = self._key_ids.get(k)
+        except TypeError:           # unhashable key: intern by repr
+            k2 = repr(k)
+            ki = self._key_ids.get(k2)
+            if ki is None:
+                ki = self._key_ids[k2] = len(self.key_objs)
+                self.key_objs.append(k)
+            return ki
+        if ki is None:
+            ki = self._key_ids[k] = len(self.key_objs)
+            self.key_objs.append(k)
+        return ki
+
+    def add_txn(self, tid: int, micro) -> None:
+        """Appends one OK transaction's reads to the table. The read
+        filter MUST match the host edge builders' `isinstance(v, list)`
+        — a narrower check (e.g. exact-type) would silently drop a
+        list-subclass read's wr/rw constraints from the screen, letting
+        it certify a graph whose true edge set is cyclic."""
+        ta, ka, na = self.tid.append, self.kid.append, self.n.append
+        self.micro_ops += len(micro)
+        for m in micro:
+            if m[0] == "r":
+                v = m[2]
+                if isinstance(v, list):
+                    ta(tid)
+                    ka(self.key_id(m[1]))
+                    na(len(v))
+
+    def key_lut(self, key_idx: dict, hk) -> np.ndarray:
+        """Maps interned key ids to positions in the checker's version
+        table (`key_idx`, keyed by `hk(key)`); -1 = key never observed."""
+        return np.fromiter(
+            (key_idx.get(hk(k), -1) for k in self.key_objs),
+            np.int32, len(self.key_objs))
+
+
+def build_columns(txns) -> ElleColumns:
+    """One-shot flatten of a transaction list (the non-overlapped path;
+    pipeline-fed runs get the same table incrementally from the stream
+    observer). One Python pass over the micro-ops; everything after
+    is numpy/XLA."""
+    cols = ElleColumns()
+    add = cols.add_txn
+    for i, t in enumerate(txns):
+        if t["ok"]:
+            add(i, t["micro"])
+        else:
+            cols.micro_ops += len(t["micro"])
+    return cols
+
+
+# ---------------------------------------------------------------------------
+# Jitted kernels (built lazily so the module imports without jax)
+# ---------------------------------------------------------------------------
+
+_FNS = None
+
+
+def _edge_candidates(jnp, writers, slot_key, r_tid, wr_pos, rw_pos):
+    """Candidate (src, dst, kind, valid) arrays: ww consecutive-writer
+    pairs inside each key's span plus per-read wr/rw writer-table
+    gathers — the device form of `elle._edges_vectorized` (duplicates
+    allowed; the set view dedups on the host, the screen is
+    duplicate-indifferent)."""
+    a, b = writers[:-1], writers[1:]
+    ww_ok = (slot_key[:-1] == slot_key[1:]) & (slot_key[1:] >= 0) \
+        & (a >= 0) & (b >= 0) & (a != b)
+    wsrc = writers[jnp.maximum(wr_pos, 0)]
+    wr_ok = (wr_pos >= 0) & (r_tid >= 0) & (wsrc >= 0) & (wsrc != r_tid)
+    rdst = writers[jnp.maximum(rw_pos, 0)]
+    rw_ok = (rw_pos >= 0) & (r_tid >= 0) & (rdst >= 0) & (rdst != r_tid)
+    i32 = jnp.int32
+    src = jnp.concatenate([a, wsrc, r_tid])
+    dst = jnp.concatenate([b, r_tid, rdst])
+    kind = jnp.concatenate([
+        jnp.full(a.shape, KIND_WW, i32),
+        jnp.full(wsrc.shape, KIND_WR, i32),
+        jnp.full(r_tid.shape, KIND_RW, i32)])
+    valid = jnp.concatenate([ww_ok, wr_ok, rw_ok])
+    return src, dst, kind, valid
+
+
+def _build_fns():
+    import jax
+    import jax.numpy as jnp
+
+    NEG = jnp.int32(_NEG)
+
+    def seg_max(vals, ids, n):
+        return jax.ops.segment_max(vals, ids, num_segments=n)
+
+    def edges_fn(writers, slot_key, r_tid, wr_pos, rw_pos):
+        return _edge_candidates(jnp, writers, slot_key, r_tid, wr_pos,
+                                rw_pos)
+
+    def screen_fn(writers, slot_key, slot_idx, r_tid, r_n, wr_pos,
+                  rw_pos, ret_tid, before_idx, n_txns_pad,
+                  do_rt=True):
+        """(data_acyclic, full_acyclic, data_iters, full_iters). The
+        phi arrays are [n_txns_pad]; padded/absent transactions carry
+        no constraints. n_txns_pad is static (shape bucket); with
+        do_rt=False (static) the realtime stage compiles out entirely
+        (callers with no realtime inputs — it could never certify)."""
+        N = int(n_txns_pad)
+        src, dst, _kind, valid = _edge_candidates(
+            jnp, writers, slot_key, r_tid, wr_pos, rw_pos)
+        src_c = jnp.where(valid, src, 0)
+        dst_c = jnp.where(valid, dst, 0)
+
+        def data_step(phi):
+            contrib = seg_max(jnp.where(valid, phi[src_c] + 1, NEG),
+                              dst_c, N)
+            return jnp.maximum(phi, contrib)
+
+        def data_viol(phi):
+            return jnp.sum(jnp.where(valid, phi[src_c] >= phi[dst_c],
+                                     False))
+
+        def rt_bound(phi):
+            # phi in ret order; prefix max = the full realtime closure
+            # (every txn whose ret precedes my inv) in ONE step
+            pr = jnp.where(ret_tid >= 0,
+                           phi[jnp.maximum(ret_tid, 0)], NEG)
+            m = jax.lax.cummax(pr, axis=0)
+            return jnp.where(before_idx >= 0,
+                             m[jnp.maximum(before_idx, 0)] + 1, NEG)
+
+        def rt_viol(phi):
+            return jnp.sum(jnp.where(before_idx >= 0,
+                                     phi < rt_bound(phi), False))
+
+        def fixpoint(phi0, step, viol):
+            def cond(c):
+                phi, it, v, changed = c
+                return (v > 0) & changed & (it < SCREEN_CAP)
+
+            def body(c):
+                phi, it, _v, _ch = c
+                nphi = step(phi)
+                return (nphi, it + 1, viol(nphi),
+                        jnp.any(nphi != phi))
+
+            phi, it, v, _ = jax.lax.while_loop(
+                cond, body, (phi0, jnp.int32(0), viol(phi0),
+                             jnp.bool_(True)))
+            return phi, it, v
+
+        # --- data stage: version-potential seed -------------------------
+        w_ids = jnp.where(writers >= 0, writers, 0)
+        phi_w = seg_max(jnp.where(writers >= 0, 2 * slot_idx + 1, NEG),
+                        w_ids, N)
+        r_ids = jnp.where(r_tid >= 0, r_tid, 0)
+        phi_r = seg_max(jnp.where(r_tid >= 0, 2 * r_n, NEG), r_ids, N)
+        phi0 = jnp.maximum(jnp.int32(0), jnp.maximum(phi_w, phi_r))
+        _phi, it_a, v_a = fixpoint(phi0, data_step, data_viol)
+        data_ok = v_a == 0
+
+        if not do_rt:
+            return data_ok, jnp.bool_(False), it_a, jnp.int32(0)
+
+        # --- realtime stage: ret-rank seed ------------------------------
+        m_pos = jnp.arange(N, dtype=jnp.int32)
+        phi_rank = seg_max(jnp.where(ret_tid >= 0, m_pos + 1, NEG),
+                           jnp.where(ret_tid >= 0, ret_tid, 0), N)
+        phi_rank = jnp.maximum(jnp.int32(0), phi_rank)
+
+        def full_step(phi):
+            return jnp.maximum(data_step(phi), rt_bound(phi))
+
+        def full_viol(phi):
+            return data_viol(phi) + rt_viol(phi)
+
+        _phi2, it_b, v_b = fixpoint(phi_rank, full_step, full_viol)
+        full_ok = v_b == 0
+        return data_ok, full_ok, it_a, it_b
+
+    return {
+        "edges": jax.jit(edges_fn),
+        "screen": jax.jit(screen_fn,
+                          static_argnames=("n_txns_pad", "do_rt")),
+        "screen_raw": screen_fn,
+        "edges_raw": edges_fn,
+    }
+
+
+def _fns():
+    global _FNS
+    if _FNS is None:
+        _FNS = _build_fns()
+    return _FNS
+
+
+# ---------------------------------------------------------------------------
+# Host-side assembly
+# ---------------------------------------------------------------------------
+
+class DeviceElle:
+    """One device analysis: screen verdicts plus a lazy edge-set view.
+    `data_acyclic`/`full_acyclic` are definite (True = certified, False
+    = undecided, fall back to Tarjan); `edge_set()` materializes the
+    Python edge set — identical to `elle._edges_vectorized` — only when
+    the fallback actually needs it."""
+
+    def __init__(self, edge_arrays, data_acyclic, full_acyclic, iters,
+                 stats):
+        self._edge_arrays = edge_arrays     # (src, dst, kind, valid)
+        self.data_acyclic = bool(data_acyclic)
+        self.full_acyclic = bool(full_acyclic)
+        self.iters = iters
+        self.stats = stats
+        self._set = None
+
+    def edge_set(self) -> set:
+        if self._set is None:
+            src, dst, kind, valid = (np.asarray(a)
+                                     for a in self._edge_arrays)
+            m = np.asarray(valid)
+            s, d, k = src[m].tolist(), dst[m].tolist(), kind[m].tolist()
+            self._set = set(zip(s, d, (KIND_NAMES[x] for x in k)))
+        return self._set
+
+    def report(self) -> dict:
+        """The deterministic `device` block for checker results (no
+        wall times here — those ride TransferStats)."""
+        return {
+            "screen": {
+                "data": "acyclic" if self.data_acyclic else "undecided",
+                "realtime": ("acyclic" if self.full_acyclic
+                             else "undecided"),
+                "iters": list(self.iters),
+            },
+            "edges-on-device": True,
+            **self.stats,
+        }
+
+
+def _writer_table(longest, appender, hk):
+    """Concatenated per-key version tables: (writers, slot_key,
+    slot_idx, offsets, lens, key_idx) — the host half of the per-key
+    version-table merge (one dict gather per version, then numpy)."""
+    keys = list(longest)
+    key_idx = {kk: i for i, kk in enumerate(keys)}
+    nk = len(keys)
+    lens = np.fromiter((len(longest[kk]) for kk in keys), np.int64,
+                       nk) if nk else np.zeros(0, np.int64)
+    offsets = np.zeros(nk + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    ag = appender.get
+    writers = np.fromiter(
+        (ag((kk, v), -1) for kk in keys for v in longest[kk]),
+        np.int64, total) if total else np.zeros(0, np.int64)
+    slot_key = np.repeat(np.arange(nk, dtype=np.int64), lens) \
+        if total else np.zeros(0, np.int64)
+    slot_idx = np.arange(total, dtype=np.int64) - offsets[slot_key] \
+        if total else np.zeros(0, np.int64)
+    return writers, slot_key, slot_idx, offsets, lens, key_idx
+
+
+def _padded(arr, n, fill, dtype=np.int32):
+    out = np.full(n, fill, dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+def read_positions(columns: ElleColumns, key_idx: dict, offsets, lens,
+                   hk):
+    """Read-side arrays + writer-table gather positions (host numpy,
+    exactly the `_edges_vectorized` index math): (tid, n, wr_pos,
+    rw_pos), -1 positions masked."""
+    n_reads = len(columns.tid)
+    if n_reads and len(columns.key_objs):
+        lut = columns.key_lut(key_idx, hk)
+        ki = lut[np.asarray(columns.kid, np.int64)]
+        n_ = np.asarray(columns.n, np.int64)
+        tid = np.asarray(columns.tid, np.int64)
+        ks = np.maximum(ki, 0)
+        has = (ki >= 0) & (n_ > 0)
+        if len(lens):
+            wr_pos = np.where(has, offsets[ks] + n_ - 1, -1)
+            can = (ki >= 0) & (n_ < lens[ks])
+            rw_pos = np.where(can, offsets[ks] + n_, -1)
+        else:
+            wr_pos = rw_pos = np.full(n_reads, -1, np.int64)
+        return tid, n_, wr_pos, rw_pos
+    z = np.zeros(0, np.int64)
+    return z, z, z, z
+
+
+def device_args(writers, slot_key, slot_idx, tid, n_, wr_pos, rw_pos,
+                ok_tids, before, n_txns):
+    """The ONE host->device assembly (pow-2 shape-bucket padding + the
+    per-txn realtime index scatter), shared by `screen_arrays` and the
+    checker bench so measured timings always describe the production
+    path. Returns (edge_args, screen_args, n_txns_pad, have_rt) —
+    `screen_args` feeds `_fns()["screen"]` (add n_txns_pad/do_rt kw),
+    `edge_args` feeds `_fns()["edges"]`."""
+    vp = _pad_to(max(len(writers), 1))
+    rp = _pad_to(max(len(tid), 1))
+    tp = _pad_to(max(n_txns, 1))
+    d_writers = _padded(writers, vp, -1)
+    d_slot_key = _padded(slot_key, vp, -1)
+    d_slot_idx = _padded(slot_idx, vp, 0)
+    d_tid = _padded(tid, rp, -1)
+    d_n = _padded(n_, rp, 0)
+    d_wr = _padded(wr_pos, rp, -1)
+    d_rw = _padded(rw_pos, rp, -1)
+    have_rt = len(ok_tids) > 0
+    ret_tid = _padded(np.asarray(ok_tids, np.int64), tp, -1)
+    before_of = np.full(tp, -1, np.int32)
+    if have_rt:
+        before_of[np.asarray(ok_tids, np.int64)] = \
+            np.asarray(before, np.int64)
+    edge_args = (d_writers, d_slot_key, d_tid, d_wr, d_rw)
+    screen_args = (d_writers, d_slot_key, d_slot_idx, d_tid, d_n,
+                   d_wr, d_rw, ret_tid, before_of)
+    return edge_args, screen_args, tp, have_rt
+
+
+def screen_arrays(writers, slot_key, slot_idx, tid, n_, wr_pos, rw_pos,
+                  ok_tids, before, n_txns, transfer=None,
+                  want_edges=True):
+    """Pads the host arrays into pow-2 shape buckets, dispatches the
+    jitted screen (and optionally the edge constructor), and fetches
+    the verdict scalars. The shared device entry point for the checker
+    path (`run`) and the stream observer's per-window screen.
+    `ok_tids`/`before`: ok txn ids in completion order and each
+    position's latest-completion-strictly-before-invocation index
+    (-1 = none); pass empty arrays to skip realtime certification.
+    Returns a DeviceElle, or None when jax is unavailable."""
+    if not available():
+        return None
+    t0 = time.perf_counter()
+    if len(writers) == 0 and len(tid) == 0:
+        # no versions and no reads: no edges can exist, and the
+        # realtime closure alone is an (interval) partial order
+        return DeviceElle((np.zeros(0, np.int32),) * 3
+                          + (np.zeros(0, bool),), True, True, (0, 0),
+                          {"edge-candidates": 0})
+
+    edge_args, screen_args, tp, have_rt = device_args(
+        writers, slot_key, slot_idx, tid, n_, wr_pos, rw_pos, ok_tids,
+        before, n_txns)
+    fns = _fns()
+    import jax
+    data_ok, full_ok, it_a, it_b = fns["screen"](
+        *screen_args, n_txns_pad=tp, do_rt=have_rt)
+    edge_arrays = None
+    if want_edges:
+        edge_arrays = fns["edges"](*edge_args)
+    data_ok, full_ok, it_a, it_b = jax.device_get(
+        (data_ok, full_ok, it_a, it_b))
+    dt = time.perf_counter() - t0
+    if transfer is not None:
+        transfer.record_checker(dt)
+    if not have_rt and n_txns > 1:
+        full_ok = False     # no realtime inputs: never certify realtime
+    # the combined certificate covers the data subgraph: a realtime-
+    # acyclic graph is data-acyclic even when the version-potential
+    # stage alone hit its cap
+    data_ok = bool(data_ok) or bool(full_ok)
+    return DeviceElle(edge_arrays, data_ok, full_ok,
+                      (int(it_a), int(it_b)),
+                      {"edge-candidates": int(len(writers) - 1
+                                              + 2 * len(tid))
+                       if len(writers) else int(2 * len(tid))})
+
+
+def run(txns, longest, appender, hk, columns: ElleColumns | None = None,
+        rt=None, transfer=None, want_edges=True):
+    """Runs the device path over one transaction set. `rt` is the
+    precomputed realtime structure from `elle.analyze` —
+    `(ok_tids_in_ret_order, before)` with `before[i]` the ret-order
+    index of the last completion strictly before ok-txn i's invocation
+    (-1 if none) — realtime screening is skipped when rt is None.
+    Returns a DeviceElle, or None when jax is unavailable."""
+    if not available():
+        return None
+    if columns is None:
+        columns = build_columns(txns)
+    writers, slot_key, slot_idx, offsets, lens, key_idx = \
+        _writer_table(longest, appender, hk)
+    tid, n_, wr_pos, rw_pos = read_positions(columns, key_idx, offsets,
+                                             lens, hk)
+    if rt is not None:
+        ok_tids, before = rt
+    else:
+        ok_tids = before = np.zeros(0, np.int64)
+    return screen_arrays(writers, slot_key, slot_idx, tid, n_, wr_pos,
+                         rw_pos, ok_tids, before, len(txns),
+                         transfer=transfer, want_edges=want_edges)
+
+
+def edges_device(txns, longest, appender, hk=repr):
+    """`edges_impl`-shaped wrapper: the device edge build materialized
+    as the Python edge set (benches/tests pin it against both
+    `_edges_python` and `_edges_vectorized`). The production checker
+    keeps the arrays on device and only materializes on a screen
+    fallback."""
+    out = run(txns, longest, appender, hk, rt=None)
+    if out is None:
+        raise RuntimeError("jax unavailable: no device edge path")
+    return out.edge_set()

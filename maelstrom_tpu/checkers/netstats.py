@@ -44,6 +44,19 @@ class TransferStats:
     ckpt_saves: int = 0
     ckpt_blocked_s: float = 0.0
     ckpt_write_s: float = 0.0
+    # device-resident checker accounting (doc/perf.md "device-resident
+    # grading"): wall time the elle edge build + cycle screen spent on
+    # the device at check time — work that used to be host-blocked
+    # Python (nested edge loops + recursive Tarjan) now leaves the
+    # host-blocked ledger and shows up here instead.
+    checker_device_calls: int = 0
+    checker_device_s: float = 0.0
+
+    def record_checker(self, seconds: float) -> None:
+        """Books one device-checker dispatch (edge build and/or cycle
+        screen) of `seconds` wall time."""
+        self.checker_device_calls += 1
+        self.checker_device_s += seconds
 
     def record(self, tree) -> None:
         """Count one drain of `tree` (any pytree of device/numpy arrays),
@@ -73,6 +86,9 @@ class TransferStats:
             out["ckpt-saves"] = self.ckpt_saves
             out["ckpt-blocked-s"] = round(self.ckpt_blocked_s, 6)
             out["ckpt-write-s"] = round(self.ckpt_write_s, 6)
+        if self.checker_device_calls:
+            out["checker-device-calls"] = self.checker_device_calls
+            out["checker-device-s"] = round(self.checker_device_s, 6)
         return out
 
 

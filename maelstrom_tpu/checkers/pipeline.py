@@ -131,6 +131,7 @@ class AnalysisPipeline:
         self._ns_per_round = ns_per_round
         self._head_round = head_round
         self.windows: list = []
+        self._history = None        # the (single) history being fed
         self._finished = False
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
@@ -166,16 +167,31 @@ class AnalysisPipeline:
 
     def finish(self):
         """Blocks until every fed segment is analyzed, then flushes
-        still-open invokes as unpaired (completion None) ops."""
+        still-open invokes as unpaired (completion None) ops — to the
+        register partitions, and to any stream observer that opts in
+        via `observe_open` (indeterminate ops matter to e.g. the elle
+        checker: an open txn's appends still enter the version
+        tables)."""
         if self._finished:
             return self
         self._q.put(None)
         self._thread.join()
         self._finished = True
         try:
-            for _row, reg in self._open.values():
+            open_rows = sorted(self._open.values(),
+                               key=lambda rr: rr[0])
+            for row, reg in open_rows:
                 if reg is not _NONREG:
                     self._add_pair(reg, None, None, None)
+            if self._observers and self._history is not None:
+                flushers = [ob for ob in self._observers.values()
+                            if hasattr(ob, "observe_open")]
+                for row, _reg in open_rows:
+                    inv = None
+                    for ob in flushers:
+                        if inv is None:
+                            inv = self._history[row]
+                        ob.observe_open(row, inv)
         except Exception as e:          # pragma: no cover - defensive
             self.error = repr(e)
         return self
@@ -273,6 +289,7 @@ class AnalysisPipeline:
         History rows below `hi` are immutable once fed (append-only
         columns), so reading them off-thread is safe."""
         soa = history.soa()
+        self._history = history
         inv_code = TYPE_CODES[INVOKE]
         ok_code, fail_code = TYPE_CODES[OK], TYPE_CODES[FAIL]
         # per-f-code register classification for this history's interner

@@ -167,6 +167,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Disable the overlapped analysis pipeline and "
                         "run all checking sequentially after the run "
                         "(verdicts are bit-identical either way)")
+    t.add_argument("--device-checker", choices=["auto", "on", "off"],
+                   default=None,
+                   help="Device-resident grading for the "
+                        "txn-list-append (elle) checker (doc/perf.md): "
+                        "dependency-edge construction runs jitted on "
+                        "the device and an on-device cycle screen "
+                        "skips Tarjan outright on certified-acyclic "
+                        "histories. 'auto' (default) engages on large "
+                        "histories; verdicts are bit-equal to the host "
+                        "path either way")
     t.add_argument("--continuous", action="store_true",
                    help="Continuous generator mode (TPU path only): "
                         "client ops are injected at their seeded "
@@ -333,7 +343,8 @@ def opts_from_args(args) -> dict:
     # TPU-path performance knobs: only forwarded when given, so the
     # runner's own defaults stay in one place
     for k in ("mesh", "max_scan", "journal_scan_cap", "reply_log_cap",
-              "check_workers", "fleet", "fleet_sweep", "nemesis_seed",
+              "check_workers", "device_checker",
+              "fleet", "fleet_sweep", "nemesis_seed",
               "kafka_groups", "session_timeout_ms", "poll_batch",
               "continuous_window_ms", "batch_max", "max_values",
               "roles", "service_roles", "nemesis_targets"):

@@ -49,6 +49,12 @@ DEFAULTS = dict(
     # runs the next stretch (None = runner default of 1; --no-overlap
     # or check_workers=0 force the sequential analysis path)
     check_workers=None, no_overlap=False,
+    # device-resident grading (doc/perf.md): the txn-list-append (elle)
+    # checker's edge construction + cycle screen run jitted on the
+    # device. "auto" engages past elle_device.AUTO_MIN_TXNS
+    # transactions; "on"/"off" force it. Verdicts are bit-equal to the
+    # host path on every setting.
+    device_checker="auto",
     # preemption-tolerant execution (doc/checkpoint.md): periodic
     # crash-consistent checkpoints off the critical path (background
     # writer unless sync_checkpoint), and SIGTERM/SIGINT graceful

@@ -1739,7 +1739,17 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         # checkers consume the incrementally-built partitions (register
         # fast path); verdicts stay bit-identical to the sequential path
         test["analysis"] = runner.pipeline
+    # the device-resident checker (doc/perf.md "device-resident
+    # grading") books its edge-build/screen wall time into the run's
+    # TransferStats so results show that work leaving host-blocked time
+    test["transfer"] = runner.transfer
     results = test["checker"].check(test, history, {})
+    net_block = results.get("net")
+    if isinstance(net_block, dict) and "drains" in net_block:
+        # the net block renders before the workload checker runs:
+        # refresh the transfer ledger so check-time device work
+        # (checker-device-s) and any check-time fetches are reported
+        net_block.update(runner.transfer.as_dict())
     if runner.pipeline is not None:
         results["analysis-pipeline"] = runner.pipeline.report()
     if resume is not None:

@@ -1,0 +1,108 @@
+"""Shared randomized-history generators for property/equivalence
+suites (and bench.py's screen fixtures): seeded, deterministic,
+concurrency-shaped like real runner output. Moved out of
+tests/test_overlap_equivalence.py so the elle device-path suites
+(tests/test_elle_device.py, tests/test_edge_oracle.py) and the
+checker bench pin all implementations against the SAME generator.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..history import History, Op
+
+
+def random_register_history(seed, n=500, keys=4, workers=6,
+                            info_rate=0.08, fail_rate=0.05,
+                            corrupt=0.0, sequential=False):
+    """Registers under a mix of outcomes; corrupt > 0 plants stale
+    reads; sequential=True keeps every key in the screen's decidable
+    class."""
+    rng = random.Random(seed)
+    h = History()
+    t = 0
+    state = {}
+    openp = {}
+    workers = 1 if sequential else workers
+    for i in range(n):
+        t += rng.randrange(1, 4)
+        p = rng.randrange(workers)
+        if p in openp:
+            f, k, v = openp.pop(p)
+            roll = rng.random()
+            if not sequential and roll < fail_rate:
+                h.append(Op(type="fail", f=f, value=[k, v], process=p,
+                            time=t, error=["abort", "definite"]))
+            elif not sequential and roll < fail_rate + info_rate:
+                h.append(Op(type="info", f=f, value=[k, v], process=p,
+                            time=t, error="net-timeout"))
+            else:
+                if f == "write":
+                    state[k] = v
+                val = state.get(k) if f == "read" else v
+                if corrupt and f == "read" and rng.random() < corrupt:
+                    val = 999
+                h.append(Op(type="ok", f=f, value=[k, val], process=p,
+                            time=t))
+        else:
+            f = rng.choice(["read", "write", "write", "read"]
+                           + ([] if sequential else ["cas"]))
+            k = rng.randrange(keys)
+            v = (rng.randrange(5) if f != "cas"
+                 else [rng.randrange(5), rng.randrange(5)])
+            h.append(Op(type="invoke", f=f, value=[k, v], process=p,
+                        time=t))
+            openp[p] = (f, k, v)
+    return h
+
+
+def random_append_history(seed, n_txn=150, keys=5, workers=6,
+                          corrupt=0.0, empty_reads=False):
+    """txn-list-append histories with overlapping invocations: appends
+    land atomically at completion, reads observe the then-current list
+    (a valid serializable execution when corrupt == 0; corrupt > 0
+    plants truncated/reversed reads that seed real anomalies).
+    ok/fail/info outcomes mixed like runner output."""
+    rng = random.Random(seed)
+    h = History()
+    t = 0
+    lists = {k: [] for k in range(keys)}
+    nextv = [0]
+    openp = {}
+    for i in range(n_txn * 2):
+        t += rng.randrange(1, 3)
+        p = rng.randrange(workers)
+        if p in openp:
+            micro, kind = openp.pop(p)
+            if kind != "ok":
+                h.append(Op(type=kind, f="txn", value=micro, process=p,
+                            time=t))
+                continue
+            done = []
+            for f, k, v in micro:
+                if f == "append":
+                    lists[k].append(v)
+                    done.append([f, k, v])
+                else:
+                    obs = [] if empty_reads else list(lists[k])
+                    if corrupt and rng.random() < corrupt:
+                        obs = obs[:-1][::-1]
+                    done.append([f, k, obs])
+            h.append(Op(type="ok", f="txn", value=done, process=p,
+                        time=t))
+        else:
+            micro = []
+            for _ in range(rng.randrange(1, 4)):
+                k = rng.randrange(keys)
+                if not empty_reads and rng.random() < 0.5:
+                    nextv[0] += 1
+                    micro.append(["append", k, nextv[0]])
+                else:
+                    micro.append(["r", k, None])
+            kind = rng.choices(["ok", "fail", "info"],
+                               [0.85, 0.07, 0.08])[0]
+            h.append(Op(type="invoke", f="txn", value=micro, process=p,
+                        time=t))
+            openp[p] = (micro, kind)
+    return h

@@ -78,6 +78,21 @@ class _Lists(Strategy):
         return [self.elements.minimal() for _ in range(self.min_size)]
 
 
+class _SampledFrom(Strategy):
+    def __init__(self, elements):
+        self.elements = list(elements)
+        if not self.elements:
+            raise ValueError("sampled_from needs a non-empty sequence")
+
+    def example(self, rng):
+        return rng.choice(self.elements)
+
+    def minimal(self):
+        # hypothesis shrinks toward the FIRST element; the fallback's
+        # minimal-example-first pass mirrors that
+        return self.elements[0]
+
+
 class _Dicts(Strategy):
     def __init__(self, keys, values, min_size=0, max_size=10):
         self.keys, self.values = keys, values
@@ -119,6 +134,10 @@ class strategies:
     @staticmethod
     def dictionaries(keys, values, min_size=0, max_size=10):
         return _Dicts(keys, values, min_size=min_size, max_size=max_size)
+
+    @staticmethod
+    def sampled_from(elements):
+        return _SampledFrom(elements)
 
 
 class MiniHypFailure(AssertionError):

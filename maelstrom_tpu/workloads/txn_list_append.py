@@ -91,5 +91,6 @@ def workload(opts: dict) -> dict:
         "client": TxnClient(opts["net"]),
         "generator": generator(opts),
         "checker": ElleListAppendChecker(
-            opts.get("consistency_models", ["strict-serializable"])),
+            opts.get("consistency_models", ["strict-serializable"]),
+            device=opts.get("device_checker")),
     }

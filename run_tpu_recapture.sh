@@ -61,6 +61,17 @@ step artifacts/bench-batched-r8.json 2400 \
 step artifacts/bench-compartment-r9.json 2400 \
     env BENCH_MODE=compartment python bench.py
 
+# 1f. device-resident checker (BENCH_MODE=checker, ISSUE 11): the elle
+#     edge build + on-device cycle screen at 1M micro-ops — headline
+#     `value` = jitted edge-build micro-ops/sec, `vs_baseline` = the
+#     speedup over the pure-Python loop (>= 10x acceptance; CPU r01 in
+#     artifacts/bench-checker-cpu-r01.json), plus the register/elle
+#     host ratios and the screen decided-fraction (>= 0.9 gate) in one
+#     record — so the pending recapture (BENCH r03-r06 gap) refreshes
+#     the whole checker trajectory device-side in a single run
+step artifacts/bench-checker-r11.json 2400 \
+    env BENCH_MODE=checker python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -107,4 +107,40 @@ if [ "${COMPARTMENT_SMOKE:-1}" = "1" ]; then
     echo "== compartment smoke valid =="
 fi
 
+# Device-checker smoke (ISSUE 11, doc/perf.md "device-resident
+# grading"): one txn-list-append run with the device-resident elle
+# checker on the forced 2-device CPU mesh, AUDITED (the self-report
+# traces this run's own step fns next to the elle kernels the gate
+# above already covered), then the same seed on the host checker path
+# — the workload verdict blocks must match exactly (the device block
+# and windowed-grading accounting stripped). DEVICE_CHECKER_SMOKE=0
+# skips.
+if [ "${DEVICE_CHECKER_SMOKE:-1}" = "1" ]; then
+    echo "== device-checker smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w txn-list-append \
+        --node tpu:txn-list-append --node-count 5 --rate 20 \
+        --time-limit 2 --seed 7 --mesh 1,2 --device-checker on \
+        --store "$SMOKE_STORE/dev" > /dev/null
+    python -m maelstrom_tpu test -w txn-list-append \
+        --node tpu:txn-list-append --node-count 5 --rate 20 \
+        --time-limit 2 --seed 7 --mesh 1,2 --device-checker off \
+        --no-audit --store "$SMOKE_STORE/host" > /dev/null
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+def wl(side):
+    with open(os.path.join(root, side, "latest", "results.json")) as f:
+        r = json.load(f)["workload"]
+    return {k: v for k, v in r.items()
+            if k not in ("device", "windows", "checker-lag")}
+dev, host = wl("dev"), wl("host")
+assert dev == host, f"device/host elle verdicts diverge:\n{dev}\n{host}"
+assert dev["valid"] is True, dev
+print("device-checker smoke: verdicts bit-equal, valid")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== device-checker smoke valid =="
+fi
+
 echo "== static gate clean =="

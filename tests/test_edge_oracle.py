@@ -251,6 +251,55 @@ def test_spill_no_loss_when_capacity_suffices():
     assert delivered == {(2, 2, 0): [7, 9]}
 
 
+# --- device-resident elle edges: third implementation vs both oracles ------
+#
+# The same discipline for the checker's device edge path
+# (checkers/elle_device.py, doc/perf.md "device-resident grading"):
+# randomized list-append histories from the SHARED generator
+# (testing/histories.py — the one the overlap-equivalence suite and the
+# bench's screen fixtures draw from), with the jitted device build
+# pinned set-equal against BOTH `_edges_python` (the original oracle)
+# and `_edges_vectorized` (the PR 3 fast path), and full analyze()
+# verdict equality on top (screen + Tarjan-fallback paths included).
+
+@settings(max_examples=15, deadline=None)
+@given(seed=st.integers(0, 10 ** 6),
+       corrupt=st.sampled_from([0.0, 0.0, 0.1, 0.25]),
+       empty_reads=st.booleans(),
+       keys=st.integers(1, 6))
+def test_elle_device_edges_match_both_oracles(seed, corrupt,
+                                              empty_reads, keys):
+    from maelstrom_tpu.checkers.elle import (_edges_python,
+                                             _edges_vectorized,
+                                             _fail_appends, _hk, _hv,
+                                             _txn_ops, analyze)
+    from maelstrom_tpu.checkers.elle_device import edges_device
+    from maelstrom_tpu.testing.histories import random_append_history
+
+    h = random_append_history(seed, n_txn=60, keys=keys,
+                              corrupt=corrupt, empty_reads=empty_reads)
+    txns = _txn_ops(h)
+    appender, longest = {}, {}
+    for t in txns:
+        for f, k, v in t["micro"]:
+            if f == "append":
+                appender[(_hk(k), _hv(v))] = t["id"]
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f == "r" and isinstance(v, list):
+                kk = _hk(k)
+                vv = [_hv(x) for x in v]
+                if len(vv) > len(longest.get(kk, [])):
+                    longest[kk] = vv
+    dev = edges_device(txns, longest, appender)
+    assert dev == _edges_vectorized(txns, longest, appender)
+    assert dev == _edges_python(txns, longest, appender)
+    assert analyze(h, device="on") \
+        == analyze(h, edges_impl=_edges_python)
+
+
 @settings(max_examples=25, deadline=None)
 @given(evs=events, ring=st.integers(2, 6),
        lat_of_round=st.lists(st.integers(0, 5), min_size=16, max_size=16))

@@ -1,0 +1,358 @@
+"""Device-resident Elle (checkers/elle_device.py, doc/perf.md
+"device-resident grading"): the jitted edge constructor must be
+set-equal to both host builds on every history shape, the on-device
+cycle screen must never call a cyclic graph acyclic (the seeded-cycle
+fixtures below all survive the screen and reach Tarjan), and verdicts
+— valid/anomaly sets, rendered cycles — must be bit-equal to the host
+path: plain analyze, through the overlapped pipeline's stream
+observer, end to end on the TPU runner (plain, --mesh 1,2, and under
+the combined nemesis soup)."""
+
+import os
+
+import pytest
+
+from maelstrom_tpu.checkers import elle_device as ed
+from maelstrom_tpu.checkers.elle import (ElleListAppendChecker,
+                                         _edges_python,
+                                         _edges_vectorized,
+                                         _fail_appends, _txn_ops,
+                                         analyze, analyze_txns)
+from maelstrom_tpu.checkers.pipeline import AnalysisPipeline
+from maelstrom_tpu.history import History, Op, coerce_history
+from maelstrom_tpu.testing.histories import random_append_history
+
+STORE = "/tmp/maelstrom-tpu-test-store"
+
+
+def _screen(h):
+    """(report, anomalies) for a device-on analyze."""
+    h = coerce_history(h)
+    rep = {}
+    anoms = analyze_txns(_txn_ops(h), _fail_appends(h), device="on",
+                         report=rep)
+    return rep, anoms
+
+
+def _txn_pair(h, micro_in, micro_out, t0, t1, typ="ok", proc=0):
+    h.append({"type": "invoke", "f": "txn", "value": micro_in,
+              "process": proc, "time": t0})
+    h.append({"type": typ, "f": "txn",
+              "value": micro_out if typ == "ok" else micro_in,
+              "process": proc, "time": t1})
+
+
+# --- edge-set equality ------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_edges_match_both_hosts(seed):
+    h = random_append_history(seed, corrupt=0.15 if seed % 2 else 0.0)
+    txns = _txn_ops(h)
+    # rebuild longest/appender the way analyze does
+    rep = {}
+    a_dev = analyze_txns(txns, _fail_appends(h), device="on",
+                         report=rep)
+    a_vec = analyze_txns(txns, _fail_appends(h), device="off")
+    a_py = analyze_txns(txns, _fail_appends(h),
+                        edges_impl=_edges_python)
+    assert a_dev == a_vec == a_py
+
+
+def test_device_edge_set_equals_vectorized_directly():
+    """The raw edge arrays (not just the verdict) are set-equal to
+    both host builds — the third implementation pinned against the
+    oracle pair."""
+    h = random_append_history(3, n_txn=200)
+    txns = _txn_ops(h)
+    # build longest/appender exactly as analyze's host passes do
+    from maelstrom_tpu.checkers.elle import _hk, _hv
+    appender, longest = {}, {}
+    for t in txns:
+        for f, k, v in t["micro"]:
+            if f == "append":
+                appender[(_hk(k), _hv(v))] = t["id"]
+    for t in txns:
+        if not t["ok"]:
+            continue
+        for f, k, v in t["micro"]:
+            if f == "r" and isinstance(v, list):
+                kk = _hk(k)
+                vv = [_hv(x) for x in v]
+                if len(vv) > len(longest.get(kk, [])):
+                    longest[kk] = vv
+    es = ed.edges_device(txns, longest, appender)
+    assert es == _edges_vectorized(txns, longest, appender)
+    assert es == _edges_python(txns, longest, appender)
+
+
+# --- screen soundness: seeded cycles must survive the screen ----------------
+
+def test_screen_never_acquits_g0():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 2]],
+              [["append", 1, 1], ["append", 2, 2]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1, 2]], ["r", 2, [1, 2]]], 12, 13)
+    rep, anoms = _screen(h)
+    assert rep["screen"]["data"] == "undecided", rep
+    assert rep["screen"]["realtime"] == "undecided", rep
+    assert "G0" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_screen_never_acquits_g1c():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["r", 2, None]],
+              [["append", 1, 1], ["r", 2, [1]]], 0, 10, proc=0)
+    _txn_pair(h, [["append", 1, 2], ["append", 2, 1]],
+              [["append", 1, 2], ["append", 2, 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1, 2]]], 12, 13)
+    rep, anoms = _screen(h)
+    assert rep["screen"]["data"] == "undecided", rep
+    assert "G1c" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_screen_never_acquits_g_single():
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 1]],
+              [["append", 1, 1], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, [1]], ["r", 2, []]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 2, None]], [["r", 2, [1]]], 12, 13)
+    rep, anoms = _screen(h)
+    assert rep["screen"]["data"] == "undecided", rep
+    assert "G-single" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_screen_never_acquits_g_nonadjacent():
+    h = []
+    _txn_pair(h, [["r", "a", None], ["append", "d", 2]],
+              [["r", "a", []], ["append", "d", 2]], 0, 10, proc=0)
+    _txn_pair(h, [["append", "a", 1], ["append", "b", 1]],
+              [["append", "a", 1], ["append", "b", 1]], 1, 11, proc=1)
+    _txn_pair(h, [["r", "c", None], ["append", "b", 2]],
+              [["r", "c", []], ["append", "b", 2]], 2, 12, proc=2)
+    _txn_pair(h, [["append", "c", 1], ["append", "d", 1]],
+              [["append", "c", 1], ["append", "d", 1]], 3, 13, proc=3)
+    _txn_pair(h, [["r", "a", None], ["r", "b", None],
+                  ["r", "c", None], ["r", "d", None]],
+              [["r", "a", [1]], ["r", "b", [1, 2]],
+               ["r", "c", [1]], ["r", "d", [1, 2]]], 4, 14, proc=4)
+    rep, anoms = _screen(h)
+    assert rep["screen"]["data"] == "undecided", rep
+    assert "G-nonadjacent" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_screen_never_acquits_realtime_cycle():
+    """Data graph acyclic, but a read misses a write that returned
+    before the reader invoked: the realtime stage must stay undecided
+    (the combined graph is cyclic) while the data stage may certify."""
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 1, proc=0)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, []]], 10, 11, proc=1)
+    _txn_pair(h, [["r", 1, None]], [["r", 1, [1]]], 20, 21, proc=2)
+    rep, anoms = _screen(h)
+    assert rep["screen"]["data"] == "acyclic", rep
+    assert rep["screen"]["realtime"] == "undecided", rep
+    assert "G-single-realtime" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_screen_certifies_valid_histories():
+    """Clean concurrent histories certify end to end (data + realtime)
+    — the >= 90% decided-fraction class the bench records — and the
+    certificate skips Tarjan without changing the (empty) verdict."""
+    decided = 0
+    for seed in range(8):
+        h = random_append_history(seed, n_txn=120)
+        rep, anoms = _screen(h)
+        ok = rep["screen"]["realtime"] == "acyclic"
+        decided += ok
+        assert anoms == analyze(h, device="off")
+    assert decided >= 7, decided
+
+
+# --- PR 3 regression shapes through the device path -------------------------
+
+def test_device_empty_version_table():
+    """Reads-only histories build an empty version table; the device
+    gather must not index it (the PR 3 vectorized-gather crash)."""
+    h = random_append_history(9, empty_reads=True)
+    rep, anoms = _screen(h)
+    assert anoms == analyze(h, device="off") \
+        == analyze(h, edges_impl=_edges_python)
+
+
+def test_device_list_subclass_reads_keep_their_edges():
+    """Review regression: the columnar read filter must match the host
+    builders' `isinstance(v, list)` — an exact-type check would drop a
+    list-subclass read's wr/rw constraints from the screen, which could
+    then certify a graph whose true edge set is cyclic."""
+    class ObservedList(list):
+        pass
+
+    # the G-single cycle, but every read value is a list SUBCLASS
+    h = []
+    _txn_pair(h, [["append", 1, 1], ["append", 2, 1]],
+              [["append", 1, 1], ["append", 2, 1]], 0, 10, proc=0)
+    _txn_pair(h, [["r", 1, None], ["r", 2, None]],
+              [["r", 1, ObservedList([1])],
+               ["r", 2, ObservedList([])]], 1, 11, proc=1)
+    _txn_pair(h, [["r", 2, None]],
+              [["r", 2, ObservedList([1])]], 12, 13)
+    rep, anoms = _screen(h)
+    # the subclass reads' edges reached the device: screen undecided,
+    # Tarjan classifies, verdict equals the host path
+    assert rep["screen"]["data"] == "undecided", rep
+    assert "G-single" in anoms
+    assert anoms == analyze(h, device="off")
+
+
+def test_device_no_reads_at_all():
+    h = []
+    _txn_pair(h, [["append", 1, 1]], [["append", 1, 1]], 0, 1, proc=0)
+    _txn_pair(h, [["append", 1, 2]], [["append", 1, 2]], 2, 3, proc=0)
+    rep, anoms = _screen(h)
+    assert anoms == analyze(h, device="off")
+
+
+def test_device_empty_history():
+    rep, anoms = _screen(History())
+    assert anoms == analyze(History(), device="off") == {}
+
+
+# --- the overlapped pipeline's stream observer ------------------------------
+
+def _check_pair(h, device="on"):
+    """(served result, post-hoc result) for the same history: once
+    through a pipeline-fed stream observer (odd segment boundaries, so
+    pairs complete out of invoke order), once post-hoc."""
+    test = {"device_checker": device}
+    c = ElleListAppendChecker(device=device)
+    ob = c.make_stream_observer(test)
+    assert ob is not None
+    pipe = AnalysisPipeline(observers={"elle": ob})
+    step = 37
+    for lo in range(0, len(h), step):
+        pipe.feed(h, lo, min(lo + step, len(h)))
+    pipe.finish()
+    served = c.check({"analysis": pipe, "device_checker": device}, h)
+    posthoc = c.check({}, h, {"device_checker": device})
+    return served, posthoc
+
+
+@pytest.mark.parametrize("seed", [0, 4, 7])
+def test_observer_serves_bit_equal_verdicts(seed):
+    h = random_append_history(seed,
+                              corrupt=0.15 if seed == 4 else 0.0)
+    served, posthoc = _check_pair(h)
+    stripped = {k: v for k, v in served.items()
+                if k not in ("windows", "checker-lag")}
+    assert stripped == posthoc, (stripped, posthoc)
+    assert served["checker-lag"]["windows"] > 1
+    # per-window early-warning screens ran (device on)
+    assert any("screen" in w.get("verdict", {})
+               for w in served["windows"])
+
+
+def test_observer_flushes_open_invokes():
+    """A still-open txn invoke at pipeline finish is an indeterminate
+    txn whose appends enter the version tables — the observer must see
+    it (`observe_open`) or served verdicts diverge from post-hoc (the
+    observed open append would grade phantom-element)."""
+    h = random_append_history(2, n_txn=60)
+    # open (never-completed) txn appending to a fresh key...
+    h.append(Op(type="invoke", f="txn", value=[["append", "zz", 1]],
+                process=17, time=10 ** 9))
+    # ...whose append a later committed read observes
+    h.append(Op(type="invoke", f="txn", value=[["r", "zz", None]],
+                process=18, time=10 ** 9 + 1))
+    h.append(Op(type="ok", f="txn", value=[["r", "zz", [1]]],
+                process=18, time=10 ** 9 + 2))
+    posthoc_anoms = analyze(h, device="off")
+    assert "phantom-element" not in posthoc_anoms
+    served, posthoc = _check_pair(h)
+    stripped = {k: v for k, v in served.items()
+                if k not in ("windows", "checker-lag")}
+    assert stripped == posthoc
+    assert "phantom-element" not in stripped["anomaly-types"]
+
+
+# --- end to end on the TPU runner -------------------------------------------
+
+def _wl(res):
+    return {k: v for k, v in res["workload"].items()
+            if k not in ("device", "windows", "checker-lag")}
+
+
+def _run(tag, **kw):
+    from maelstrom_tpu import core
+    root = os.path.join(STORE, f"elle-device-{tag}")
+    opts = dict(store_root=root, seed=11, workload="txn-list-append",
+                node="tpu:txn-list-append", node_count=5, rate=25,
+                time_limit=2.0, audit=False)
+    opts.update(kw)
+    return core.run(opts)
+
+
+def test_e2e_device_vs_host_bit_equal():
+    r_dev = _run("on", device_checker="on")
+    r_host = _run("off", device_checker="off", no_overlap=True)
+    assert r_dev["valid"] is True and r_host["valid"] is True
+    assert _wl(r_dev) == _wl(r_host)
+    # the device actually engaged, certified, and booked its wall time
+    assert r_dev["workload"]["device"]["screen"]["realtime"] \
+        == "acyclic"
+    assert r_dev["net"]["checker-device-calls"] >= 1
+    assert r_dev["net"]["checker-device-s"] > 0
+    # overlapped run: the observer fed the device path windowed
+    assert r_dev["workload"]["checker-lag"]["windows"] >= 1
+    assert r_dev["analysis-pipeline"]["rows"] > 0
+
+
+@pytest.mark.multichip
+def test_e2e_device_vs_host_mesh():
+    r_dev = _run("mesh-on", device_checker="on", mesh="1,2")
+    r_host = _run("mesh-off", device_checker="off", mesh="1,2",
+                  no_overlap=True)
+    assert r_dev["valid"] is True and r_host["valid"] is True
+    assert _wl(r_dev) == _wl(r_host)
+
+
+@pytest.mark.slow
+def test_e2e_device_vs_host_nemesis_soup():
+    """Under the combined four-package soup this workload's verdict may
+    legitimately be invalid (the txn node sheds uncommitted state on
+    kill — same reason test_fault_soup runs it partition-only); the
+    device-path invariant is that the verdict — anomaly sets and
+    rendered cycles included — is BIT-EQUAL to the host path."""
+    kw = dict(nemesis={"kill", "pause", "partition", "duplicate"},
+              nemesis_interval=0.7, time_limit=4.0, timeout_ms=1500)
+    r_dev = _run("soup-on", device_checker="on", **kw)
+    r_host = _run("soup-off", device_checker="off", no_overlap=True,
+                  **kw)
+    assert _wl(r_dev) == _wl(r_host)
+    assert r_dev["valid"] == r_host["valid"]
+
+
+@pytest.mark.slow
+def test_e2e_device_vs_host_partition_soup_valid():
+    kw = dict(nemesis={"partition"}, nemesis_interval=2.0,
+              time_limit=4.0, rate=15.0, seed=23)
+    r_dev = _run("part-on", device_checker="on", **kw)
+    r_host = _run("part-off", device_checker="off", no_overlap=True,
+                  **kw)
+    assert _wl(r_dev) == _wl(r_host)
+    assert r_dev["valid"] is True
+
+
+def test_auto_mode_thresholds():
+    assert ed.resolve("off", 10 ** 9) is False
+    assert ed.resolve("on", 0) is True
+    assert ed.resolve("auto", ed.AUTO_MIN_TXNS - 1) is False
+    assert ed.resolve(None, ed.AUTO_MIN_TXNS) is True

@@ -93,6 +93,25 @@ def test_example_cap_env(monkeypatch):
     assert len(calls) == 7
 
 
+def test_sampled_from_contract():
+    """`sampled_from`: draws come from the sequence, the minimal-first
+    pass uses the FIRST element (hypothesis shrinks toward it), and an
+    empty sequence is rejected up front."""
+    seen = []
+
+    @settings(max_examples=12, deadline=None)
+    @given(x=st.sampled_from([0.25, 0.0, 0.1]))
+    def collect(x):
+        seen.append(x)
+
+    collect()
+    assert seen[0] == 0.25          # minimal example first
+    assert set(seen) <= {0.25, 0.0, 0.1}
+    assert len(set(seen)) > 1, "examples never varied"
+    with pytest.raises(ValueError):
+        st.sampled_from([])
+
+
 def test_given_rejects_non_strategies():
     with pytest.raises(TypeError, match="non-strategies"):
         minihyp.given(x=42)

@@ -25,52 +25,10 @@ from maelstrom_tpu.checkers.linearizable import (
     ops_from_arrays, partition_register, screen_register_arrays)
 from maelstrom_tpu.checkers.pipeline import AnalysisPipeline
 from maelstrom_tpu.history import History, Op
+from maelstrom_tpu.testing.histories import (random_append_history,
+                                             random_register_history)
 
 STORE = "/tmp/maelstrom-tpu-test-store"
-
-
-def random_register_history(seed, n=500, keys=4, workers=6,
-                            info_rate=0.08, fail_rate=0.05,
-                            corrupt=0.0, sequential=False):
-    """Registers under a mix of outcomes; corrupt > 0 plants stale
-    reads; sequential=True keeps every key in the screen's decidable
-    class."""
-    rng = random.Random(seed)
-    h = History()
-    t = 0
-    state = {}
-    openp = {}
-    workers = 1 if sequential else workers
-    for i in range(n):
-        t += rng.randrange(1, 4)
-        p = rng.randrange(workers)
-        if p in openp:
-            f, k, v = openp.pop(p)
-            roll = rng.random()
-            if not sequential and roll < fail_rate:
-                h.append(Op(type="fail", f=f, value=[k, v], process=p,
-                            time=t, error=["abort", "definite"]))
-            elif not sequential and roll < fail_rate + info_rate:
-                h.append(Op(type="info", f=f, value=[k, v], process=p,
-                            time=t, error="net-timeout"))
-            else:
-                if f == "write":
-                    state[k] = v
-                val = state.get(k) if f == "read" else v
-                if corrupt and f == "read" and rng.random() < corrupt:
-                    val = 999
-                h.append(Op(type="ok", f=f, value=[k, val], process=p,
-                            time=t))
-        else:
-            f = rng.choice(["read", "write", "write", "read"]
-                           + ([] if sequential else ["cas"]))
-            k = rng.randrange(keys)
-            v = (rng.randrange(5) if f != "cas"
-                 else [rng.randrange(5), rng.randrange(5)])
-            h.append(Op(type="invoke", f=f, value=[k, v], process=p,
-                        time=t))
-            openp[p] = (f, k, v)
-    return h
 
 
 @pytest.mark.parametrize("seed", range(12))
@@ -173,52 +131,6 @@ def test_stale_pipeline_falls_back():
     c = LinearizableRegisterChecker()
     assert c.check({"analysis": p}, h) == c.check({}, h,
                                                   {"no_fast": True})
-
-
-def random_append_history(seed, n_txn=150, keys=5, workers=6,
-                          corrupt=0.0, empty_reads=False):
-    rng = random.Random(seed)
-    h = History()
-    t = 0
-    lists = {k: [] for k in range(keys)}
-    nextv = [0]
-    openp = {}
-    for i in range(n_txn * 2):
-        t += rng.randrange(1, 3)
-        p = rng.randrange(workers)
-        if p in openp:
-            micro, kind = openp.pop(p)
-            if kind != "ok":
-                h.append(Op(type=kind, f="txn", value=micro, process=p,
-                            time=t))
-                continue
-            done = []
-            for f, k, v in micro:
-                if f == "append":
-                    lists[k].append(v)
-                    done.append([f, k, v])
-                else:
-                    obs = [] if empty_reads else list(lists[k])
-                    if corrupt and rng.random() < corrupt:
-                        obs = obs[:-1][::-1]
-                    done.append([f, k, obs])
-            h.append(Op(type="ok", f="txn", value=done, process=p,
-                        time=t))
-        else:
-            micro = []
-            for _ in range(rng.randrange(1, 4)):
-                k = rng.randrange(keys)
-                if not empty_reads and rng.random() < 0.5:
-                    nextv[0] += 1
-                    micro.append(["append", k, nextv[0]])
-                else:
-                    micro.append(["r", k, None])
-            kind = rng.choices(["ok", "fail", "info"],
-                               [0.85, 0.07, 0.08])[0]
-            h.append(Op(type="invoke", f="txn", value=micro, process=p,
-                        time=t))
-            openp[p] = (micro, kind)
-    return h
 
 
 @pytest.mark.parametrize("seed", range(8))

@@ -357,6 +357,26 @@ def test_gate_traces_continuous_scan_variant():
     assert new == [], [f.as_dict() for f in new]
 
 
+def test_gate_traces_device_checker_kernels():
+    """ISSUE 11: the txn-list-append program set traces the
+    device-resident checker's jitted entry points — the elle edge
+    constructor and the cycle-screen fixed point
+    (checkers/elle_device.py) — under the same zero-new-findings gate
+    (no baseline exemption: the kernels use no device sorts, stay
+    int32, and their only scatters are combiner segment-max)."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["txn-list-append"], mesh=None, fleet=False)
+    assert "elle_edges_fn" in entries, entries
+    assert "elle_screen_fn" in entries, entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+    # and none of the checker findings needed baselining at all
+    checker_hits = [f for f in findings
+                    if f.entry in ("elle_edges_fn", "elle_screen_fn")]
+    assert checker_hits == [], [f.as_dict() for f in checker_hits]
+
+
 def test_fixture_violation_in_continuous_scan_path_fires():
     """A seeded hazard INSIDE the continuous scan body is caught through
     the cscan trace: an unstable argsort planted in a program step
