@@ -424,6 +424,9 @@ def audit_runner(runner, trace: bool = True) -> dict:
     return _runner_audit(
         lambda: (type(runner.program).__name__, repr(runner.cfg),
                  runner._shardings is not None, bool(trace),
+                 # continuous runs trace the sched-inject variant: a
+                 # round-synchronous trace must not satisfy them
+                 getattr(runner, "continuous", False),
                  donation_enabled()),
         steps, trace)
 
@@ -442,5 +445,6 @@ def audit_fleet_runner(runner, trace: bool = True) -> dict:
         lambda: ("fleet", type(runner.program).__name__,
                  repr(runner.cfg), runner.spec.fleet,
                  runner._shardings is not None, bool(trace),
+                 getattr(runner, "continuous", False),
                  donation_enabled()),
         steps, trace, extra_fn=lambda: {"fleet": runner.spec.fleet})

@@ -447,6 +447,7 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
             sim = jax.device_put(sim, sh[0])
         kv = jnp.full((F,), 8, jnp.int32)
         flags = jnp.ones((F,), bool)
+        at = jnp.zeros((F, max(runner.concurrency, 1)), jnp.int32)
         tag = f"{workload}@fleet={F}" + (f"@mesh={mesh}" if mesh else "")
         sim_sh = sh[0] if sh is not None else None
         common = dict(donate_argnums=(0,) if donate else (),
@@ -457,6 +458,16 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
                                            reply_cap=runner.reply_log_cap,
                                            donate=donate, shardings=sh),
                      args=(sim, inject, kv, flags, flags), **common),
+            # the continuous-mode fleet dispatch (`--fleet N
+            # --continuous`, ISSUE 12): the vmapped sched-inject scan
+            # with its [F, Q] round-offset tensor and inj_mids drain —
+            # a distinct compiled entry point, traced like the rest
+            StepSpec(name=f"fleet_cscan_fn[{tag}]",
+                     fn=make_fleet_scan_fn(runner.program, runner.cfg,
+                                           reply_cap=runner.reply_log_cap,
+                                           donate=donate, shardings=sh,
+                                           sched_inject=True),
+                     args=(sim, inject, at, kv, flags, flags), **common),
             StepSpec(name=f"fleet_round_fn[{tag}]",
                      fn=parallel.make_cluster_round_fn(
                          runner.program, runner.cfg,
@@ -595,14 +606,26 @@ def audit_fleet_runner_steps(runner):
     kv = jnp.full((F,), 8, jnp.int32)
     flags = jnp.ones((F,), bool)
     tag = f"{type(runner.program).__name__}@fleet={F}"
-    spec = StepSpec(
-        name=f"fleet_scan_fn[{tag}]",
-        fn=make_fleet_scan_fn(runner.program, runner.cfg,
-                              reply_cap=runner.reply_log_cap,
-                              donate=donate, shardings=sh),
-        args=(runner.sim, inject, kv, flags, flags),
-        donate_argnums=(0,) if donate else (),
-        in_shardings=sim_sh, out_shardings=sim_sh)
+    common = dict(donate_argnums=(0,) if donate else (),
+                  in_shardings=sim_sh, out_shardings=sim_sh)
+    if getattr(runner, "continuous", False):
+        # a continuous fleet's waves dispatch the vmapped sched-inject
+        # scan: that is the entry point to self-report
+        at = jnp.zeros((F, max(runner.concurrency, 1)), jnp.int32)
+        spec = StepSpec(
+            name=f"fleet_cscan_fn[{tag}]",
+            fn=make_fleet_scan_fn(runner.program, runner.cfg,
+                                  reply_cap=runner.reply_log_cap,
+                                  donate=donate, shardings=sh,
+                                  sched_inject=True),
+            args=(runner.sim, inject, at, kv, flags, flags), **common)
+    else:
+        spec = StepSpec(
+            name=f"fleet_scan_fn[{tag}]",
+            fn=make_fleet_scan_fn(runner.program, runner.cfg,
+                                  reply_cap=runner.reply_log_cap,
+                                  donate=donate, shardings=sh),
+            args=(runner.sim, inject, kv, flags, flags), **common)
     return audit_step(spec), [spec.name], []
 
 

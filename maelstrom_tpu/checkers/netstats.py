@@ -51,6 +51,23 @@ class TransferStats:
     # host-blocked ledger and shows up here instead.
     checker_device_calls: int = 0
     checker_device_s: float = 0.0
+    # host-driver poll accounting (doc/perf.md "vectorized host
+    # driver"): `host_polls` counts host poll passes — each a full
+    # gather cycle over generator scheduling + the pending-table
+    # timeout/deadline scans + inject encode before one compiled
+    # dispatch — and `host_poll_s` their wall time. A standalone run
+    # books one per stretch/window boundary; the fleet driver books ONE
+    # per wave for the whole coalesced fleet, which is the O(waves)-
+    # not-O(clusters) claim the fleet_stream bench measures: polls per
+    # cluster-round shrink ~linearly with fleet size.
+    host_polls: int = 0
+    host_poll_s: float = 0.0
+
+    def record_poll(self, seconds: float) -> None:
+        """Books one host poll pass (generator scheduling + pending
+        scans + inject encode) of `seconds` wall time."""
+        self.host_polls += 1
+        self.host_poll_s += seconds
 
     def record_checker(self, seconds: float) -> None:
         """Books one device-checker dispatch (edge build and/or cycle
@@ -89,6 +106,9 @@ class TransferStats:
         if self.checker_device_calls:
             out["checker-device-calls"] = self.checker_device_calls
             out["checker-device-s"] = round(self.checker_device_s, 6)
+        if self.host_polls:
+            out["host-polls"] = self.host_polls
+            out["host-poll-s"] = round(self.host_poll_s, 6)
         return out
 
 

@@ -113,8 +113,14 @@ class AnalysisPipeline:
     partitions to the checker and `report()` summarizes overlap."""
 
     def __init__(self, workers: int = 1, observers: dict | None = None,
-                 ns_per_round: float | None = None, head_round=None):
+                 ns_per_round: float | None = None, head_round=None,
+                 label=None):
         self.workers = max(1, int(workers))
+        # fleet attribution (doc/perf.md "vectorized host driver"): a
+        # cluster index stamped on window records and the report, so a
+        # fleet's per-cluster stream-grading blocks stay attributable
+        # when logs/results are read side by side. None standalone.
+        self.label = label
         self.busy_s = 0.0           # worker seconds (compute-overlapped)
         self.segments = 0
         self.rows = 0
@@ -263,6 +269,8 @@ class AnalysisPipeline:
                 out["max-lag-rounds"] = max(lags)
         if self.resumed_rows:
             out["resumed-rows"] = self.resumed_rows
+        if self.label is not None:
+            out["cluster"] = self.label
         if self.error:
             out["error"] = self.error
         return out
@@ -370,6 +378,8 @@ class AnalysisPipeline:
                 lag = max(head - end_round, 0)
         rec = {"window": len(self.windows), "rows": [lo, hi],
                "end-round": end_round, "lag-rounds": lag}
+        if self.label is not None:
+            rec["cluster"] = self.label
         for name, ob in self._observers.items():
             close = getattr(ob, "window_close", None)
             if close is not None:

@@ -135,9 +135,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "ONE compiled scan (the fleet runner): a "
                         "seed/nemesis/capacity campaign becomes one "
                         "device program, sharded ('dp','sp') under "
-                        "--mesh dp,sp with N %% dp == 0. Every "
-                        "cluster's history is bit-identical to its "
-                        "standalone run (doc/perf.md). TPU path only")
+                        "--mesh dp,sp with N %% dp == 0. Composes "
+                        "with --continuous: N open-world clusters in "
+                        "one vmapped sched-inject scan, host polls "
+                        "amortized to one pass per wave (doc/perf.md "
+                        "'vectorized host driver'). Every cluster's "
+                        "history is bit-identical to its standalone "
+                        "run (doc/perf.md). TPU path only")
     t.add_argument("--fleet-sweep", choices=["seed", "nemesis",
                                              "capacity"],
                    help="What the fleet varies per cluster (default "
@@ -183,8 +187,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "offered-rate rounds INSIDE the compiled scan "
                         "window — traffic lands while nemeses are "
                         "mid-fault — instead of one dispatch per op. "
-                        "Same seed => byte-identical history, plain and "
-                        "--mesh (doc/streams.md)")
+                        "Same seed => byte-identical history, plain, "
+                        "--mesh, and as a --fleet N cluster "
+                        "(doc/streams.md, doc/perf.md)")
     t.add_argument("--continuous-window-ms", type=float,
                    help="Continuous-mode stream stride in virtual ms "
                         "(default 250): windows cross replies, and the "

@@ -83,7 +83,8 @@ DEFAULTS = dict(
     # continuous generator mode (doc/streams.md): client ops are
     # injected at their seeded offered-rate rounds INSIDE the compiled
     # scan window (open-world stream) instead of one dispatch per op;
-    # TPU path only, same-seed runs byte-identical plain and --mesh.
+    # TPU path only, same-seed runs byte-identical plain, --mesh, and
+    # as a --fleet cluster (the vectorized host driver, doc/perf.md).
     # continuous_window_ms is the stream stride: windows cross replies,
     # and the stride bounds a backlogged op's emission delay
     continuous=False, continuous_window_ms=250.0,
@@ -134,9 +135,12 @@ class FleetSpec:
 
     Static shapes (node count, concurrency, capacities, fault packages)
     stay uniform across the fleet: they define the ONE compiled program
-    every cluster shares. The per-cluster contract is bit-identity:
-    cluster i's history equals the standalone run of `cluster_opts(i)`
-    (pinned by tests/test_fleet_runner.py)."""
+    every cluster shares. All three sweeps compose with `--continuous`
+    (each cluster streams its own open-world schedule; the capacity
+    sweep ramps the offered rate per stream). The per-cluster contract
+    is bit-identity: cluster i's history equals the standalone run of
+    `cluster_opts(i)` (pinned by tests/test_fleet_runner.py and
+    tests/test_fleet_continuous.py)."""
 
     fleet: int = 1
     sweep: str = "seed"

@@ -55,7 +55,10 @@ VOLATILE_SUBRESULT_KEYS = ("windows", "checker-lag")
 # and a static-audit block with wall time; both restart per launch.
 VOLATILE_FLEET_KEYS = VOLATILE_RESULT_KEYS + (
     "drains", "host-bytes", "host-blocked-s", "host-overlapped-s",
-    "ckpt-saves", "ckpt-blocked-s", "ckpt-write-s", "static-audit")
+    "ckpt-saves", "ckpt-blocked-s", "ckpt-write-s", "static-audit",
+    # host-driver poll accounting (doc/perf.md "vectorized host
+    # driver"): a resumed launch only counts polls since its resume
+    "host-polls", "host-poll-s", "max-checker-lag-rounds")
 
 # A small but honest default config: raft-backed lin-kv (durable store,
 # so the kill nemesis is recoverable), the full combined fault soup, and

@@ -614,6 +614,48 @@ def phases(*gens):
     return Phases(*gens)
 
 
+def sched_columns(rows, r0: int, q: int, n_nodes: int) -> dict:
+    """Numpy-columnar encode of pre-scheduled injection rows
+    (doc/perf.md "vectorized host driver").
+
+    `rows` is the continuous loop's carry_sched list — tuples of
+    ``(round, process, op, node_idx, t, a, b, c)`` from
+    `schedule_ahead` + the runner's encode pass — and the result is the
+    [Q] column set the sched-inject scan consumes: ``at`` (round offsets
+    relative to `r0`, -1 on padding), ``valid``, and the wire fields
+    ``src``/``dest``/``type``/``a``/``b``/``c``. One `np.asarray` per
+    field replaces a per-row Python loop, and the fleet driver fills
+    one row of its [fleet, Q] buffers per cluster from these columns —
+    so the whole fleet's window rides ONE device transfer per field per
+    wave instead of per-cluster jnp constructions."""
+    import numpy as np
+    m = len(rows)
+    if m > q:
+        raise ValueError(f"{m} scheduled rows exceed the {q}-row "
+                         f"inject batch")
+    at = np.full(q, -1, np.int32)
+    valid = np.zeros(q, bool)
+    src = np.zeros(q, np.int32)
+    dest = np.zeros(q, np.int32)
+    typ = np.zeros(q, np.int32)
+    a = np.zeros(q, np.int32)
+    b = np.zeros(q, np.int32)
+    c = np.zeros(q, np.int32)
+    if m:
+        cols = np.asarray([(rw[0], rw[1], rw[3], rw[4], rw[5], rw[6],
+                            rw[7]) for rw in rows], np.int64).T
+        at[:m] = cols[0] - r0
+        valid[:m] = True
+        src[:m] = cols[1] + n_nodes
+        dest[:m] = cols[2]
+        typ[:m] = cols[3]
+        a[:m] = cols[4]
+        b[:m] = cols[5]
+        c[:m] = cols[6]
+    return {"at": at, "valid": valid, "src": src, "dest": dest,
+            "type": typ, "a": a, "b": b, "c": c}
+
+
 def schedule_ahead(gen, processes, free, r0: int, horizon_r: int,
                    ns_per_round: float, dispatch_count: int):
     """Continuous-mode pre-scheduler (doc/streams.md): polls `gen`

@@ -25,12 +25,26 @@ merely answers their yielded device requests in lockstep *waves*:
     bumps         -> one batched round-counter add (k=0 holds a row)
     scans         -> one vmapped `fleet_scan_fn` dispatch; clusters
                      between stretches are held by the `active` mask
+    cscans        -> (`--continuous`) one vmapped sched-inject dispatch:
+                     the whole fleet's pre-scheduled windows ride ONE
+                     columnar [fleet, Q] inject tensor + round-offset
+                     tensor, and ONE packed drain returns every lane's
+                     replies and confirmed `inj_mids`
 
 Because the loop code and the per-row compiled math are identical to
 the standalone path, every cluster's history is **bit-identical** to
 running it alone with the same options (pinned by
-tests/test_fleet_runner.py) — the fleet changes batching, never
-semantics.
+tests/test_fleet_runner.py and tests/test_fleet_continuous.py) — the
+fleet changes batching, never semantics.
+
+The host side is the vectorized multi-cluster driver (doc/perf.md
+"vectorized host driver"): per wave, the gather pass advances every
+ready cluster's coroutine, the inject encode fills numpy-columnar
+[fleet, C] buffers (one `jnp.asarray` per field — no per-cluster device
+constructions), and one dispatch + one packed fetch serve the whole
+fleet. `TransferStats.host_polls` books ONE poll pass per wave, so the
+O(waves)-not-O(clusters) host-cost claim is a measured counter
+(`BENCH_MODE=fleet_stream`), not an assertion.
 
 Checkpointing is per-cluster-consistent: each shell snapshots itself at
 its own stretch boundaries (sim row + host meta, pickled immediately),
@@ -52,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import core as core_mod
+from .. import generators as g
 from .. import store
 from ..history import History
 from ..net import tpu as T
@@ -141,12 +156,22 @@ class FleetRunner:
         self.shells: list[_FleetClusterShell] = []
         for i in range(F):
             t_i = core_mod.build_test(self.spec.cluster_opts(test, i))
-            t_i["nemesis"] = (True if t_i["nemesis_pkg"]["generator"]
-                              is not None else None)
             # shells never write files; the fleet's dir lets graceful
             # preemption (Preempted.checkpoint_dir) name the right place
             t_i["store_dir"] = test.get("store_dir")
-            self.shells.append(_FleetClusterShell(t_i, fleet=self, idx=i))
+            shell = _FleetClusterShell(t_i, fleet=self, idx=i)
+            # the nemesis truthiness rewrite comes AFTER construction,
+            # mirroring run_tpu_test's ordering exactly: program
+            # builders sniff the fault SET (edge_timing grows the edge
+            # ring +2 under `duplicate` so second deliveries are
+            # representable), and rewriting first silently sized fleet
+            # rings without that headroom — every duplicate clipped/
+            # self-overwrote, flagged invalid by the net checker (found
+            # by the ISSUE 12 verification; the old test solos made the
+            # same premature rewrite, masking it)
+            t_i["nemesis"] = (True if t_i["nemesis_pkg"]["generator"]
+                              is not None else None)
+            self.shells.append(shell)
         s0 = self.shells[0]
         self.program, self.cfg = s0.program, s0.cfg
         self.concurrency = s0.concurrency
@@ -190,12 +215,18 @@ class FleetRunner:
 
         from ..checkers.netstats import TransferStats
         self.transfer = TransferStats()
+        # open-world fleets (doc/streams.md x doc/perf.md): continuous
+        # shells run `_loop_steps_continuous` and yield cscan requests;
+        # the fleet answers them with the vmapped sched-inject scan
+        self.continuous = bool(test.get("continuous"))
         self._state_cache = None     # host nodes cache (read_state)
         self._sim_cache = None       # host full-tree cache (snapshots)
         self._scan_fn = None
+        self._cscan_fn = None        # sched-inject (continuous) variant
         self._quiet_fn = None
         self._restart_fn = None
         self._pack = None
+        self._pack_c = None          # continuous drain (replies + mids)
         self._empty_inject = T.Msgs.empty(max(self.concurrency, 1))
         donate = (0,) if donation_enabled() else ()
         self._bump_fn = jax.jit(
@@ -302,28 +333,67 @@ class FleetRunner:
         self.sim = self._bump_fn(self.sim, jnp.asarray(ks))
         self._invalidate()
 
+    def _columnar_inject(self, fill, width: int) -> "T.Msgs":
+        """The fleet's [F, width] inject batch assembled numpy-columnar
+        (doc/perf.md "vectorized host driver"): `fill(valid, src, dest,
+        typ, a, b, c)` writes cluster rows into preallocated host
+        buffers, then ONE `jnp.asarray` per field materializes the
+        whole fleet's batch — O(1) device constructions per wave
+        instead of one Msgs build + tree-stack per cluster."""
+        F = self.spec.fleet
+        shape = (F, width)
+        valid = np.zeros(shape, bool)
+        src = np.zeros(shape, np.int32)
+        dest = np.zeros(shape, np.int32)
+        typ = np.zeros(shape, np.int32)
+        a = np.zeros(shape, np.int32)
+        b = np.zeros(shape, np.int32)
+        c = np.zeros(shape, np.int32)
+        fill(valid, src, dest, typ, a, b, c)
+        z = jnp.zeros(shape, T.I32)
+        return T.Msgs(valid=jnp.asarray(valid), src=jnp.asarray(src),
+                      dest=jnp.asarray(dest), due=z, mid=z,
+                      reply_to=jnp.full(shape, -1, T.I32),
+                      type=jnp.asarray(typ), a=jnp.asarray(a),
+                      b=jnp.asarray(b), c=jnp.asarray(c))
+
     def _exec_fleet_scan(self, reqs: dict) -> dict:
         """One vmapped dispatch covering every cluster with a pending
         scan request; the rest are held by the active mask. Returns
         {cluster: (k_executed, replies)}."""
+        t0 = time.perf_counter()
         F = self.spec.fleet
-        injects, kmax = [], np.ones(F, np.int32)
+        N, C = self.cfg.n_nodes, max(self.concurrency, 1)
+        kmax = np.ones(F, np.int32)
         stop = np.ones(F, bool)
         active = np.zeros(F, bool)
-        for i in range(F):
-            req = reqs.get(i)
-            if req is None:
-                injects.append(self._empty_inject)
-                continue
-            inject_rows, k_max, st, _hist, _r = req
-            injects.append(self.shells[i]._encode_inject(inject_rows))
-            kmax[i], stop[i], active[i] = k_max, st, True
-        inject = jax.tree.map(lambda *xs: jnp.stack(xs), *injects)
+
+        def fill(valid, src, dest, typ, a, b, c):
+            for i, req in reqs.items():
+                inject_rows, k_max, st, _hist, _r = req
+                kmax[i], stop[i], active[i] = k_max, st, True
+                m = len(inject_rows)
+                if not m:
+                    continue
+                cols = np.asarray(
+                    [(p, ni, t, aa, bb, cc) for (p, _o, ni, t, aa,
+                                                 bb, cc) in inject_rows],
+                    np.int64).T
+                valid[i, :m] = True
+                src[i, :m] = cols[0] + N
+                dest[i, :m] = cols[1]
+                typ[i, :m] = cols[2]
+                a[i, :m] = cols[3]
+                b[i, :m] = cols[4]
+                c[i, :m] = cols[5]
+
+        inject = self._columnar_inject(fill, C)
         if self._scan_fn is None:
             from ..sim import make_fleet_scan_fn
             self._scan_fn = make_fleet_scan_fn(
                 self.program, self.cfg, reply_cap=self.reply_log_cap,
                 donate=True, shardings=self._shardings)
+        self.transfer.host_poll_s += time.perf_counter() - t0
         self.sim, _cm, k, rl = self._scan_fn(
             self.sim, inject, jnp.asarray(kmax), jnp.asarray(stop),
             jnp.asarray(active))
@@ -347,6 +417,75 @@ class FleetRunner:
             row_log = jax.tree.map(lambda a, i=i: a[i], rlog)
             out[i] = (int(k[i]), sh._decode_replies(
                 row_log, rounds[i], plog[i] if W else (), int(rn[i])))
+        return out
+
+    def _exec_fleet_cscan(self, reqs: dict) -> dict:
+        """One vmapped SCHED-INJECT dispatch (`--fleet N --continuous`):
+        every requesting cluster's pre-scheduled window rides one
+        columnar [F, Q] inject tensor + round-offset tensor, held lanes
+        masked inactive; one packed fetch drains replies AND the
+        per-row confirmed message ids for the whole fleet. Returns
+        {cluster: (k_executed, replies, inj_mids_row)} — exactly what
+        each shell's `_loop_steps_continuous` expects from its own
+        standalone `_exec_cscan`."""
+        t0 = time.perf_counter()
+        F = self.spec.fleet
+        N, Q = self.cfg.n_nodes, max(self.concurrency, 1)
+        at = np.full((F, Q), -1, np.int32)
+        kmax = np.ones(F, np.int32)
+        stop = np.ones(F, bool)
+        active = np.zeros(F, bool)
+
+        def fill(valid, src, dest, typ, a, b, c):
+            for i, req in reqs.items():
+                rows, k_max, st, _hist, r = req
+                kmax[i], stop[i], active[i] = k_max, st, True
+                cols = g.sched_columns(rows, r, Q, N)
+                at[i] = cols["at"]
+                valid[i] = cols["valid"]
+                src[i] = cols["src"]
+                dest[i] = cols["dest"]
+                typ[i] = cols["type"]
+                a[i] = cols["a"]
+                b[i] = cols["b"]
+                c[i] = cols["c"]
+
+        inject = self._columnar_inject(fill, Q)
+        if self._cscan_fn is None:
+            from ..sim import make_fleet_scan_fn
+            self._cscan_fn = make_fleet_scan_fn(
+                self.program, self.cfg, reply_cap=self.reply_log_cap,
+                donate=True, shardings=self._shardings,
+                sched_inject=True)
+        self.transfer.host_poll_s += time.perf_counter() - t0
+        self.sim, _cm, k, rl, im = self._cscan_fn(
+            self.sim, inject, jnp.asarray(at), jnp.asarray(kmax),
+            jnp.asarray(stop), jnp.asarray(active))
+        self._invalidate()
+        # the batched window is in flight: overlap each cluster's
+        # analysis of its last drained segment with the device time
+        # (the PR 7 windowed graders run per shell, so checker-lag
+        # stays a per-cluster metric while the fleet streams)
+        for i, req in sorted(reqs.items()):
+            self.shells[i]._overlap_feed(req[3])
+        if self._pack_c is None:
+            self._pack_c = TpuRunner._make_packer(
+                (rl, im, k, self.sim.net.next_mid))
+        pack, unpack = self._pack_c
+        # ONE fetched array for the whole fleet per wave: replies,
+        # confirmed inj_mids, per-lane k, and the mid counters together
+        flat = self.transfer.fetch(
+            pack((rl, im, k, self.sim.net.next_mid)))
+        (rlog, rounds, plog, rn), im, k, next_mid = unpack(flat)
+        W = int(getattr(self.program, "reply_payload_words", 0) or 0)
+        out = {}
+        for i in sorted(reqs):
+            sh = self.shells[i]
+            sh._next_mid = int(next_mid[i])
+            row_log = jax.tree.map(lambda a, i=i: a[i], rlog)
+            out[i] = (int(k[i]), sh._decode_replies(
+                row_log, rounds[i], plog[i] if W else (), int(rn[i])),
+                im[i])
         return out
 
     # --- checkpoint / preemption ----------------------------------------
@@ -374,6 +513,14 @@ class FleetRunner:
             "intern": sh.intern,
             "nemesis_rng": (sh.nemesis.rng_state()
                             if sh.nemesis else None),
+            # continuous-mode carry (scheduled-but-uninjected rows +
+            # the drawn nemesis/host ops — the schedule cannot be
+            # re-drawn; None on round-synchronous shells) and the
+            # program's host session state (kafka consumer sessions):
+            # both ride the coalesced fleet checkpoint exactly like the
+            # standalone checkpoint's meta
+            "carry": getattr(sh, "_carry_live", None),
+            "program_host": sh.program.host_state(),
             "history_columns": history.snapshot_columns(),
         }
         self._snaps[i] = {
@@ -497,7 +644,9 @@ class FleetRunner:
                                                      History())}
                 continue
             self._states[i] = sh._setup_run(cluster_resumes[i])
-            steps[i] = sh._loop_steps(**self._states[i])
+            loop = (sh._loop_steps_continuous if sh.continuous
+                    else sh._loop_steps)
+            steps[i] = loop(**self._states[i])
         if self.checkpoint_every:
             self._seed_initial_snaps()
 
@@ -579,6 +728,13 @@ class FleetRunner:
                     if not stopped[i]:
                         self.shells[i]._preempt.set()
             scan_reqs: dict = {}
+            cscan_reqs: dict = {}
+            # one host poll pass per wave: advancing every ready
+            # cluster's coroutine (their generator scheduling runs in
+            # here) — booked ONCE for the whole fleet, the O(waves)
+            # counter the fleet_stream bench compares against
+            # per-cluster standalone polls
+            _poll_t0 = time.perf_counter()
             while ready:
                 quiet_wait, bump_wait = [], {}
                 for i, resp in ready:
@@ -608,6 +764,8 @@ class FleetRunner:
                         quiet_wait.append(i)
                     elif kind == "bump":
                         bump_wait[i] = req[1]
+                    elif kind == "cscan":
+                        cscan_reqs[i] = req[1:]
                     else:
                         scan_reqs[i] = req[1:]
                 ready = []
@@ -618,9 +776,15 @@ class FleetRunner:
                     qs = self._probe_quiet()
                     ready += [(i, bool(qs[i]))
                               for i in sorted(quiet_wait)]
+            if scan_reqs or cscan_reqs:
+                self.transfer.record_poll(
+                    time.perf_counter() - _poll_t0)
             if scan_reqs:
                 results = self._exec_fleet_scan(scan_reqs)
-                ready = [(i, results[i]) for i in sorted(scan_reqs)]
+                ready += [(i, results[i]) for i in sorted(scan_reqs)]
+            if cscan_reqs:
+                results = self._exec_fleet_cscan(cscan_reqs)
+                ready += [(i, results[i]) for i in sorted(cscan_reqs)]
             if self.checkpoint_every:
                 self._write_checkpoint(finished)
             if preempted and not ready:
@@ -707,11 +871,20 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
         "fleet": F,
         "fleet-sweep": runner.spec.sweep,
         "mesh": str(test.get("mesh")) if test.get("mesh") else None,
+        "continuous": bool(test.get("continuous")),
         "valid": all_valid,
         "clusters": cluster_results,
         "final-rounds": list(runner.final_rounds),
         **runner.transfer.as_dict(),
     }
+    # fleet-level checker-lag roll-up (doc/streams.md): the worst lag
+    # any cluster's windowed grader recorded — bounded lag means the
+    # per-cluster stream graders kept up while the whole fleet ran
+    lags = [(c.get("analysis-pipeline") or {}).get("max-lag-rounds")
+            for c in cluster_results]
+    lags = [v for v in lags if v is not None]
+    if lags:
+        results["max-checker-lag-rounds"] = max(lags)
     if resume is not None:
         results["resumed-at-round"] = resume["r"]
     # ONE static-audit block for the whole fleet: the vmapped fleet

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from functools import partial
 
 import jax
@@ -857,8 +858,15 @@ class TpuRunner:
                     "resume with journaling: net-journal rows and the "
                     "Lamport diagram cover only rounds >= %d; "
                     "history/results cover the whole run", r)
-        next_ckpt = (r + self.checkpoint_every_rounds
-                     if self.checkpoint_every_rounds else None)
+        # checkpoint cadence stays GRID-ALIGNED across resume: the next
+        # boundary is the next cadence multiple after r, not r + cadence
+        # — a graceful-preemption checkpoint lands at an arbitrary
+        # stretch boundary, and in continuous mode checkpoint
+        # boundaries are window boundaries (op timing depends on them),
+        # so a resumed run must reproduce the original grid to stay
+        # byte-identical (caught by the fleet-continuous resume seam)
+        ce = self.checkpoint_every_rounds
+        next_ckpt = ((r // ce) + 1) * ce if ce else None
         if not self.no_overlap and self.check_workers > 0 \
                 and _wants_analysis(test.get("checker")):
             from ..checkers.pipeline import AnalysisPipeline
@@ -866,7 +874,10 @@ class TpuRunner:
                 workers=self.check_workers,
                 observers=_stream_observers(test.get("checker"), test),
                 ns_per_round=self.ms_per_round * 1e6,
-                head_round=lambda: getattr(self, "_r_live", 0))
+                head_round=lambda: getattr(self, "_r_live", 0),
+                # fleet shells stamp their cluster index on window
+                # records/reports (None for a standalone runner)
+                label=getattr(self, "idx", None))
         self._fed_upto = 0
         if resume is not None and self.pipeline is not None and \
                 len(history) > 0:
@@ -1026,6 +1037,11 @@ class TpuRunner:
             # replies are in the history, so this is the graceful spot
             # to honor a pending SIGTERM/SIGINT
             self._check_preempted(gen, history, pending, free, r)
+            # one host poll pass per stretch boundary: the generator
+            # poll loop below (plus the pending/deadline scans riding
+            # this iteration) — surfaced as host-polls/host-poll-s so
+            # the O(waves)-not-O(clusters) fleet claim is measurable
+            _poll_t0 = time.perf_counter()
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
             inject_rows = []
@@ -1093,6 +1109,8 @@ class TpuRunner:
                 ctx = {"time": self._time_ns(r),
                        "free": self._free_rotated(free, history),
                        "processes": processes}
+
+            self.transfer.record_poll(time.perf_counter() - _poll_t0)
 
             if exhausted and not pending and free == set(processes):
                 break
@@ -1334,7 +1352,10 @@ class TpuRunner:
 
             # pre-schedule the window; nemesis ops due NOW execute
             # immediately (fault surgery before the dispatch) and
-            # scheduling resumes with the masks installed
+            # scheduling resumes with the masks installed. This whole
+            # block is ONE host poll pass (scheduling + encode) per
+            # window boundary — the unit host-polls/host-poll-s counts
+            _poll_t0 = time.perf_counter()
             while True:
                 gen, evs, nem, _end, end_kind = g.schedule_ahead(
                     gen, processes, free, r, horizon(), ns_pr,
@@ -1355,6 +1376,7 @@ class TpuRunner:
             exhausted = end_kind == "exhausted"
             # stable by round: carried rows precede same-round new ones
             carry_sched.sort(key=lambda rw: rw[0])
+            self.transfer.record_poll(time.perf_counter() - _poll_t0)
             self._carry_live = {"sched": carry_sched, "nem": carry_nem,
                                 "host": carry_host}
 
@@ -1560,23 +1582,21 @@ class TpuRunner:
         C = self.concurrency
         program, cfg = self.program, self.cfg
         N, Q = cfg.n_nodes, max(self.concurrency, 1)
-        M = len(rows)
-        if M > Q:       # pragma: no cover - workers bound the schedule
-            raise RuntimeError(f"{M} scheduled rows exceed the {Q}-row "
-                              f"inject batch")
+        # numpy-columnar encode (generators.sched_columns, shared with
+        # the fleet driver's [fleet, Q] batch assembly): one asarray per
+        # field instead of per-row Python loops
+        cols = g.sched_columns(rows, r, Q, N)
         inject = T.Msgs.empty(Q)
-        at = np.full(Q, -1, np.int32)
-        if M:
-            at[:M] = [rw[0] - r for rw in rows]
-            pad = [0] * (Q - M)
+        at = cols["at"]
+        if rows:
             inject = inject.replace(
-                valid=jnp.arange(Q) < M,
-                src=jnp.asarray([rw[1] + N for rw in rows] + pad, T.I32),
-                dest=jnp.asarray([rw[3] for rw in rows] + pad, T.I32),
-                type=jnp.asarray([rw[4] for rw in rows] + pad, T.I32),
-                a=jnp.asarray([rw[5] for rw in rows] + pad, T.I32),
-                b=jnp.asarray([rw[6] for rw in rows] + pad, T.I32),
-                c=jnp.asarray([rw[7] for rw in rows] + pad, T.I32))
+                valid=jnp.asarray(cols["valid"]),
+                src=jnp.asarray(cols["src"]),
+                dest=jnp.asarray(cols["dest"]),
+                type=jnp.asarray(cols["type"]),
+                a=jnp.asarray(cols["a"]),
+                b=jnp.asarray(cols["b"]),
+                c=jnp.asarray(cols["c"]))
         if self._cscan_fn is None:
             from ..sim import make_scan_fn
             self._cscan_fn = make_scan_fn(
@@ -1705,12 +1725,12 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     cluster instances inside one compiled scan, each checked and stored
     per cluster."""
     if int(test.get("fleet") or 1) > 1:
-        if test.get("continuous"):
-            raise ValueError(
-                "--continuous with --fleet is not supported yet: the "
-                "fleet driver coalesces round-synchronous scan requests "
-                "(run the continuous campaign as separate processes, or "
-                "drop --continuous)")
+        # --continuous composes since ISSUE 12 (doc/perf.md "vectorized
+        # host driver"): continuous shells yield cscan requests and the
+        # fleet answers them with one vmapped sched-inject dispatch per
+        # wave. Programs whose completions read mutable end-of-stretch
+        # state remain rejected per shell (the TpuRunner constructor's
+        # continuous guard), exactly as standalone.
         from .fleet_runner import run_fleet_test
         return run_fleet_test(test, test_dir)
     runner = TpuRunner(test)

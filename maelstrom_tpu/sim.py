@@ -821,7 +821,7 @@ def make_scan_fn(program, cfg: NetConfig, journal_cap: int | None = None,
 def make_fleet_scan_fn(program, cfg: NetConfig,
                        journal_cap: int | None = None,
                        reply_cap: int | None = None, donate: bool = False,
-                       shardings=None):
+                       shardings=None, sched_inject: bool = False):
     """Jitted FLEET scan: the single-cluster scan body vmapped over a
     leading cluster axis, so N independent cluster instances advance
     inside one compiled dispatch.
@@ -842,17 +842,29 @@ def make_fleet_scan_fn(program, cfg: NetConfig,
     to keep clusters whose host loop is between dispatches (or finished)
     frozen while others scan.
 
+    `sched_inject=True` builds the continuous-mode fleet variant
+    (doc/streams.md): fleet_fn(sim, inject, at_rounds, k_max,
+    stop_on_reply, active) takes a [F, Q] inject batch plus a [F, Q]
+    round-offset tensor, each lane injecting its rows at their scheduled
+    offsets inside the compiled window, and drains a [F, Q] `inj_mids`
+    output next to the reply log (-1 = not injected; held lanes report
+    all -1, since their window never ran). This is the `--fleet N
+    --continuous` dispatch: one columnar inj tensor and one inj_mids
+    drain per wave for the whole fleet.
+
     `shardings` pins the cluster-batched placement for `--mesh dp,sp`
     execution: the fleet axis shards over dp, per-cluster node/pool axes
     over sp (`parallel.fleet_scan_shardings`)."""
-    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap)
-    vscan = jax.vmap(scan_fn, in_axes=(0, 0, 0, 0))
+    scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap,
+                                     sched_inject)
+    n_in = 5 if sched_inject else 4
+    vscan = jax.vmap(scan_fn, in_axes=(0,) * n_in)
     has_replies = reply_cap is not None
 
-    def fleet_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply,
-                 active):
-        out = vscan(sim, inject, jnp.asarray(k_max, jnp.int32),
-                    jnp.asarray(stop_on_reply, bool))
+    def _mask_held(out, sim, active):
+        """Held (inactive) lanes computed their mandatory first round;
+        discard it: state reverts to the input row, k and the reply
+        count come back 0, and (sched_inject) no mids are confirmed."""
         sim2, cm, k = out[0], out[1], out[2]
         act = jnp.asarray(active, bool)
 
@@ -866,9 +878,30 @@ def make_fleet_scan_fn(program, cfg: NetConfig,
             rlog, rounds, plog, rn = extra[0]
             extra = ((rlog, rounds, plog, jnp.where(act, rn, 0)),) \
                 + extra[1:]
+        if sched_inject:
+            i = 1 if has_replies else 0
+            im = jnp.where(act[:, None], extra[i], -1)
+            extra = extra[:i] + (im,) + extra[i + 1:]
         return (sim2, cm, k) + extra
 
-    return jax.jit(fleet_fn, **_jit_kwargs(donate, shardings, 5, n_outs))
+    if sched_inject:
+        def fleet_fn(sim: SimState, inject: Msgs, at_rounds, k_max,
+                     stop_on_reply, active):
+            out = vscan(sim, inject, jnp.asarray(at_rounds, jnp.int32),
+                        jnp.asarray(k_max, jnp.int32),
+                        jnp.asarray(stop_on_reply, bool))
+            return _mask_held(out, sim, active)
+        n_args = 6
+    else:
+        def fleet_fn(sim: SimState, inject: Msgs, k_max, stop_on_reply,
+                     active):
+            out = vscan(sim, inject, jnp.asarray(k_max, jnp.int32),
+                        jnp.asarray(stop_on_reply, bool))
+            return _mask_held(out, sim, active)
+        n_args = 5
+
+    return jax.jit(fleet_fn,
+                   **_jit_kwargs(donate, shardings, n_args, n_outs))
 
 
 def make_run_fn(program, cfg: NetConfig, collect_client_msgs: bool = False,

@@ -72,6 +72,18 @@ step artifacts/bench-compartment-r9.json 2400 \
 step artifacts/bench-checker-r11.json 2400 \
     env BENCH_MODE=checker python bench.py
 
+# 1g. million-session open-world fleets (BENCH_MODE=fleet_stream,
+#     ISSUE 12): `--fleet N --continuous` end to end — N streaming
+#     kafka clusters in one vmapped sched-inject scan at fleet 1/8/64 x
+#     offered rates 1x/4x. Headline `value` = sustained aggregate
+#     client-ops/s at the top point, `vs_baseline` = the measured
+#     host-poll amortization (>= 8x acceptance at fleet 64; CPU r01 in
+#     artifacts/bench-fleet-stream-cpu-r01.json), with max checker-lag
+#     bounded at every recorded rate (doc/perf.md "vectorized host
+#     driver")
+step artifacts/bench-fleet-stream-r12.json 3600 \
+    env BENCH_MODE=fleet_stream python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -51,6 +51,48 @@ if [ "${STREAM_SMOKE:-1}" = "1" ]; then
     echo "== stream smoke valid =="
 fi
 
+# Fleet-continuous smoke (ISSUE 12, doc/perf.md "vectorized host
+# driver"): `--fleet 2 --continuous` streaming kafka end to end,
+# AUDITED (the fleet self-report traces the vmapped sched-inject scan
+# this run actually dispatches), then the same fleet on the post-hoc
+# path (--no-overlap) — each cluster's windowed-grader workload verdict
+# must be bit-equal to its post-hoc verdict (windows/checker-lag
+# accounting stripped). FLEET_STREAM_SMOKE=0 skips.
+if [ "${FLEET_STREAM_SMOKE:-1}" = "1" ]; then
+    echo "== fleet-continuous smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w kafka --node tpu:kafka \
+        --node-count 5 --continuous --kafka-groups 2 --fleet 2 \
+        --rate 20 --time-limit 2 --seed 7 \
+        --store "$SMOKE_STORE/win" > /dev/null
+    python -m maelstrom_tpu test -w kafka --node tpu:kafka \
+        --node-count 5 --continuous --kafka-groups 2 --fleet 2 \
+        --rate 20 --time-limit 2 --seed 7 --no-overlap --no-audit \
+        --store "$SMOKE_STORE/post" > /dev/null
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+def wl(side, i):
+    with open(os.path.join(root, side, "latest",
+                           f"cluster-{i:04d}", "results.json")) as f:
+        r = json.load(f)["workload"]
+    return {k: v for k, v in r.items()
+            if k not in ("windows", "checker-lag")}
+for i in range(2):
+    win, post = wl("win", i), wl("post", i)
+    assert win == post, \
+        f"cluster {i} windowed/post-hoc verdicts diverge:\n{win}\n{post}"
+    assert win["valid"] is True, win
+with open(os.path.join(root, "win", "latest", "results.json")) as f:
+    res = json.load(f)
+assert res["continuous"] is True and res["host-polls"] > 0, res
+assert res["static-audit"]["ok"] is True, res["static-audit"]
+print("fleet-continuous smoke: verdicts bit-equal, audited, valid")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== fleet-continuous smoke valid =="
+fi
+
 # Batched-broadcast smoke (ISSUE 9, doc/perf.md): the distilled-batch
 # node end to end — plain, sharded (--mesh 1,2 over the forced 2-device
 # CPU mesh), and under the combined nemesis soup — expansion proofs

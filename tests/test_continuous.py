@@ -180,9 +180,22 @@ def test_continuous_checkpoint_resume_identical():
 
 
 def test_continuous_rejections():
-    """Guard rails: --continuous composes with neither --fleet nor
-    programs whose completions read mutable end-of-stretch state."""
-    with pytest.raises(ValueError, match="fleet"):
-        core.run(dict(store_root=STORE, workload="echo",
-                      node="tpu:echo", node_count=4, fleet=2,
-                      continuous=True, time_limit=1.0))
+    """Guard rails: --fleet composes with --continuous since ISSUE 12
+    (covered by tests/test_fleet_continuous.py), so the one remaining
+    rejection is per program — completions that read mutable
+    end-of-stretch state cannot cross reply-bearing windows. It fires
+    identically standalone and per fleet shell."""
+    from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+    # every stock program is continuous-capable today (state_reads_final
+    # or reply payloads), so pin the guard itself: forcing the
+    # per-reply dispatch mode (collect_replies False) puts any program
+    # in the rejected class
+    opts = dict(store_root=STORE, workload="broadcast",
+                node="tpu:broadcast", node_count=4, continuous=True,
+                time_limit=1.0, collect_replies=False)
+    with pytest.raises(ValueError, match="continuous"):
+        TpuRunner(core.build_test(dict(opts)))
+    from maelstrom_tpu.runner.fleet_runner import FleetRunner
+    with pytest.raises(ValueError, match="continuous"):
+        FleetRunner(core.build_test({**opts, "fleet": 2}))

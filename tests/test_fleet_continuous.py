@@ -1,0 +1,292 @@
+"""`--fleet N --continuous` (ISSUE 12, doc/perf.md "vectorized host
+driver"): N independent OPEN-WORLD clusters — offered-rate client ops
+injected inside the compiled windows while faults are live — advance in
+one vmapped sched-inject scan, with the host cost amortized across the
+fleet (one columnar [fleet, Q] inject tensor, one packed `inj_mids` +
+reply drain, ONE host poll pass per wave).
+
+The contract under test is the fleet runner's usual bar applied to the
+continuous loop: every cluster's history is **bit-identical** to the
+standalone `--continuous` run of its own option set — plain, sharded
+(`--mesh 2,1`), under the combined nemesis, and across a
+checkpoint/resume seam (graceful preemption in-process; the real
+SIGKILL subprocess soak and the 3-workload soup are slow-marked). On
+top of that, the host-poll counters must show the O(waves) claim: a
+fleet's driver-level polls stay ~flat in fleet size instead of scaling
+with clusters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from conftest import ops_projection as _ops
+from maelstrom_tpu import checkpoint as cp
+from maelstrom_tpu import core
+from maelstrom_tpu.runner.fleet_runner import FleetRunner, run_fleet_test
+from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+LIN_KV = {"workload": "lin-kv", "node": "tpu:lin-kv", "node_count": 3,
+          "rate": 10.0, "time_limit": 1.5, "recovery_s": 0.5, "seed": 11,
+          "continuous": True, "timeout_ms": 1000, "audit": False}
+ECHO = {"workload": "echo", "node": "tpu:echo", "node_count": 3,
+        "rate": 20.0, "time_limit": 1.0, "seed": 7, "continuous": True,
+        # size workers to the offered rate (doc/streams.md): emitted
+        # ops reserve their worker for the window, so the capacity
+        # sweep needs headroom for the ramped rates to differentiate
+        "concurrency": 16, "timeout_ms": 1000, "audit": False}
+KAFKA = {"workload": "kafka", "node": "tpu:kafka", "node_count": 4,
+         "rate": 20.0, "time_limit": 1.5, "recovery_s": 0.5, "seed": 5,
+         "kafka_groups": 2, "continuous": True, "timeout_ms": 1000,
+         "audit": False}
+SOUP = {"nemesis": ["kill", "pause", "partition", "duplicate"],
+        "nemesis_interval": 0.4}
+
+
+_SOLO_CACHE: dict = {}
+
+
+def _solo(opts):
+    # standalone-continuous baselines are shared across tests (runs are
+    # deterministic by contract) — same memoization scheme as
+    # tests/test_fleet_runner.py
+    key = repr(sorted(opts.items(), key=lambda kv: repr(kv[0])))
+    if key not in _SOLO_CACHE:
+        test = core.build_test(dict(opts))
+        # construct BEFORE the nemesis truthiness rewrite, exactly like
+        # run_tpu_test: program builders sniff the fault SET (edge ring
+        # headroom under `duplicate` — nodes.edge_timing)
+        runner = TpuRunner(test)
+        test["nemesis"] = (True if test["nemesis_pkg"]["generator"]
+                           is not None else None)
+        _SOLO_CACHE[key] = (runner.run(), runner)
+    return _SOLO_CACHE[key]
+
+
+def _fleet(opts, **fleet_over):
+    test = core.build_test({**opts, **fleet_over})
+    runner = FleetRunner(test)
+    return runner, runner.run()
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: every open-world cluster == its standalone continuous run
+# ---------------------------------------------------------------------------
+
+def test_fleet_continuous_bit_identical_plain():
+    """The core contract: a 2-cluster continuous lin-kv fleet equals the
+    standalone continuous runs of seeds 11 and 12 op for op, and the
+    fleet driver's wave count stays ~that of ONE run (host cost
+    amortized, not multiplied)."""
+    solos = [_solo({**LIN_KV, "seed": s})[0] for s in (11, 12)]
+    runner, hs = _fleet(LIN_KV, fleet=2)
+    assert len(hs[0]) > 10
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+    # driver-level polls ~ waves ~ one cluster's window count, NOT the
+    # fleet sum: the O(1)-in-fleet-size property (exact counts vary
+    # with boundary interleaving, so assert the order, not a constant)
+    solo_polls = [_solo({**LIN_KV, "seed": s})[1].transfer.host_polls
+                  for s in (11, 12)]
+    assert runner.transfer.host_polls < sum(solo_polls), (
+        runner.transfer.host_polls, solo_polls)
+
+
+def test_fleet_continuous_combined_nemesis_bit_identical():
+    """Under the combined kill/pause/partition/duplicate soup, client
+    ops keep landing INSIDE fault windows (the open-world point) and
+    every cluster still replays its standalone continuous run."""
+    opts = {**LIN_KV, **SOUP, "time_limit": 1.2}
+    solos = [_solo({**opts, "seed": s})[0] for s in (11, 12)]
+    _, hs = _fleet(opts, fleet=2)
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+@pytest.mark.multichip
+def test_fleet_continuous_mesh_dp2_bit_identical():
+    """`--fleet 2 --continuous --mesh 2,1`: the cluster axis sharded
+    over dp while the sched-inject windows run inside the vmapped scan
+    — every cluster equal to its (single-chip) standalone continuous
+    run."""
+    solos = [_solo({**LIN_KV, "seed": 11 + i})[0] for i in range(2)]
+    runner, hs = _fleet(LIN_KV, fleet=2, mesh="2,1")
+    assert runner.mesh is not None and runner.mesh.shape["dp"] == 2
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+def test_fleet_continuous_capacity_sweep():
+    """`--fleet-sweep capacity` composes with --continuous: cluster i
+    streams at rate * (i + 1) and equals the standalone continuous run
+    at that rate."""
+    solos = [_solo({**ECHO, "rate": 20.0 * (i + 1)})[0]
+             for i in range(2)]
+    _, hs = _fleet(ECHO, fleet=2, fleet_sweep="capacity")
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+    assert len(hs[1]) > len(hs[0])
+
+
+# ---------------------------------------------------------------------------
+# Windowed grading + the host-poll counters (run_fleet_test end to end)
+# ---------------------------------------------------------------------------
+
+def test_fleet_continuous_windowed_grading_and_polls(tmp_path):
+    """The end-to-end entry point: a continuous kafka fleet grades
+    every cluster through its own PR 7 windowed stream observer
+    (per-cluster windows with bounded lag, cluster-tagged), the fleet
+    results block carries the host-poll counters and the fleet-level
+    checker-lag roll-up, and the old up-front rejection is gone."""
+    test = core.build_test({**KAFKA, "fleet": 2})
+    res = run_fleet_test(test, str(tmp_path))
+    assert res["valid"] is True
+    assert res["continuous"] is True and res["fleet"] == 2
+    # host-driver poll accounting: one pass per wave, surfaced
+    assert res["host-polls"] > 0
+    assert res["host-poll-s"] >= 0
+    # per-cluster windowed grading: each shell's pipeline saw only its
+    # own rows, graded them as windows, and the lag roll-up is bounded
+    # by the scan horizon
+    assert "max-checker-lag-rounds" in res
+    for i, c in enumerate(res["clusters"]):
+        ap = c.get("analysis-pipeline")
+        assert ap is not None and ap["windows"] >= 1
+        assert ap["cluster"] == i
+        w = c["workload"]
+        assert w["valid"] is True
+        assert all("verdict" in rec for rec in w["windows"])
+    max_round = max(res["final-rounds"])
+    assert 0 <= res["max-checker-lag-rounds"] <= max_round
+
+
+def test_fleet_continuous_windowed_verdict_equals_posthoc():
+    """The PR 7 equality contract holds per cluster under the fleet:
+    the windowed incremental kafka verdict is bit-equal to the post-hoc
+    whole-history checker (--no-overlap) for every cluster."""
+    import jax
+
+    def verdicts(**over):
+        test = core.build_test({**KAFKA, "fleet": 2, **over})
+        runner = FleetRunner(test)
+        hs = runner.run()
+        out = []
+        for i, sh in enumerate(runner.shells):
+            sh.sim = jax.tree.map(lambda a, i=i: a[i], runner.sim)
+            t_i = sh.test
+            if sh.pipeline is not None:
+                t_i["analysis"] = sh.pipeline
+            w = dict(t_i["workload_map"]["checker"].check(
+                t_i, hs[i], {}))
+            w.pop("windows", None)
+            w.pop("checker-lag", None)
+            out.append(w)
+        return out
+
+    windowed = verdicts()
+    posthoc = verdicts(no_overlap=True)
+    assert windowed == posthoc
+    assert all(w["valid"] is True for w in windowed)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / preemption / resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_continuous_preempt_resume_bit_identical(tmp_path):
+    """Graceful preemption mid-stream: the coalesced fleet checkpoint
+    carries each cluster's continuous-mode carry (scheduled-but-
+    uninjected rows, drawn nemesis op) and program host state, and the
+    resumed fleet lands histories bit-identical to the uninterrupted
+    one — including the checkpoint-grid alignment across the seam
+    (checkpoints are window boundaries in continuous mode)."""
+    opts = {**KAFKA, "nemesis": ["partition"], "nemesis_interval": 0.6,
+            "time_limit": 2.0, "checkpoint_every": 0.25}
+
+    a_dir = tmp_path / "a"
+    a_dir.mkdir()
+    t = core.build_test({**opts, "fleet": 2})
+    t["store_dir"] = str(a_dir)
+    hs_a = FleetRunner(t).run()
+    assert len(hs_a[0]) > 20
+
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    t2 = core.build_test({**opts, "fleet": 2})
+    t2["store_dir"] = str(b_dir)
+    fr2 = FleetRunner(t2)
+
+    def preempt_after_first_checkpoint():
+        deadline = time.time() + 300
+        while time.time() < deadline and not fr2._preempt.is_set():
+            if fr2.transfer.ckpt_saves >= 1:
+                fr2._preempt.set()
+                return
+            time.sleep(0.01)
+    threading.Thread(target=preempt_after_first_checkpoint,
+                     daemon=True).start()
+    with pytest.raises(cp.Preempted):
+        fr2.run()
+
+    ck = cp.load(str(b_dir))
+    t3 = core.build_test({**opts, "fleet": 2})
+    t3["store_dir"] = str(b_dir)
+    fr3 = FleetRunner(t3)
+    cp.check_fingerprint(ck, t3)
+    hs_c = fr3.run(resume=ck)
+    for i in range(2):
+        assert _ops(hs_c[i]) == _ops(hs_a[i]), \
+            f"cluster {i} diverged after resume"
+
+
+@pytest.mark.slow
+def test_fleet_continuous_sigkill_resume_byte_identical(tmp_path):
+    """Real SIGKILL, real subprocess: a --fleet 2 --continuous run
+    killed after its first coalesced checkpoint and resumed with
+    --resume lands byte-identical history.jsonl and verdict-identical
+    results.json against the uninterrupted fleet baseline."""
+    import os
+    import random
+
+    from maelstrom_tpu import crash_soak
+
+    opts = {"-w": "lin-kv", "--node": "tpu:lin-kv", "--node-count": "3",
+            "--rate": "10", "--time-limit": "4", "--seed": "16",
+            "--continuous": True,
+            "--nemesis": "partition", "--nemesis-interval": "1",
+            "--checkpoint-every": "0.5", "--fleet": "2"}
+    root = str(tmp_path / "baseline")
+    baseline = crash_soak.run_once(root, opts,
+                                   os.path.join(str(tmp_path),
+                                                "baseline.log"))
+    res = crash_soak.run_with_kills(str(tmp_path / "killed"), opts,
+                                    kills=1, rng=random.Random(5),
+                                    kill_jitter_s=0.2)
+    assert len(res["kills"]) == 1, res
+    verdict = crash_soak.compare_runs(baseline, res["dir"])
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+
+
+# ---------------------------------------------------------------------------
+# The 3-workload open-world soup (the acceptance trio, slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("opts,seeds", [
+    ({**LIN_KV, **SOUP, "time_limit": 2.0}, (11, 12)),
+    ({**KAFKA, **SOUP, "time_limit": 2.0}, (5, 6)),
+    ({**ECHO, **SOUP, "time_limit": 1.5, "recovery_s": 0.5}, (7, 8)),
+])
+def test_fleet_continuous_soup_bit_identical_all_workloads(opts, seeds):
+    """Raft-backed lin-kv, streaming kafka (consumer groups), and echo
+    fleets under the combined nemesis with --continuous: every cluster
+    bit-identical to its standalone open-world run."""
+    solos = [_solo({**opts, "seed": s})[0] for s in seeds]
+    _, hs = _fleet({**opts, "seed": seeds[0]}, fleet=len(seeds))
+    for i in range(len(seeds)):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"

@@ -52,9 +52,12 @@ def _solo(opts):
     key = repr(sorted(opts.items(), key=lambda kv: kv[0]))
     if key not in _SOLO_CACHE:
         test = core.build_test(dict(opts))
+        # construct BEFORE the nemesis truthiness rewrite, exactly like
+        # run_tpu_test: program builders sniff the fault SET (edge ring
+        # headroom under `duplicate` — nodes.edge_timing)
+        runner = TpuRunner(test)
         test["nemesis"] = (True if test["nemesis_pkg"]["generator"]
                            is not None else None)
-        runner = TpuRunner(test)
         _SOLO_CACHE[key] = runner.run()
     return _SOLO_CACHE[key]
 

@@ -170,8 +170,8 @@ def _comparable(res):
     windowed-grading blocks carry checker lag, which is wall-clock, and
     exist only on the overlapped path — the FINAL verdict fields are
     compared and must match bit-for-bit)."""
-    drop = {"host-blocked-s", "host-overlapped-s", "static-audit",
-            "windows", "checker-lag"}
+    drop = {"host-blocked-s", "host-overlapped-s", "host-poll-s",
+            "static-audit", "windows", "checker-lag"}
     return {name: ({k: v for k, v in r.items() if k not in drop}
                    if isinstance(r, dict) else r)
             for name, r in res.items()

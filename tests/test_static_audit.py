@@ -357,6 +357,20 @@ def test_gate_traces_continuous_scan_variant():
     assert new == [], [f.as_dict() for f in new]
 
 
+def test_gate_traces_fleet_continuous_scan_variant():
+    """ISSUE 12: the fleet program set now traces the vmapped
+    sched-inject scan (`fleet_cscan_fn` — the `--fleet N --continuous`
+    dispatch) next to the round-synchronous fleet scan, under the same
+    zero-new-findings gate."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["lin-kv"], mesh=None, fleet=True)
+    assert any(e.startswith("fleet_cscan_fn[") for e in entries), entries
+    assert any(e.startswith("fleet_scan_fn[") for e in entries), entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+
+
 def test_gate_traces_device_checker_kernels():
     """ISSUE 11: the txn-list-append program set traces the
     device-resident checker's jitted entry points — the elle edge
