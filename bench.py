@@ -1272,6 +1272,9 @@ def main():
         metric = "broadcast_batched_client_ops_per_sec"
         unit = "client-ops/sec"
         fn = _main_broadcast_batched
+    elif mode == "telemetry":
+        metric, unit = "telemetry_ring_overhead_pct", "percent"
+        fn = _main_telemetry
     else:
         metric = ("raft_cluster_rounds_per_sec_10k_clusters" if raft
                   else "broadcast_sim_msgs_per_sec_100k_nodes")
@@ -1553,6 +1556,139 @@ def _main_checker():
         "value": dev.get("build_ops_per_s"),
         "unit": "micro-ops/sec",
         "vs_baseline": dev.get("speedup"),
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"]:
+        sys.exit(1)
+
+
+def bench_telemetry_record() -> dict:
+    """The flight-recorder overhead record (ISSUE 13,
+    doc/observability.md): the SAME chunked broadcast scan (eager
+    resend — the message-heaviest round body) run with the device
+    metric rings compiled OUT and compiled IN, msgs/s compared. The
+    ring is ~20 small int32 ops per round beside the round's sorts and
+    scatters, so the acceptance budget is < 5% on the CPU box
+    (`BENCH_TEL_MAX_OVERHEAD_PCT` overrides). Each config takes the
+    best of `BENCH_TEL_REPS` timed passes (2-core CPU boxes are
+    noisy); histories are byte-identical by construction (pinned in
+    tests/test_telemetry.py), so only wall time is compared here."""
+    import jax
+    import jax.numpy as jnp
+
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    from maelstrom_tpu.nodes.broadcast import T_BCAST
+    from maelstrom_tpu.sim import (dealias, donation_enabled,
+                                   make_run_fn, make_sim)
+
+    N = int(os.environ.get("BENCH_TEL_NODES", 4096))
+    V = int(os.environ.get("BENCH_TEL_VALUES", 64))
+    R = int(os.environ.get("BENCH_TEL_ROUNDS", 400))
+    chunk = min(int(os.environ.get("BENCH_CHUNK", 100)), R)
+    reps = max(int(os.environ.get("BENCH_TEL_REPS", 2)), 1)
+    max_overhead = float(os.environ.get("BENCH_TEL_MAX_OVERHEAD_PCT",
+                                        5.0))
+    R = max(chunk, (R // chunk) * chunk)
+
+    nodes = [f"n{i}" for i in range(N)]
+    program = get_program("broadcast",
+                          {"topology": "grid", "max_values": V,
+                           "gossip_per_neighbor": 1,
+                           "latency": {"mean": 0},
+                           "eager_resend": True},
+                          nodes)
+    donate = donation_enabled()
+
+    rr = np.arange(R)
+    inj_round = (rr % 2 == 0) & (rr // 2 < V)
+    value = (rr // 2) % V
+    dest = (value.astype(np.int64) * 2654435761) % N
+    plan = T.Msgs.empty((R, 1)).replace(
+        valid=jnp.asarray(inj_round[:, None]),
+        src=jnp.full((R, 1), N, T.I32),
+        dest=jnp.asarray(dest.astype(np.int32)[:, None]),
+        type=jnp.full((R, 1), T_BCAST, T.I32),
+        a=jnp.asarray(value.astype(np.int32)[:, None]))
+    chunks = jax.tree.map(
+        lambda f: f.reshape((R // chunk, chunk) + f.shape[1:]), plan)
+
+    def measure(telemetry: bool):
+        cfg = T.NetConfig(
+            n_nodes=N, n_clients=1, pool_cap=8192,
+            inbox_cap=program.inbox_cap, client_cap=0,
+            telemetry=telemetry,
+            telemetry_roles=((0, N),) if telemetry else ())
+        run_fn = make_run_fn(program, cfg, donate=donate)
+
+        def run(seed):
+            sim = make_sim(program, cfg, seed=seed)
+            if donate:
+                sim = dealias(sim)
+            for i in range(R // chunk):
+                sim, _counts = run_fn(
+                    sim, jax.tree.map(lambda f: f[i], chunks))
+            assert int(jax.device_get(sim.net.round)) == R
+            return sim
+
+        t0 = time.perf_counter()
+        run(seed=0)             # compile + first run, untimed
+        print(f"bench[telemetry rings={'on' if telemetry else 'off'}]:"
+              f" compile+first {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        best, sim = None, None
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            sim = run(seed=1)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        st = T.stats_dict(sim.net)
+        seen = np.asarray(jax.device_get(sim.nodes["seen"][:, :V]))
+        ring = None
+        if telemetry:
+            from maelstrom_tpu import telemetry as TM
+            ring = TM.ring_dict(jax.device_get(sim.telemetry))
+        return st, bool(seen.all()), best, ring
+
+    print(f"bench[telemetry]: {N} nodes, {V} values, {R} rounds "
+          f"({chunk}/dispatch), reps {reps}", file=sys.stderr)
+    st_off, conv_off, dt_off, _ = measure(False)
+    st_on, conv_on, dt_on, ring = measure(True)
+    rate_off = st_off["sent_all"] / dt_off
+    rate_on = st_on["sent_all"] / dt_on
+    overhead = (1.0 - rate_on / rate_off) * 100.0
+    rec = {
+        "nodes": N, "values": V, "rounds": R,
+        "reps_best_of": reps,
+        "msgs_per_sec_off": round(rate_off, 1),
+        "msgs_per_sec_on": round(rate_on, 1),
+        "wall_s_off": round(dt_off, 3),
+        "wall_s_on": round(dt_on, 3),
+        "overhead_pct": round(overhead, 3),
+        "max_overhead_pct": max_overhead,
+        "sent_identical": st_off["sent_all"] == st_on["sent_all"],
+        "converged": conv_off and conv_on,
+        "ring": {k: v for k, v in (ring or {}).items()
+                 if isinstance(v, int)},
+        "valid": (conv_off and conv_on
+                  and st_off["sent_all"] == st_on["sent_all"]
+                  and overhead < max_overhead),
+    }
+    return rec
+
+
+def _main_telemetry():
+    """`BENCH_MODE=telemetry`: the flight-recorder overhead record
+    (rings on vs off, same JSON-line contract as the other modes;
+    headline `value` = overhead percent, gate < 5%)."""
+    rec = bench_telemetry_record()
+    record = {
+        "metric": "telemetry_ring_overhead_pct",
+        "value": rec["overhead_pct"],
+        "unit": "percent",
+        "vs_baseline": rec["msgs_per_sec_on"] / rec["msgs_per_sec_off"],
         **rec,
         **_fallback_meta(),
     }

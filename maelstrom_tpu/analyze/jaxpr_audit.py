@@ -326,7 +326,8 @@ def _force_donation(on: bool = True):
 
 
 def production_step_specs(workload: str, mesh: str | None = None,
-                          donate: bool = True) -> list[StepSpec]:
+                          donate: bool = True,
+                          telemetry: bool = False) -> list[StepSpec]:
     """Builds the production `round_fn` / `scan_fn` (plain and journaled)
     for one workload the exact way `runner.tpu_runner` does — same
     program, NetConfig, capacities, shardings, donation — and returns
@@ -354,13 +355,20 @@ def production_step_specs(workload: str, mesh: str | None = None,
                 "time_limit": 1.0}
     if mesh:
         opts["mesh"] = mesh
+    if telemetry:
+        # flight-recorder rings (doc/observability.md): the telemetry
+        # fold becomes part of the traced round/scan bodies, so the
+        # gate proves it adds no host transfers / unstable sorts /
+        # non-unique scatters
+        opts["telemetry"] = "audit"
     with _force_donation(donate):
         test = core.build_test(opts)
         runner = TpuRunner(test)
         inject = T.Msgs.empty(max(runner.concurrency, 1))
         sh = runner._shardings
         sim_sh, out0_sh = (sh[0], sh[0]) if sh is not None else (None, None)
-        tag = f"{workload}{'@mesh=' + mesh if mesh else ''}"
+        tag = (f"{workload}{'@mesh=' + mesh if mesh else ''}"
+               f"{'@telemetry' if telemetry else ''}")
         common = dict(donate_argnums=(0,) if donate else (),
                       in_shardings=sim_sh, out_shardings=out0_sh)
         specs = [
@@ -574,6 +582,16 @@ def audit_production(programs=None, mesh: str | None = "auto",
                                if p in programs]
         for workload, mesh_spec in fleet_jobs:
             for spec in fleet_step_specs(workload, mesh=mesh_spec):
+                findings += audit_step(spec)
+                entries.append(spec.name)
+
+    # flight-recorder rings (doc/observability.md): ring-enabled traces
+    # of one pool-path and one edge-path workload, so the gate audits
+    # the telemetry fold itself — the host-transfer / scatter rules
+    # must stay at zero findings with rings compiled in
+    for workload in ("lin-kv", "broadcast"):
+        if workload in programs:
+            for spec in production_step_specs(workload, telemetry=True):
                 findings += audit_step(spec)
                 entries.append(spec.name)
 

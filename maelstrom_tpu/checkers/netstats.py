@@ -142,6 +142,13 @@ class NetStatsChecker(Checker):
         if getattr(self.net, "batched_msgs", 0):
             stats["sent-units"] = self.net.sent_units
             stats["recv-units"] = self.net.recv_units
+        # flight-recorder counter parity (doc/observability.md): the
+        # same message-flow vocabulary the TPU path's device MetricRing
+        # reports, booked by the host net — surfaced only on
+        # --telemetry runs so classic results keep their shape
+        if test.get("telemetry") and \
+                hasattr(self.net, "telemetry_counters"):
+            stats["telemetry"] = self.net.telemetry_counters()
         # journal ingest volume (counts() includes host-bytes): the host
         # path's analogue of the TPU path's device-drain accounting
         # (TransferStats above, surfaced by TpuNetStats)

@@ -114,8 +114,14 @@ class AnalysisPipeline:
 
     def __init__(self, workers: int = 1, observers: dict | None = None,
                  ns_per_round: float | None = None, head_round=None,
-                 label=None):
+                 label=None, tracer=None):
         self.workers = max(1, int(workers))
+        # flight recorder (doc/observability.md): an optional
+        # TelemetrySession; each analyzed segment lands a
+        # "pipeline-grade" span on the trace's analysis thread row.
+        # Purely observational — failures in the tracer count as
+        # pipeline errors like any other (the checker then recomputes).
+        self._tracer = tracer
         # fleet attribution (doc/perf.md "vectorized host driver"): a
         # cluster index stamped on window records and the report, so a
         # fleet's per-cluster stream-grading blocks stay attributable
@@ -289,7 +295,16 @@ class AnalysisPipeline:
             except Exception as e:
                 self.error = repr(e)
             finally:
-                self.busy_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self.busy_s += t1 - t0
+                if self._tracer is not None:
+                    try:
+                        self._tracer.span(
+                            "pipeline-grade", t0, t1, tid="analysis",
+                            args={"rows": self.rows,
+                                  "segments": self.segments})
+                    except Exception:   # pragma: no cover - defensive
+                        pass
                 self._q.task_done()
 
     def _analyze(self, history, lo: int, hi: int):

@@ -53,7 +53,12 @@ PREV_CHECKPOINT_FILE = "checkpoint.prev.pkl"
 # then the pickle payload. The frame is what makes torn/truncated writes
 # *detectable* (and old raw-pickle checkpoints cleanly rejectable).
 MAGIC = b"MAELCKPT"
-VERSION = 2
+# v3 (ISSUE 13): SimState grew the `telemetry` carry field (the
+# flight-recorder MetricRing). A v2 pickle restores a SimState without
+# the attribute, which would surface as an AttributeError deep inside
+# the first jax tree flatten on resume — version the format instead,
+# so pre-change checkpoints get the curated CheckpointError.
+VERSION = 3
 _HEADER = struct.Struct("<8sIQ32s")     # magic, version, payload len, digest
 
 # The exit code of a run that was preempted (SIGTERM/SIGINT) and wrote a
@@ -148,6 +153,12 @@ def fingerprint(test: dict) -> dict:
     # (doc/streams.md)
     if test.get("continuous"):
         fp["checkpoint_every"] = test.get("checkpoint_every")
+    # flight-recorder rings change the checkpointed carry SHAPE (a
+    # MetricRing rides SimState.telemetry), so a resume must match the
+    # on/off state — but only the boolean: the output DIRECTORY may
+    # move freely between launches (crash-soak roots differ per run)
+    v = test.get("telemetry")
+    fp["telemetry_rings"] = bool(v) and str(v) != "off"
     return fp
 
 

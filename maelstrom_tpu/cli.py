@@ -229,6 +229,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(TPU path: results.json normally carries rule "
                         "counts from a trace-time hazard audit of this "
                         "run's own step functions — doc/analyze.md)")
+    t.add_argument("--telemetry", nargs="?", const="auto", default=None,
+                   metavar="DIR|off",
+                   help="Flight recorder (TPU path, doc/observability"
+                        ".md): device-resident metric rings folded "
+                        "inside the compiled scan (message flow, "
+                        "pool/channel occupancy, per-role sends, "
+                        "latency-in-rounds buckets — drained on the "
+                        "existing dispatch fetches, zero extra host "
+                        "transfers), Chrome-trace phase spans "
+                        "(trace.json opens in Perfetto), and a "
+                        "telemetry.jsonl stream of per-window "
+                        "p50/p95/p99 latency + rates + checker lag "
+                        "(tail it with `maelstrom_tpu top`). DIR names "
+                        "the output directory; bare --telemetry lands "
+                        "it in the store dir; 'off' (the default) "
+                        "disables. Histories are byte-identical "
+                        "telemetry on or off")
     t.add_argument("--on-preempt", choices=["checkpoint", "abort"],
                    default="checkpoint",
                    help="What SIGTERM/SIGINT does to a TPU-path run: "
@@ -240,6 +257,19 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("serve", help="Serve the store directory")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--store", default="store")
+
+    tp = sub.add_parser(
+        "top", help="Live tail of a run's telemetry stream: freshest "
+                    "window per cluster — round, ops, delivered rate, "
+                    "p50/p95/p99 latency, checker lag "
+                    "(doc/observability.md)")
+    tp.add_argument("path", nargs="?", default="store/latest",
+                    help="telemetry dir, telemetry.jsonl file, or a "
+                         "store test dir (default: store/latest)")
+    tp.add_argument("--follow", action="store_true",
+                    help="re-render every --interval seconds until "
+                         "interrupted")
+    tp.add_argument("--interval", type=float, default=1.0)
 
     d = sub.add_parser("demo", help="Run the bundled demo suite")
     d.add_argument("--store", default="store")
@@ -356,6 +386,14 @@ def opts_from_args(args) -> dict:
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v
+    # flight recorder: "off" is the explicit disable spelling
+    if args.telemetry and args.telemetry != "off":
+        opts["telemetry"] = args.telemetry
+    if opts.get("telemetry") and not (
+            args.node and str(args.node).startswith("tpu:")):
+        raise SystemExit("--telemetry needs the TPU path (--node "
+                         "tpu:<program>): the metric rings live in the "
+                         "compiled scan carry")
     if (args.checkpoint_every or args.resume) and not (
             args.node and str(args.node).startswith("tpu:")):
         raise SystemExit("--checkpoint-every/--resume need the TPU path "
@@ -456,6 +494,11 @@ def main(argv=None) -> int:
         from .serve import serve
         serve(args.store, args.port)
         return 0
+
+    if args.cmd == "top":
+        from .telemetry import top_main
+        return top_main(args.path, follow=args.follow,
+                        interval=args.interval)
 
     if args.cmd == "demo":
         from . import core

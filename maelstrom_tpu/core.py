@@ -98,6 +98,13 @@ DEFAULTS = dict(
     # up to batch_max fresh values per batch, a batch_dup_rate fraction
     # of duplicate re-submissions collapsed by distillation
     batch_max=16, batch_dup_rate=0.25,
+    # flight recorder (doc/observability.md): --telemetry DIR turns on
+    # the device metric rings (an int32 block in the compiled scan
+    # carry, drained on the existing dispatch fetches), Chrome-trace
+    # phase spans (trace.json), and the telemetry.jsonl window stream.
+    # None/off = fully compiled out; histories are byte-identical
+    # either way.
+    telemetry=None,
     # role-partitioned clusters (doc/compartment.md): `roles` sizes the
     # compartmentalized consensus tiers (--node tpu:compartment;
     # "proxies=P,acceptors=RxC,replicas=R"), `service_roles` the

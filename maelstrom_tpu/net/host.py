@@ -110,6 +110,18 @@ class HostNet:
         self.sent_units = 0
         self.recv_units = 0
         self.batched_msgs = 0   # messages that declared batch_units > 1
+        # flight-recorder counter parity (doc/observability.md): the
+        # host net books the same counter classes the TPU path's device
+        # MetricRing accumulates — sends attempted, deliveries, drops
+        # (loss + partition), at-least-once duplicates — so both
+        # network paths expose one telemetry vocabulary
+        # (`telemetry_counters()`, surfaced by NetStatsChecker when
+        # --telemetry is on; keys match telemetry.ring_dict)
+        self.sent_count = 0
+        self.recv_count = 0
+        self.lost_count = 0
+        self.dropped_partition = 0
+        self.dup_count = 0
         self.partitions: dict[str, set[str]] = {}   # dest -> blocked srcs
         self.queues: dict[str, _NodeQueue] = {}
         self.next_client_id = itertools.count(0)
@@ -205,12 +217,14 @@ class HostNet:
             self.journal.log_send(msg, self.time_ns())
         u = self._units(msg)
         self.sent_units += u
+        self.sent_count += 1
         if u > 1:
             self.batched_msgs += 1
         if self.log_send:
             log.info("send %r", msg)
 
         if self.rng.random() < self.p_loss:
+            self.lost_count += 1
             return msg      # whoops, lost ur packet (net.clj:213-214)
         dest_q.put(deadline_ns, msg)
         if (self.p_dup > 0 and not involves_client(msg)
@@ -221,6 +235,7 @@ class HostNet:
             dup_deadline = self.time_ns() + int(
                 self.latency_for_ms(msg) * 1e6)
             dest_q.put(dup_deadline, msg)
+            self.dup_count += 1
         return msg
 
     def recv(self, node: str, timeout_ms: float) -> Optional[Message]:
@@ -234,6 +249,7 @@ class HostNet:
         deadline_ns, _, msg = entry
         blocked = self.partitions.get(node, ())
         if msg.src in blocked:
+            self.dropped_partition += 1
             return None     # consumed and dropped, like the reference
         dt_ns = deadline_ns - self.time_ns()
         if dt_ns > 0:
@@ -243,4 +259,14 @@ class HostNet:
         if self.journal is not None:
             self.journal.log_recv(msg, self.time_ns())
         self.recv_units += self._units(msg)
+        self.recv_count += 1
         return msg
+
+    def telemetry_counters(self) -> dict:
+        """The host half of the flight-recorder counter vocabulary:
+        keyed exactly like the device ring's `telemetry.ring_dict`
+        message-flow block, so a result (or parity test) reads the same
+        whichever network ran the test."""
+        return {"sent": self.sent_count, "delivered": self.recv_count,
+                "dropped": self.lost_count + self.dropped_partition,
+                "duplicated": self.dup_count}

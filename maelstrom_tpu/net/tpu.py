@@ -171,6 +171,17 @@ class NetConfig:
     # rides payload word `word` (0 = a, 1 = b, 2 = c). Every other
     # message counts 1 unit. Empty = units booking compiles out.
     unit_words: tuple = ()
+    # flight-recorder metric rings (doc/observability.md): when True the
+    # round body folds per-round telemetry — message-flow deltas,
+    # occupancy histograms, per-role send counts, reply-latency buckets
+    # — into the SimState.telemetry int32 carry block
+    # (telemetry.MetricRing), drained only on the existing
+    # dispatch-boundary fetches. Off = the block compiles out entirely;
+    # histories are byte-identical either way. `telemetry_roles` is the
+    # static ((lo, hi), ...) node-id slicing role_sent buckets by
+    # (telemetry.role_bounds).
+    telemetry: bool = False
+    telemetry_roles: tuple = ()
 
     @property
     def n_total(self) -> int:

@@ -215,6 +215,23 @@ class FleetRunner:
 
         from ..checkers.netstats import TransferStats
         self.transfer = TransferStats()
+        # flight recorder (doc/observability.md): ONE TelemetrySession
+        # for the whole fleet — shells share it (their per-wave records
+        # carry the cluster index), the fleet driver lands its own
+        # dispatch/fetch spans on the "fleet" trace row, and close()
+        # renders the per-cluster heatmap. Ring state is per cluster (a
+        # leading fleet axis on the MetricRing, like the rest of the
+        # carry).
+        from .. import telemetry as TM
+        self.telemetry_rings = s0.telemetry_rings
+        self.session = None
+        if self.telemetry_rings:
+            self.session = TM.TelemetrySession(
+                TM.resolve_dir(test.get("telemetry"),
+                               test.get("store_dir") or "."),
+                ms_per_round=s0.ms_per_round, fleet=F)
+            for sh in self.shells:
+                sh.telemetry = self.session
         # open-world fleets (doc/streams.md x doc/perf.md): continuous
         # shells run `_loop_steps_continuous` and yield cscan requests;
         # the fleet answers them with the vmapped sched-inject scan
@@ -247,6 +264,23 @@ class FleetRunner:
         self.final_rounds = [0] * F
 
     # --- device plumbing -------------------------------------------------
+
+    def _tel_span(self, name, t0, t1, args=None):
+        """Fleet-level phase span (no-op without a session): lands on
+        the trace's "fleet" thread row, distinct from the per-cluster
+        shell rows."""
+        if self.session is not None:
+            self.session.span(name, t0, t1, tid="fleet", args=args)
+
+    def _drain_rings(self, ring_h, reqs):
+        """Hands each serviced shell its row of the drained [F, ...]
+        metric ring (the shells' `_tel_wave` reads it on their next
+        loop iteration)."""
+        if not self.telemetry_rings:
+            return
+        for i in reqs:
+            self.shells[i]._ring_host = jax.tree.map(
+                lambda a, i=i: a[i], ring_h)
 
     def _pins(self, n_args: int) -> dict:
         if self._shardings is None:
@@ -394,21 +428,32 @@ class FleetRunner:
                 self.program, self.cfg, reply_cap=self.reply_log_cap,
                 donate=True, shardings=self._shardings)
         self.transfer.host_poll_s += time.perf_counter() - t0
+        t_d0 = time.perf_counter()
         self.sim, _cm, k, rl = self._scan_fn(
             self.sim, inject, jnp.asarray(kmax), jnp.asarray(stop),
             jnp.asarray(active))
+        self._tel_span("dispatch", t_d0, time.perf_counter(),
+                       args={"clusters": len(reqs)})
         self._invalidate()
         # the batched stretch is in flight: overlap each cluster's
         # host-side analysis of its last segment with the device time
         for i, req in sorted(reqs.items()):
             self.shells[i]._overlap_feed(req[3])
+        # the fleet metric ring rides the SAME packed fetch ([F, ...]
+        # rows; an empty tuple when rings are off)
+        ring = self.sim.telemetry if self.telemetry_rings else ()
+        tree = (rl, k, self.sim.net.next_mid, ring)
         if self._pack is None:
-            self._pack = TpuRunner._make_packer(
-                (rl, k, self.sim.net.next_mid))
+            self._pack = TpuRunner._make_packer(tree)
         pack, unpack = self._pack
         # ONE fetched array for the whole fleet per wave
-        flat = self.transfer.fetch(pack((rl, k, self.sim.net.next_mid)))
-        (rlog, rounds, plog, rn), k, next_mid = unpack(flat)
+        t_f0 = time.perf_counter()
+        flat = self.transfer.fetch(pack(tree))
+        self._tel_span("device-get", t_f0, time.perf_counter(),
+                       args={"drains": self.transfer.drains,
+                             "host-bytes": self.transfer.host_bytes})
+        (rlog, rounds, plog, rn), k, next_mid, ring_h = unpack(flat)
+        self._drain_rings(ring_h, reqs)
         W = int(getattr(self.program, "reply_payload_words", 0) or 0)
         out = {}
         for i in sorted(reqs):
@@ -458,9 +503,12 @@ class FleetRunner:
                 donate=True, shardings=self._shardings,
                 sched_inject=True)
         self.transfer.host_poll_s += time.perf_counter() - t0
+        t_d0 = time.perf_counter()
         self.sim, _cm, k, rl, im = self._cscan_fn(
             self.sim, inject, jnp.asarray(at), jnp.asarray(kmax),
             jnp.asarray(stop), jnp.asarray(active))
+        self._tel_span("dispatch", t_d0, time.perf_counter(),
+                       args={"clusters": len(reqs)})
         self._invalidate()
         # the batched window is in flight: overlap each cluster's
         # analysis of its last drained segment with the device time
@@ -468,15 +516,20 @@ class FleetRunner:
         # stays a per-cluster metric while the fleet streams)
         for i, req in sorted(reqs.items()):
             self.shells[i]._overlap_feed(req[3])
+        ring = self.sim.telemetry if self.telemetry_rings else ()
+        tree = (rl, im, k, self.sim.net.next_mid, ring)
         if self._pack_c is None:
-            self._pack_c = TpuRunner._make_packer(
-                (rl, im, k, self.sim.net.next_mid))
+            self._pack_c = TpuRunner._make_packer(tree)
         pack, unpack = self._pack_c
         # ONE fetched array for the whole fleet per wave: replies,
         # confirmed inj_mids, per-lane k, and the mid counters together
-        flat = self.transfer.fetch(
-            pack((rl, im, k, self.sim.net.next_mid)))
-        (rlog, rounds, plog, rn), im, k, next_mid = unpack(flat)
+        t_f0 = time.perf_counter()
+        flat = self.transfer.fetch(pack(tree))
+        self._tel_span("device-get", t_f0, time.perf_counter(),
+                       args={"drains": self.transfer.drains,
+                             "host-bytes": self.transfer.host_bytes})
+        (rlog, rounds, plog, rn), im, k, next_mid, ring_h = unpack(flat)
+        self._drain_rings(ring_h, reqs)
         W = int(getattr(self.program, "reply_payload_words", 0) or 0)
         out = {}
         for i in sorted(reqs):
@@ -777,8 +830,11 @@ class FleetRunner:
                     ready += [(i, bool(qs[i]))
                               for i in sorted(quiet_wait)]
             if scan_reqs or cscan_reqs:
-                self.transfer.record_poll(
-                    time.perf_counter() - _poll_t0)
+                _poll_t1 = time.perf_counter()
+                self.transfer.record_poll(_poll_t1 - _poll_t0)
+                self._tel_span("schedule-encode", _poll_t0, _poll_t1,
+                               args={"clusters": len(scan_reqs)
+                                     + len(cscan_reqs)})
             if scan_reqs:
                 results = self._exec_fleet_scan(scan_reqs)
                 ready += [(i, results[i]) for i in sorted(scan_reqs)]
@@ -835,37 +891,57 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
         resume = cp.load(test["resume"])
         cp.check_fingerprint(resume, test)
 
-    histories = runner.run(resume=resume)
+    try:
+        histories = runner.run(resume=resume)
+    except BaseException:
+        # a flight recorder must land its trace ESPECIALLY when the
+        # fleet died unexpectedly (and on graceful preemption)
+        if runner.session is not None:
+            runner.session.close()
+        raise
 
     F = runner.spec.fleet
     cluster_results = []
     all_valid = True
-    for i, sh in enumerate(runner.shells):
-        # give the shell its row back: the per-cluster checkers (device
-        # counters, invalid-state counters) read runner.sim
-        sh.sim = jax.tree.map(lambda a, i=i: a[i], runner.sim)
-        t_i = sh.test
-        cdir = os.path.join(test_dir, f"cluster-{i:04d}")
-        os.makedirs(cdir, exist_ok=True)
-        t_i["store_dir"] = cdir
-        t_i["checker"].checkers["net"] = TpuNetStats(sh)
-        if sh.pipeline is not None:
-            t_i["analysis"] = sh.pipeline
-        res_i = t_i["checker"].check(t_i, histories[i], {})
-        if sh.pipeline is not None:
-            # per-cluster rows only: each pipeline saw exactly its own
-            # cluster's history (no fleet-level double counting)
-            res_i["analysis-pipeline"] = sh.pipeline.report()
-        res_i["cluster"] = i
-        res_i["seed"] = t_i.get("seed")
-        if runner.spec.sweep == "nemesis":
-            res_i["nemesis-seed"] = t_i.get("nemesis_seed")
-        if runner.spec.sweep == "capacity":
-            res_i["rate"] = t_i.get("rate")
-        store.write_history(cdir, histories[i])
-        store.write_results(cdir, res_i)
-        all_valid = all_valid and bool(res_i.get("valid"))
-        cluster_results.append(res_i)
+    try:
+        for i, sh in enumerate(runner.shells):
+            # give the shell its row back: the per-cluster checkers (device
+            # counters, invalid-state counters) read runner.sim
+            sh.sim = jax.tree.map(lambda a, i=i: a[i], runner.sim)
+            t_i = sh.test
+            cdir = os.path.join(test_dir, f"cluster-{i:04d}")
+            os.makedirs(cdir, exist_ok=True)
+            t_i["store_dir"] = cdir
+            t_i["checker"].checkers["net"] = TpuNetStats(sh)
+            if sh.pipeline is not None:
+                t_i["analysis"] = sh.pipeline
+            if runner.session is not None:
+                # per-cluster final record: cumulative quantiles over the
+                # whole cluster history (== the cluster's PerfChecker block)
+                runner.session.flush(
+                    histories[i], runner.final_rounds[i], cluster=i,
+                    ring=(sh._ring_dict() if sh._final_ring() is not None
+                          else None),
+                    pipeline=sh.pipeline)
+            res_i = t_i["checker"].check(t_i, histories[i], {})
+            if sh.pipeline is not None:
+                # per-cluster rows only: each pipeline saw exactly its own
+                # cluster's history (no fleet-level double counting)
+                res_i["analysis-pipeline"] = sh.pipeline.report()
+            res_i["cluster"] = i
+            res_i["seed"] = t_i.get("seed")
+            if runner.spec.sweep == "nemesis":
+                res_i["nemesis-seed"] = t_i.get("nemesis_seed")
+            if runner.spec.sweep == "capacity":
+                res_i["rate"] = t_i.get("rate")
+            store.write_history(cdir, histories[i])
+            store.write_results(cdir, res_i)
+            all_valid = all_valid and bool(res_i.get("valid"))
+            cluster_results.append(res_i)
+    finally:
+        # land the trace even when a per-cluster checker raises
+        if runner.session is not None:
+            runner.session.close()
 
     results = {
         "fleet": F,

@@ -292,6 +292,19 @@ class TpuNetStats(Checker):
             n_bad = int(np.sum(jax.device_get(arr)))
             out[name] = n_bad
             ok = ok and n_bad == 0
+        # flight-recorder ring (doc/observability.md): the drained
+        # device telemetry block, next to the raw counters it refines.
+        # Off by default — classic results keep their exact shape.
+        if getattr(self.runner, "telemetry_rings", False):
+            try:
+                from .. import telemetry as TM
+                ring = self.runner._final_ring()
+                if ring is not None:
+                    out["telemetry"] = TM.ring_dict(
+                        ring,
+                        role_labels=TM.role_names(self.runner.program))
+            except Exception as e:  # observational: never fail the run
+                out["telemetry-error"] = repr(e)
         # host-transfer accounting: drains must stay O(host-relevant
         # rounds) — one batched fetch per dispatch — not O(simulated
         # rounds); a regression here is a performance bug even when the
@@ -343,6 +356,15 @@ class TpuRunner:
         # fault package pay nothing for its round-path machinery
         faults = self._fault_set(test)
         self.faults = faults
+        # flight recorder (doc/observability.md): --telemetry DIR turns
+        # on the device metric rings (a static cfg capability — off
+        # costs nothing) and, for top-level runs, a TelemetrySession
+        # (spans + telemetry.jsonl), attached by run_tpu_test /
+        # FleetRunner AFTER construction. Rings never change histories.
+        from .. import telemetry as TM
+        self.telemetry_rings = TM.enabled(test)
+        self.telemetry = None
+        self._ring_host = None
         self.cfg = T.NetConfig(
             n_nodes=n, n_clients=self.concurrency, pool_cap=pool_cap,
             inbox_cap=self.program.inbox_cap,
@@ -358,7 +380,10 @@ class TpuRunner:
             # (type, count-word) mapping; the net books units next to
             # raw message counts
             unit_words=tuple(getattr(self.program, "unit_words", ())
-                             or ()))
+                             or ()),
+            telemetry=self.telemetry_rings,
+            telemetry_roles=(TM.role_bounds(self.program)
+                             if self.telemetry_rings else ()))
         # continuous generator mode (doc/streams.md): client ops are
         # pre-scheduled onto their offered-rate rounds and injected
         # INSIDE the compiled scan window (the open-world stream), so
@@ -550,6 +575,52 @@ class TpuRunner:
         (refreshed by every dispatch's combined fetch). The fleet shell
         overrides this to read its row of the batched counter."""
         self._next_mid = int(self.transfer.fetch(self.sim.net.next_mid))
+
+    # --- flight recorder (doc/observability.md) ---
+
+    def _tel_span(self, name, t0, t1, args=None):
+        """Records one phase span when a telemetry session is attached
+        (spans are Chrome trace events; see telemetry.py). Fleet shells
+        land on their own trace thread row via the cluster index."""
+        if self.telemetry is not None:
+            tid = f"c{self.idx}" if hasattr(self, "idx") else "runner"
+            self.telemetry.span(name, t0, t1, tid=tid, args=args)
+
+    def _ring_dict(self):
+        """The last drained metric ring as a plain dict (None before
+        the first drain or with rings off)."""
+        if self._ring_host is None:
+            return None
+        from .. import telemetry as TM
+        return TM.ring_dict(self._ring_host,
+                            role_labels=TM.role_names(self.program))
+
+    def _final_ring(self):
+        """The ring's end-of-run value, fetched once (a post-run drain
+        — never on the dispatch hot path). Used by the results block
+        and the session's final record."""
+        if not self.telemetry_rings:
+            return None
+        if self.sim is not None and self.sim.telemetry is not None:
+            self._ring_host = self.transfer.fetch(self.sim.telemetry)
+        return self._ring_host
+
+    def _tel_wave(self, history, r):
+        """One per-wave telemetry.jsonl record (no-op without a
+        session): windowed/cumulative latency quantiles from the rows
+        this wave exposed, ring deltas, checker lag. Fleet shells
+        report the FLEET's transfer ledger — all device fetches run
+        through the fleet driver, so the shell's own TransferStats
+        never books a drain."""
+        if self.telemetry is not None:
+            fleet = getattr(self, "fleet", None)
+            self.telemetry.wave(history, r,
+                                cluster=getattr(self, "idx", None),
+                                ring=self._ring_dict(),
+                                pipeline=self.pipeline,
+                                transfer=(fleet.transfer if fleet
+                                          is not None else
+                                          self.transfer))
 
     # --- helpers ---
 
@@ -770,6 +841,8 @@ class TpuRunner:
             self.transfer.ckpt_blocked_s += _time.perf_counter() - t0
             log.info("checkpoint snapshot at round %d -> background "
                      "writer (%s)", r, store_dir)
+        self._tel_span("checkpoint-snapshot", t0, _time.perf_counter(),
+                       args={"round": r})
 
     def _finish_checkpoints(self):
         """Joins the background writer (if any) and books its wall time
@@ -877,7 +950,10 @@ class TpuRunner:
                 head_round=lambda: getattr(self, "_r_live", 0),
                 # fleet shells stamp their cluster index on window
                 # records/reports (None for a standalone runner)
-                label=getattr(self, "idx", None))
+                label=getattr(self, "idx", None),
+                # flight recorder: per-segment grading spans land on
+                # the trace's "analysis" thread row
+                tracer=self.telemetry)
         self._fed_upto = 0
         if resume is not None and self.pipeline is not None and \
                 len(history) > 0:
@@ -1110,7 +1186,9 @@ class TpuRunner:
                        "free": self._free_rotated(free, history),
                        "processes": processes}
 
-            self.transfer.record_poll(time.perf_counter() - _poll_t0)
+            _poll_t1 = time.perf_counter()
+            self.transfer.record_poll(_poll_t1 - _poll_t0)
+            self._tel_span("schedule-encode", _poll_t0, _poll_t1)
 
             if exhausted and not pending and free == set(processes):
                 break
@@ -1169,6 +1247,10 @@ class TpuRunner:
                 completed = {**op, "type": "info", "error": "net-timeout"}
                 gen = self._complete(history, gen, ctx, process, completed,
                                      free)
+
+            # flight recorder: one telemetry.jsonl record per wave, AFTER
+            # this wave's replies/timeouts folded into the history
+            self._tel_wave(history, r)
 
             if next_ckpt is not None and r >= next_ckpt:
                 self._save_checkpoint(gen, history, pending, free, r)
@@ -1376,7 +1458,9 @@ class TpuRunner:
             exhausted = end_kind == "exhausted"
             # stable by round: carried rows precede same-round new ones
             carry_sched.sort(key=lambda rw: rw[0])
-            self.transfer.record_poll(time.perf_counter() - _poll_t0)
+            _poll_t1 = time.perf_counter()
+            self.transfer.record_poll(_poll_t1 - _poll_t0)
+            self._tel_span("schedule-encode", _poll_t0, _poll_t1)
             self._carry_live = {"sched": carry_sched, "nem": carry_nem,
                                 "host": carry_host}
 
@@ -1462,6 +1546,9 @@ class TpuRunner:
                 gen = self._complete(history, gen, ctx, process,
                                      completed, free)
 
+            # flight recorder: one record per window, replies folded
+            self._tel_wave(history, r)
+
             if next_ckpt is not None and r >= next_ckpt:
                 self._carry_live = {"sched": carry_sched,
                                     "nem": carry_nem,
@@ -1516,23 +1603,34 @@ class TpuRunner:
                     program, cfg, journal_cap=self.journal_scan_cap,
                     reply_cap=self.reply_log_cap, donate=True,
                     shardings=self._shardings)
+            t_d0 = time.perf_counter()
             self.sim, _cm, k, rl, buf = self._scan_journal_fn(
                 self.sim, inject, jnp.int32(k_max), stop)
+            self._tel_span("dispatch", t_d0, time.perf_counter())
             self._state_cache = None
             # stretch N+1 is in flight: overlap the host-side
             # analysis of segment N with its device time
             self._overlap_feed(history)
+            # the metric ring rides the SAME packed fetch (zero new
+            # host transfers; an empty tuple when rings are off)
+            ring = self.sim.telemetry if self.telemetry_rings else ()
+            tree = (buf, rl, k, self.sim.net.next_mid, ring)
             if self._pack_buf is None:
-                self._pack_buf = self._make_packer(
-                    (buf, rl, k, self.sim.net.next_mid))
+                self._pack_buf = self._make_packer(tree)
             pack, unpack = self._pack_buf
             # ONE fetched array per dispatch: k and next_mid ride the
             # packed buffer (every separately fetched array is its own
             # round trip on remote backends)
-            packed = pack((buf, rl, k, self.sim.net.next_mid))
+            packed = pack(tree)
+            t_f0 = time.perf_counter()
             flat = self.transfer.fetch(packed)
-            buf, (rlog, rounds, plog, rn), k, self._next_mid = \
+            self._tel_span("device-get", t_f0, time.perf_counter(),
+                           args={"drains": self.transfer.drains,
+                                 "host-bytes": self.transfer.host_bytes})
+            buf, (rlog, rounds, plog, rn), k, self._next_mid, ring_h = \
                 unpack(flat)
+            if self.telemetry_rings:
+                self._ring_host = ring_h
             k, self._next_mid = int(k), int(self._next_mid)
             quiet_cm = jax.tree.map(
                 lambda a: np.zeros_like(a[:max(C, 1)]), rlog)
@@ -1555,20 +1653,30 @@ class TpuRunner:
                 self._scan_fn = make_scan_fn(
                     program, cfg, reply_cap=self.reply_log_cap,
                     donate=True, shardings=self._shardings)
+            t_d0 = time.perf_counter()
             self.sim, _cm, k, rl = self._scan_fn(
                 self.sim, inject, jnp.int32(k_max), stop)
+            self._tel_span("dispatch", t_d0, time.perf_counter())
             self._state_cache = None
             # stretch N+1 is in flight: overlap the host-side
             # analysis of segment N with its device time
             self._overlap_feed(history)
+            ring = self.sim.telemetry if self.telemetry_rings else ()
+            tree = (rl, k, self.sim.net.next_mid, ring)
             if self._pack_replies is None:
-                self._pack_replies = self._make_packer(
-                    (rl, k, self.sim.net.next_mid))
+                self._pack_replies = self._make_packer(tree)
             pack, unpack = self._pack_replies
             # ONE fetched array per dispatch (see journal branch)
-            packed = pack((rl, k, self.sim.net.next_mid))
+            packed = pack(tree)
+            t_f0 = time.perf_counter()
             flat = self.transfer.fetch(packed)
-            (rlog, rounds, plog, rn), k, self._next_mid = unpack(flat)
+            self._tel_span("device-get", t_f0, time.perf_counter(),
+                           args={"drains": self.transfer.drains,
+                                 "host-bytes": self.transfer.host_bytes})
+            (rlog, rounds, plog, rn), k, self._next_mid, ring_h = \
+                unpack(flat)
+            if self.telemetry_rings:
+                self._ring_host = ring_h
             k, self._next_mid = int(k), int(self._next_mid)
             rn = int(rn)
         return k, self._decode_replies(rlog, rounds, plog, rn)
@@ -1602,18 +1710,28 @@ class TpuRunner:
             self._cscan_fn = make_scan_fn(
                 program, cfg, reply_cap=self.reply_log_cap, donate=True,
                 shardings=self._shardings, sched_inject=True)
+        t_d0 = time.perf_counter()
         self.sim, _cm, k, rl, im = self._cscan_fn(
             self.sim, inject, jnp.asarray(at), jnp.int32(k_max), stop)
+        self._tel_span("dispatch", t_d0, time.perf_counter())
         self._state_cache = None
         # window N+1 is in flight: overlap segment N's analysis
         self._overlap_feed(history)
+        ring = self.sim.telemetry if self.telemetry_rings else ()
+        tree = (rl, im, k, self.sim.net.next_mid, ring)
         if self._pack_creplies is None:
-            self._pack_creplies = self._make_packer(
-                (rl, im, k, self.sim.net.next_mid))
+            self._pack_creplies = self._make_packer(tree)
         pack, unpack = self._pack_creplies
-        packed = pack((rl, im, k, self.sim.net.next_mid))
+        packed = pack(tree)
+        t_f0 = time.perf_counter()
         flat = self.transfer.fetch(packed)
-        (rlog, rounds, plog, rn), im, k, self._next_mid = unpack(flat)
+        self._tel_span("device-get", t_f0, time.perf_counter(),
+                       args={"drains": self.transfer.drains,
+                             "host-bytes": self.transfer.host_bytes})
+        (rlog, rounds, plog, rn), im, k, self._next_mid, ring_h = \
+            unpack(flat)
+        if self.telemetry_rings:
+            self._ring_host = ring_h
         k, self._next_mid = int(k), int(self._next_mid)
         return (k, self._decode_replies(rlog, rounds, plog, int(rn)),
                 im)
@@ -1735,6 +1853,14 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         return run_fleet_test(test, test_dir)
     runner = TpuRunner(test)
     test["store_dir"] = test_dir
+    # flight recorder (doc/observability.md): --telemetry DIR attaches
+    # the session AFTER construction (fleet shells share their fleet's
+    # session instead; rings themselves are a cfg capability)
+    if runner.telemetry_rings:
+        from .. import telemetry as TM
+        runner.telemetry = TM.TelemetrySession(
+            TM.resolve_dir(test.get("telemetry"), test_dir),
+            ms_per_round=runner.ms_per_round)
     # swap the host-net stats checker for the device-counter one
     test["checker"].checkers["net"] = TpuNetStats(runner)
     test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
@@ -1747,23 +1873,41 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         cp.check_fingerprint(resume, test)
 
     try:
-        history = runner.run(resume=resume)
-    except cp.Preempted:
-        # graceful preemption: the final checkpoint is on disk; flush
-        # the journal and let the CLI exit EXIT_PREEMPTED (the store dir
-        # keeps its in-progress shape — no results, not marked complete)
-        if runner.journal is not None:
-            runner.journal.close()
-        raise
-    if runner.pipeline is not None:
-        # checkers consume the incrementally-built partitions (register
-        # fast path); verdicts stay bit-identical to the sequential path
-        test["analysis"] = runner.pipeline
-    # the device-resident checker (doc/perf.md "device-resident
-    # grading") books its edge-build/screen wall time into the run's
-    # TransferStats so results show that work leaving host-blocked time
-    test["transfer"] = runner.transfer
-    results = test["checker"].check(test, history, {})
+        try:
+            history = runner.run(resume=resume)
+        except cp.Preempted:
+            # graceful preemption: the final checkpoint is on disk;
+            # flush the journal and let the CLI exit EXIT_PREEMPTED
+            # (the store dir keeps its in-progress shape — no results,
+            # not marked complete)
+            if runner.journal is not None:
+                runner.journal.close()
+            raise
+        if runner.telemetry is not None:
+            # final record: cumulative quantiles over the WHOLE history
+            # — the value the acceptance test pins against PerfChecker
+            runner.telemetry.flush(history, runner.final_round,
+                                   ring=runner._ring_dict()
+                                   if runner._final_ring() is not None
+                                   else None,
+                                   pipeline=runner.pipeline)
+        if runner.pipeline is not None:
+            # checkers consume the incrementally-built partitions
+            # (register fast path); verdicts stay bit-identical to the
+            # sequential path
+            test["analysis"] = runner.pipeline
+        # the device-resident checker (doc/perf.md "device-resident
+        # grading") books its edge-build/screen wall time into the
+        # run's TransferStats so results show that work leaving
+        # host-blocked time
+        test["transfer"] = runner.transfer
+        results = test["checker"].check(test, history, {})
+    finally:
+        # a flight recorder must land its trace ESPECIALLY when the run
+        # died unexpectedly: close() is idempotent and writes
+        # trace.json from whatever spans were recorded
+        if runner.telemetry is not None:
+            runner.telemetry.close()
     net_block = results.get("net")
     if isinstance(net_block, dict) and "drains" in net_block:
         # the net block renders before the workload checker runs:

@@ -39,6 +39,15 @@ class SimState:
     # exactly this (`NodeProgram.restore`); None for fully-persistent
     # programs, whose restart keeps the whole state.
     durable: object = None
+    # Flight-recorder metric ring (doc/observability.md): a small int32
+    # telemetry carry block (`telemetry.MetricRing`) folded per round
+    # when cfg.telemetry is on, drained only at dispatch boundaries.
+    # None when telemetry is off — the field (and its round cost)
+    # compiles out. Purely observational: the ring never touches the
+    # PRNG stream or message contents, so telemetry-on/off runs are
+    # byte-identical per seed. Rides checkpoints like the rest of the
+    # carry.
+    telemetry: object = None
 
 
 def dealias(tree):
@@ -60,9 +69,13 @@ def make_sim(program, cfg: NetConfig, seed: int = 0,
                                      track_send_round=track_edge_send_round)
                 if getattr(program, "is_edge", False) else None)
     nodes = program.init_state()
+    tel = None
+    if cfg.telemetry:
+        from . import telemetry as TM
+        tel = TM.make_ring(cfg)
     return SimState(net=T.make_net(cfg), nodes=nodes,
                     key=jax.random.PRNGKey(seed), channels=channels,
-                    durable=program.durable_view(nodes))
+                    durable=program.durable_view(nodes), telemetry=tel)
 
 
 def _freeze(stall, old, new):
@@ -375,9 +388,19 @@ def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     flat = flat.replace(src=jnp.repeat(jnp.arange(N, dtype=I32), O))
     net, outbox_sent = T._send(cfg, net, flat, k3)
     net = T.advance(net)
+    tel = sim.telemetry
+    if cfg.telemetry and tel is not None:
+        # flight-recorder fold (doc/observability.md): pure int32
+        # bookkeeping AFTER all PRNG consumption — the ring can never
+        # perturb the simulation (telemetry-on/off byte-identity)
+        from . import telemetry as TM
+        node_sent = jnp.sum(flat.valid.reshape(N, O).astype(I32), axis=1)
+        tel = TM.ring_update(cfg, tel, sim.net.stats, net, None,
+                             sim.net.round, node_sent, inject_sent,
+                             client_msgs)
     return (SimState(net=net, nodes=nodes, key=key,
-                     durable=program.durable_view(nodes)), client_msgs,
-            (inject_sent, outbox_sent, inbox))
+                     durable=program.durable_view(nodes), telemetry=tel),
+            client_msgs, (inject_sent, outbox_sent, inbox))
 
 
 def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
@@ -556,8 +579,21 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
                                      edge_out.valid))
     net = net.replace(stats=st)
     net = T.advance(net)
+    tel = sim.telemetry
+    if cfg.telemetry and tel is not None:
+        # flight-recorder fold: node sends = edge traffic + the
+        # compacted client replies; `flat` (every valid reply row, not
+        # the CC-capped compaction) feeds the latency buckets
+        from . import telemetry as TM
+        node_sent = (jnp.sum(edge_out.valid.reshape(N, -1).astype(I32),
+                             axis=1)
+                     + jnp.sum(flat.valid.reshape(N, K).astype(I32),
+                               axis=1))
+        tel = TM.ring_update(cfg, tel, sim.net.stats, net, ch,
+                             sim.net.round, node_sent, inject_sent,
+                             flat)
     return (SimState(net=net, nodes=nodes, key=key, channels=ch,
-                     durable=program.durable_view(nodes)),
+                     durable=program.durable_view(nodes), telemetry=tel),
             client_msgs,
             (inject_sent, outbox_sent, client_inbox, edge_out, edge_in))
 

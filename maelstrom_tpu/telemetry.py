@@ -1,0 +1,689 @@
+"""The flight recorder: device-resident metric rings, host phase-span
+tracing, and streaming run telemetry (doc/observability.md).
+
+The reference Maelstrom's whole value is that a run *explains itself* —
+stats, Lamport diagrams, journals. This module makes the reproduction
+explain itself **while it runs**, in three layers:
+
+  1. **Device metric rings** (`MetricRing`): a small int32 carry block
+     accumulated INSIDE the compiled round — per-round message-flow
+     counters (sent/delivered/dropped/duplicated), flight-pool and
+     edge-channel occupancy histograms, per-role send counts under
+     `sim.RolePartition`, and client-op latency-in-rounds buckets. The
+     block rides `SimState.telemetry` through the scan carry and is
+     drained only on the EXISTING dispatch-boundary packed fetches —
+     zero new host transfers, zero history impact (counters never touch
+     the PRNG stream or any message content, so telemetry-on and
+     telemetry-off runs are byte-identical per seed).
+
+  2. **Host phase spans** (`TelemetrySession.span`): the runner's wave
+     loop phases — schedule/encode, dispatch, device_get, pipeline
+     grading, checkpoint snapshots — recorded as Chrome trace events
+     ("X" complete events, microsecond timestamps), written to
+     `trace.json` so a whole run opens in Perfetto / chrome://tracing.
+     TransferStats counters ride the spans as args.
+
+  3. **Streaming export** (`TelemetrySession.wave`): one
+     `telemetry.jsonl` record per window/wave — windowed AND cumulative
+     p50/p95/p99 op latency via an exact counting sketch (`Sketch`),
+     offered vs delivered rates, checker lag, ring deltas, per-cluster
+     under `--fleet` — plus `render_top` (the `maelstrom_tpu top` tail
+     view) and the fleet heatmap (`viz/fleet.py`).
+
+Quantiles are EXACT, not approximate: virtual time makes op latencies a
+small discrete domain, so the "sketch" is a counting histogram keyed by
+latency value, and its quantile rule replicates
+`checkers.perf.latency_stats` index-for-index — the final cumulative
+record matches the post-hoc PerfChecker bit-for-bit (pinned by
+tests/test_telemetry.py).
+
+Everything here is observational: no telemetry code path may influence
+scheduling, PRNG draws, or history contents.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+I32 = jnp.int32
+
+# Bucket shapes are static (they size the carry block): occupancy is
+# bucketed by fraction-of-capacity eighths, latency by powers of two in
+# rounds (bucket b covers (2^(b-1), 2^b] rounds; bucket 0 is <= 1).
+OCC_BUCKETS = 8
+LAT_BUCKETS = 16
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: the device-resident metric ring
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class MetricRing:
+    """The int32 telemetry carry block (`SimState.telemetry`). All
+    fields are cumulative over the rounds executed since the run (or
+    resume) started; the host computes per-window deltas at each
+    dispatch-boundary drain. `req_round` is internal bookkeeping: the
+    in-flight invoke round per client slot (-1 = idle), the device-side
+    half of the latency histogram."""
+    rounds: jnp.ndarray         # i32 [] rounds accumulated
+    sent: jnp.ndarray           # i32 [] messages sent (attempted)
+    delivered: jnp.ndarray      # i32 [] messages delivered
+    dropped: jnp.ndarray        # i32 [] lost + partition + down + overflow
+    duplicated: jnp.ndarray     # i32 [] at-least-once extra copies
+    pool_hist: jnp.ndarray      # i32 [OCC_BUCKETS] rounds by pool occupancy
+    pool_max: jnp.ndarray       # i32 [] peak flight-pool occupancy
+    chan_hist: jnp.ndarray      # i32 [OCC_BUCKETS] rounds by channel occ
+    chan_max: jnp.ndarray       # i32 [] peak edge-channel occupancy
+    role_sent: jnp.ndarray      # i32 [R] node sends per role slice
+    lat_hist: jnp.ndarray       # i32 [LAT_BUCKETS] reply latency (rounds,
+    #                             log2 buckets; device-side, so the delta
+    #                             vs the history's stamp is a constant 1)
+    lat_count: jnp.ndarray      # i32 [] replies measured
+    lat_sum: jnp.ndarray        # i32 [] summed latency rounds
+    req_round: jnp.ndarray      # i32 [C] in-flight invoke round (-1 idle)
+
+
+def role_bounds(program) -> tuple:
+    """The static ((lo, hi), ...) node-id slices `MetricRing.role_sent`
+    buckets by: a `RolePartition`'s role ranges, or one whole-cluster
+    slice for homogeneous programs. Hashable (rides `NetConfig`)."""
+    bounds = getattr(program, "_bounds", None)
+    if bounds:
+        return tuple((int(lo), int(hi)) for lo, hi in bounds)
+    return ((0, int(getattr(program, "n_nodes", 0))),)
+
+
+def role_names(program) -> list:
+    roles = getattr(program, "roles", None)
+    if roles:
+        return [name for name, _prog in roles]
+    return ["nodes"]
+
+
+def make_ring(cfg) -> MetricRing:
+    z = jnp.zeros((), I32)
+    n_roles = max(len(cfg.telemetry_roles), 1)
+    return MetricRing(
+        rounds=z, sent=z, delivered=z, dropped=z, duplicated=z,
+        pool_hist=jnp.zeros(OCC_BUCKETS, I32), pool_max=z,
+        chan_hist=jnp.zeros(OCC_BUCKETS, I32), chan_max=z,
+        role_sent=jnp.zeros(n_roles, I32),
+        lat_hist=jnp.zeros(LAT_BUCKETS, I32), lat_count=z, lat_sum=z,
+        req_round=jnp.full(max(cfg.n_clients, 1), -1, I32))
+
+
+def _occ_bucket(occ, cap: int):
+    b = (occ * OCC_BUCKETS) // max(cap, 1)
+    return jnp.clip(b, 0, OCC_BUCKETS - 1)
+
+
+def ring_update(cfg, ring: MetricRing, st0, net, channels, round_i,
+                node_sent, inject_sent, reply_msgs) -> MetricRing:
+    """One round's telemetry fold, called at the END of `sim._round` /
+    `sim._round_edge` (pure, int32, scatter-ADD only — the jaxpr
+    auditor's host-transfer and scatter rules stay at zero findings).
+
+    `st0` is the round-entry `NetStats`, `net` the post-round NetState
+    (its stats are the round-exit values, so class deltas are exact),
+    `node_sent` an [N] per-node valid-send count for role bucketing,
+    `inject_sent` the id-stamped client inject view, and `reply_msgs` a
+    flat Msgs view whose valid client-destined rows are this round's
+    reply deliveries."""
+    st1 = net.stats
+    d_sent = st1.sent_all - st0.sent_all
+    d_recv = st1.recv_all - st0.recv_all
+    d_drop = ((st1.lost + st1.dropped_partition + st1.dropped_down
+               + st1.dropped_overflow)
+              - (st0.lost + st0.dropped_partition + st0.dropped_down
+                 + st0.dropped_overflow))
+    d_dup = st1.duplicated - st0.duplicated
+
+    # occupancy (sampled once per round, post-delivery/post-send)
+    pool_occ = jnp.sum(net.pool.valid.astype(I32))
+    pool_hist = ring.pool_hist.at[_occ_bucket(pool_occ,
+                                              cfg.pool_cap)].add(1)
+    if channels is not None:
+        chan_occ = jnp.sum(channels.valid.astype(I32))
+        chan_hist = ring.chan_hist.at[
+            _occ_bucket(chan_occ, int(channels.valid.size))].add(1)
+        chan_max = jnp.maximum(ring.chan_max, chan_occ)
+    else:
+        chan_hist, chan_max = ring.chan_hist, ring.chan_max
+
+    # per-role sends: static role slices over the [N] per-node counts
+    role_sent = ring.role_sent
+    bounds = cfg.telemetry_roles or ((0, cfg.n_nodes),)
+    for i, (lo, hi) in enumerate(bounds):
+        role_sent = role_sent.at[i].add(jnp.sum(node_sent[lo:hi]))
+
+    # client-op latency in rounds: invokes arm req_round, replies read
+    # it. Dense where-updates driven by scatter-ADD one-hots — no
+    # scatter-set, so overlapping rows (a duplicated reply) stay
+    # order-independent. Replies are matched against the PRE-ARM table:
+    # a late reply delivered in the same round a timed-out worker
+    # re-invokes must pair with the OLD op (its real latency) and leave
+    # the fresh arm in place for the new op's reply.
+    C = ring.req_round.shape[0]
+    N = cfg.n_nodes
+    req0 = ring.req_round
+
+    rep_flat = jax.tree.map(lambda f: f.reshape(-1), reply_msgs)
+    rep_valid = rep_flat.valid & (rep_flat.dest >= N)
+    rep_idx = jnp.where(rep_valid,
+                        jnp.clip(rep_flat.dest - N, 0, C - 1), C)
+    hit = jnp.zeros(C, I32).at[rep_idx].add(
+        rep_valid.astype(I32), mode="drop") > 0
+    lat_c = jnp.where(hit & (req0 >= 0), round_i - req0, -1)  # [C]
+    measured = lat_c >= 0
+    lat_pos = jnp.maximum(lat_c, 1).astype(jnp.float32)
+    bucket = jnp.clip(jnp.ceil(jnp.log2(lat_pos)).astype(I32),
+                      0, LAT_BUCKETS - 1)
+    lat_hist = ring.lat_hist.at[jnp.where(measured, bucket,
+                                          LAT_BUCKETS)].add(
+        measured.astype(I32), mode="drop")
+
+    inv_valid = inject_sent.valid & (inject_sent.src >= N)
+    inv_idx = jnp.where(inv_valid,
+                        jnp.clip(inject_sent.src - N, 0, C - 1), C)
+    armed = jnp.zeros(C, I32).at[inv_idx].add(
+        jnp.where(inv_valid, round_i + 1, 0), mode="drop")
+    req = jnp.where(armed > 0, armed - 1, req0)
+    req = jnp.where(hit & ~(armed > 0), -1, req)
+
+    return MetricRing(
+        rounds=ring.rounds + 1,
+        sent=ring.sent + d_sent,
+        delivered=ring.delivered + d_recv,
+        dropped=ring.dropped + d_drop,
+        duplicated=ring.duplicated + d_dup,
+        pool_hist=pool_hist,
+        pool_max=jnp.maximum(ring.pool_max, pool_occ),
+        chan_hist=chan_hist, chan_max=chan_max,
+        role_sent=role_sent,
+        lat_hist=lat_hist,
+        lat_count=ring.lat_count + jnp.sum(measured.astype(I32)),
+        lat_sum=ring.lat_sum + jnp.sum(jnp.where(measured, lat_c, 0)),
+        req_round=req)
+
+
+def ring_dict(ring, role_labels=None) -> dict:
+    """The drained ring as a plain JSON-shaped dict (host numpy in,
+    ints out). Used by the net-stats results block, the per-wave jsonl
+    records (as deltas), and the parity tests."""
+    g = lambda a: int(np.asarray(a).sum())      # noqa: E731
+    labels = list(role_labels or [])
+    role = np.asarray(ring.role_sent).reshape(
+        -1, ring.role_sent.shape[-1]).sum(axis=0)
+    out = {
+        "rounds": g(ring.rounds),
+        "sent": g(ring.sent),
+        "delivered": g(ring.delivered),
+        "dropped": g(ring.dropped),
+        "duplicated": g(ring.duplicated),
+        "pool-occupancy-hist": np.asarray(ring.pool_hist).reshape(
+            -1, OCC_BUCKETS).sum(axis=0).tolist(),
+        "pool-occupancy-max": int(np.asarray(ring.pool_max).max()),
+        "latency-rounds-hist": np.asarray(ring.lat_hist).reshape(
+            -1, LAT_BUCKETS).sum(axis=0).tolist(),
+        "latency-count": g(ring.lat_count),
+        "latency-rounds-sum": g(ring.lat_sum),
+    }
+    if int(np.asarray(ring.chan_hist).sum()):
+        out["chan-occupancy-hist"] = np.asarray(ring.chan_hist).reshape(
+            -1, OCC_BUCKETS).sum(axis=0).tolist()
+        out["chan-occupancy-max"] = int(np.asarray(ring.chan_max).max())
+    out["role-sent"] = {
+        (labels[i] if i < len(labels) else f"role-{i}"): int(v)
+        for i, v in enumerate(role.tolist())}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Exact streaming quantiles
+# ---------------------------------------------------------------------------
+
+class Sketch:
+    """An exact streaming quantile structure for a small discrete value
+    domain: a counting histogram keyed by value. Virtual time makes op
+    latencies multiples of ms_per_round, so this is lossless where a
+    GK/t-digest sketch would approximate — and `quantiles()` replicates
+    `checkers.perf.latency_stats` (sorted values, index
+    `min(n-1, int(p*n))`, round(x, 3)) so the cumulative sketch matches
+    the post-hoc PerfChecker exactly."""
+
+    __slots__ = ("counts", "n")
+
+    def __init__(self):
+        self.counts: dict = {}
+        self.n = 0
+
+    def add(self, v: float):
+        self.counts[v] = self.counts.get(v, 0) + 1
+        self.n += 1
+
+    def merge(self, other: "Sketch"):
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+        self.n += other.n
+
+    def quantiles(self) -> dict:
+        if not self.n:
+            return {}
+        items = sorted(self.counts.items())
+        n = self.n
+
+        def q(p):
+            target = min(n - 1, int(p * n))
+            seen = 0
+            for v, c in items:
+                seen += c
+                if target < seen:
+                    return v
+            return items[-1][0]         # pragma: no cover - target < n
+        return {"count": n, "p50": round(q(0.5), 3),
+                "p95": round(q(0.95), 3), "p99": round(q(0.99), 3),
+                "max": round(items[-1][0], 3)}
+
+
+# ---------------------------------------------------------------------------
+# Layers 2+3: the host session (spans + jsonl stream)
+# ---------------------------------------------------------------------------
+
+class _Cursor:
+    """Per-cluster incremental history scan state: the open-slot pairing
+    walk (same adjacency rule as `History.pairs_index`), a windowed and
+    a cumulative latency sketch, window op counters, and the last ring
+    drain (for deltas)."""
+
+    __slots__ = ("row", "open", "win", "cum", "invokes", "oks", "fails",
+                 "infos", "last_round", "last_ring", "windows")
+
+    def __init__(self):
+        self.row = 0
+        self.open: dict = {}
+        self.win = Sketch()
+        self.cum = Sketch()
+        self.invokes = self.oks = self.fails = self.infos = 0
+        self.last_round = 0
+        self.last_ring: dict | None = None
+        self.windows = 0
+
+
+class TelemetrySession:
+    """One run's flight recorder (standalone or fleet-wide). Opened by
+    `run_tpu_test` / `FleetRunner` when `--telemetry` names a directory;
+    every method is cheap and observational — sessions never touch
+    scheduling, PRNG, or history state.
+
+    Thread safety: spans arrive from the analysis worker thread too, so
+    the event list and jsonl writer are lock-guarded."""
+
+    # span buffer cap: trace.json must be written as one JSON document,
+    # so spans are held in memory until close — bounded, or a long
+    # continuous fleet run would grow the buffer for days. Past the cap
+    # the EARLIEST spans are already safe (they were recorded first);
+    # later spans are counted as dropped in the trace metadata.
+    TRACE_EVENT_CAP = 200_000
+
+    def __init__(self, out_dir: str, ms_per_round: float = 1.0,
+                 fleet: int = 1):
+        os.makedirs(out_dir, exist_ok=True)
+        self.dir = out_dir
+        self.ms_per_round = float(ms_per_round)
+        self.fleet = int(fleet)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._events_dropped = 0
+        self._cursors: dict = {}
+        self._seq = 0
+        self._clusters: set = set()
+        self._closed = False
+        self._jsonl = open(os.path.join(out_dir, "telemetry.jsonl"), "w")
+
+    # --- spans (Chrome trace events) ---
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def span(self, name: str, t0: float, t1: float, tid="runner",
+             args: dict | None = None):
+        """One completed phase span, perf_counter() endpoints."""
+        ev = {"name": name, "ph": "X", "pid": "maelstrom",
+              "tid": str(tid),
+              "ts": round((t0 - self._t0) * 1e6, 1),
+              "dur": round(max(t1 - t0, 0.0) * 1e6, 1)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) < self.TRACE_EVENT_CAP:
+                self._events.append(ev)
+            else:
+                self._events_dropped += 1
+
+    # --- per-wave records ---
+
+    def _cursor(self, cluster) -> _Cursor:
+        c = self._cursors.get(cluster)
+        if c is None:
+            c = self._cursors[cluster] = _Cursor()
+        return c
+
+    def _ingest(self, cur: _Cursor, history):
+        """Advances the cursor over newly-appended history rows with the
+        pairing adjacency rule `History.pairs_index` / the post-hoc
+        PerfChecker use: an invoke pairs with the immediately following
+        same-process completion; nemesis rows are skipped."""
+        hi = len(history)
+        if hi <= cur.row:
+            return
+        soa = history.soa()
+        try:
+            nem = soa.process_table.index("nemesis")
+        except ValueError:
+            nem = -1
+        types, procs, times = soa.type, soa.process, soa.time
+        for i in range(cur.row, hi):
+            p = int(procs[i])
+            if p == nem:
+                continue
+            if types[i] == 0:               # invoke
+                cur.open[p] = int(times[i])
+                cur.invokes += 1
+                continue
+            t0 = cur.open.pop(p, None)
+            if types[i] == 1:               # ok
+                cur.oks += 1
+                if t0 is not None:
+                    lat_ms = (int(times[i]) - t0) / 1e6
+                    cur.win.add(lat_ms)
+                    cur.cum.add(lat_ms)
+            elif types[i] == 2:
+                cur.fails += 1
+            else:
+                cur.infos += 1
+        cur.row = hi
+
+    def wave(self, history, r: int, cluster=None, ring=None,
+             pipeline=None, transfer=None):
+        """Appends one window record to telemetry.jsonl: ops and exact
+        windowed + cumulative latency quantiles from the rows this wave
+        exposed, offered/delivered rates over the window's virtual
+        span, ring deltas, and the stream grader's checker lag."""
+        cur = self._cursor(cluster)
+        inv0, ok0 = cur.invokes, cur.oks
+        fail0, info0 = cur.fails, cur.infos
+        cur.win = Sketch()
+        self._ingest(cur, history)
+        span_r = max(int(r) - cur.last_round, 0)
+        span_s = span_r * self.ms_per_round / 1e3
+        rec = {
+            "type": "window", "seq": self._seq, "window": cur.windows,
+            "round": int(r),
+            "t_s": round(time.perf_counter() - self._t0, 6),
+            "ops": cur.invokes - inv0,
+            "oks": cur.oks - ok0,
+            "fails": cur.fails - fail0,
+            "infos": cur.infos - info0,
+            "lat_ms": cur.win.quantiles(),
+            "cum_lat_ms": cur.cum.quantiles(),
+        }
+        if cluster is not None:
+            rec["cluster"] = cluster
+        if span_s > 0:
+            rec["offered_rate"] = round((cur.invokes - inv0) / span_s, 3)
+            rec["delivered_rate"] = round((cur.oks - ok0) / span_s, 3)
+        if pipeline is not None and getattr(pipeline, "windows", None):
+            lag = pipeline.windows[-1].get("lag-rounds")
+            if lag is not None:
+                rec["checker_lag_rounds"] = lag
+        if ring is not None:
+            rec["ring"] = self._ring_delta(cur, ring)
+        if transfer is not None:
+            rec["drains"] = transfer.drains
+        cur.last_round = int(r)
+        cur.windows += 1
+        self._write(rec)
+
+    def _ring_delta(self, cur: _Cursor, ring_now: dict) -> dict:
+        prev = cur.last_ring or {}
+        cur.last_ring = ring_now
+        out = {}
+        for k, v in ring_now.items():
+            if isinstance(v, int):
+                out[k] = v - int(prev.get(k, 0))
+            elif isinstance(v, list):
+                pv = prev.get(k) or [0] * len(v)
+                out[k] = [a - b for a, b in zip(v, pv)]
+        return out
+
+    def flush(self, history, r: int, cluster=None, ring=None,
+              pipeline=None):
+        """The run's final record for one cluster: ingests the tail
+        rows (replies folded after the last wave, timeouts) and writes
+        the cumulative stats — `final.lat_ms` is the record the
+        acceptance test compares against PerfChecker's latency-ms."""
+        cur = self._cursor(cluster)
+        cur.win = Sketch()
+        self._ingest(cur, history)
+        rec = {
+            "type": "final", "seq": self._seq, "round": int(r),
+            "t_s": round(time.perf_counter() - self._t0, 6),
+            "ops": cur.invokes, "oks": cur.oks,
+            "fails": cur.fails, "infos": cur.infos,
+            "windows": cur.windows,
+            "lat_ms": cur.cum.quantiles(),
+        }
+        if cluster is not None:
+            rec["cluster"] = cluster
+        if ring is not None:
+            # the final record carries the CUMULATIVE ring (window
+            # records carry deltas): the run's whole device telemetry
+            # in one line, equal to the results block's
+            rec["ring"] = ring
+        if pipeline is not None and getattr(pipeline, "windows", None):
+            lags = [w.get("lag-rounds") for w in pipeline.windows
+                    if w.get("lag-rounds") is not None]
+            if lags:
+                rec["max_checker_lag_rounds"] = max(lags)
+        self._write(rec)
+
+    def _write(self, rec: dict):
+        # records go straight to disk (flushed — `top` tails the live
+        # file); nothing is buffered in memory, so session footprint
+        # stays flat over arbitrarily long runs
+        with self._lock:
+            if self._closed:
+                return
+            self._seq += 1
+            if rec.get("cluster") is not None:
+                self._clusters.add(rec["cluster"])
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
+    # --- teardown ---
+
+    def close(self):
+        """Writes trace.json (Perfetto/chrome://tracing format) and —
+        for fleet sessions — the per-cluster heatmap SVG. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._jsonl.close()
+            events = self._events
+            self._events = []
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self._events_dropped:
+            trace["otherData"] = {
+                "spans-dropped-past-cap": self._events_dropped}
+        with open(os.path.join(self.dir, "trace.json"), "w") as f:
+            json.dump(trace, f)
+        if len(self._clusters) > 1:
+            try:
+                # re-read the stream from disk (records are not kept in
+                # memory) to render the per-cluster heatmap
+                from .viz.fleet import fleet_heatmap
+                fleet_heatmap(read_records(self.dir),
+                              os.path.join(self.dir,
+                                           "fleet-heatmap.svg"))
+            except Exception:       # viz must never fail the run
+                pass
+
+
+def resolve_dir(spec, store_dir: str) -> str:
+    """`--telemetry` value -> output directory: an explicit path is
+    used as-is; the bare flag ("auto") lands telemetry/ inside the
+    run's store dir, next to history.jsonl and results.json."""
+    if spec in (None, "", "off"):
+        raise ValueError("telemetry disabled")
+    if spec in ("auto", "on", True):
+        return os.path.join(store_dir, "telemetry")
+    return str(spec)
+
+
+def enabled(test: dict) -> bool:
+    v = test.get("telemetry")
+    return bool(v) and str(v) != "off"
+
+
+# ---------------------------------------------------------------------------
+# `maelstrom_tpu top`: the live tail view
+# ---------------------------------------------------------------------------
+
+def read_records(path: str) -> list:
+    """Loads telemetry.jsonl records from a file, a telemetry dir, or a
+    store test dir (searched at <dir>/telemetry/telemetry.jsonl)."""
+    if os.path.isdir(path):
+        for cand in (os.path.join(path, "telemetry.jsonl"),
+                     os.path.join(path, "telemetry", "telemetry.jsonl")):
+            if os.path.exists(cand):
+                path = cand
+                break
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue            # torn tail line of a live run
+    return out
+
+
+def render_top(records: list) -> str:
+    """A `top`-style snapshot of the freshest window per cluster plus a
+    totals line — pure function of the parsed records, so the renderer
+    is unit-testable without a live run."""
+    if not records:
+        return "telemetry: no records yet"
+    latest: dict = {}
+    last_win: dict = {}
+    for r in records:
+        if r.get("type") in ("window", "final"):
+            latest[r.get("cluster")] = r
+        if r.get("type") == "window":
+            last_win[r.get("cluster")] = r
+    rows = []
+    header = (f"{'cluster':>8} {'round':>9} {'win':>5} {'ops':>7} "
+              f"{'ok/s':>9} {'p50ms':>8} {'p95ms':>8} {'p99ms':>8} "
+              f"{'lag':>6}")
+    rows.append(header)
+    rows.append("-" * len(header))
+    tot_ops = tot_oks = 0
+    for cl in sorted(latest, key=lambda c: (c is None, c)):
+        r = latest[cl]
+        lat = r.get("lat_ms") or r.get("cum_lat_ms") or {}
+        cum = r.get("cum_lat_ms") or lat
+        tot_ops += r.get("ops", 0)
+        tot_oks += r.get("oks", 0)
+        # the rate column reads the freshest WINDOW record (finals
+        # carry cumulative counts, not a windowed rate)
+        rate = (r.get("delivered_rate")
+                or last_win.get(cl, {}).get("delivered_rate", "-"))
+        rows.append(
+            f"{('-' if cl is None else cl):>8} "
+            f"{r.get('round', 0):>9} "
+            f"{r.get('window', r.get('windows', 0)):>5} "
+            f"{r.get('ops', 0):>7} "
+            f"{rate:>9} "
+            f"{lat.get('p50', cum.get('p50', '-')):>8} "
+            f"{lat.get('p95', cum.get('p95', '-')):>8} "
+            f"{lat.get('p99', cum.get('p99', '-')):>8} "
+            f"{r.get('checker_lag_rounds', '-'):>6}")
+    finals = [r for r in records if r.get("type") == "final"]
+    rows.append("")
+    rows.append(f"clusters: {len(latest)}  records: {len(records)}  "
+                f"final: {len(finals)}")
+    return "\n".join(rows)
+
+
+def top_main(path: str, follow: bool = False,
+             interval: float = 1.0) -> int:
+    """`maelstrom_tpu top PATH [--follow]`: renders the freshest
+    telemetry snapshot; with --follow, re-renders every `interval`
+    seconds until interrupted."""
+    try:
+        while True:
+            try:
+                records = read_records(path)
+            except FileNotFoundError:
+                print(f"top: no telemetry at {path!r} (run with "
+                      f"--telemetry DIR)")
+                return 1
+            out = render_top(records)
+            if follow:
+                print("\x1b[2J\x1b[H" + out, flush=True)
+                time.sleep(max(interval, 0.1))
+            else:
+                print(out)
+                return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Introspection helpers shared by tests and docs
+# ---------------------------------------------------------------------------
+
+def lat_bucket_bounds() -> list:
+    """[(lo, hi)] inclusive latency-in-rounds range per lat_hist
+    bucket, for rendering (doc/observability.md's table)."""
+    out = [(0, 1)]
+    for b in range(1, LAT_BUCKETS):
+        out.append((2 ** (b - 1) + 1, 2 ** b))
+    return out
+
+
+def validate_record(rec: dict) -> list:
+    """Schema check for one telemetry.jsonl record (the check.sh smoke
+    gate): returns a list of problems, empty when valid."""
+    problems = []
+    t = rec.get("type")
+    if t not in ("window", "final"):
+        problems.append(f"unknown record type {t!r}")
+        return problems
+    for k in ("seq", "round", "ops", "oks"):
+        if not isinstance(rec.get(k), int):
+            problems.append(f"{k}: expected int, got {rec.get(k)!r}")
+    for k in ("lat_ms",) + (("cum_lat_ms",) if t == "window" else ()):
+        v = rec.get(k)
+        if not isinstance(v, dict):
+            problems.append(f"{k}: expected dict, got {v!r}")
+        elif v and not {"count", "p50", "p95", "p99",
+                        "max"} <= set(v):
+            problems.append(f"{k}: incomplete quantile block {v!r}")
+    if math.isnan(rec.get("t_s", 0.0)):
+        problems.append("t_s: NaN")
+    return problems

@@ -1,0 +1,99 @@
+"""Fleet telemetry heatmap: clusters x windows, colored by a chosen
+window metric (doc/observability.md).
+
+Renders the flight recorder's telemetry.jsonl stream as one SVG grid —
+row = cluster, column = window sequence, cell color = the metric value
+(p99 latency by default) on a white->red ramp — so a `--fleet N`
+campaign's hot clusters and hot phases are visible at a glance. Pure
+stdlib SVG like the rest of viz/ (no matplotlib)."""
+
+from __future__ import annotations
+
+import html
+
+CELL = 14          # px per window cell
+ROW_H = 16
+ML, MT = 70, 46    # margins: cluster labels left, title/legend top
+
+
+def _metric(rec: dict, metric: str):
+    if metric in ("p50", "p95", "p99", "max"):
+        return (rec.get("lat_ms") or {}).get(metric)
+    v = rec.get(metric)
+    return v if isinstance(v, (int, float)) else None
+
+
+def _ramp(frac: float) -> str:
+    """White -> amber -> red ramp over [0, 1]."""
+    frac = min(max(frac, 0.0), 1.0)
+    if frac < 0.5:
+        t = frac * 2
+        r, g, b = 255, int(255 - 90 * t), int(255 * (1 - t))
+    else:
+        t = (frac - 0.5) * 2
+        r, g, b = 255, int(165 * (1 - t) + 60 * t), int(60 * t * 0.5)
+    return f"#{r:02x}{g:02x}{b:02x}"
+
+
+def fleet_heatmap(records: list, path: str | None = None,
+                  metric: str = "p99") -> str:
+    """Builds the clusters x windows heatmap from parsed telemetry
+    records (`type == "window"`); cells without a value render grey.
+    Returns the SVG text; writes it when `path` is given."""
+    grid: dict = {}          # (cluster, window) -> value-or-None
+    clusters: list = []
+    max_win = 0
+    for rec in records:
+        if rec.get("type") != "window":
+            continue
+        cl = rec.get("cluster")
+        cl = 0 if cl is None else cl
+        if cl not in clusters:
+            clusters.append(cl)
+        w = int(rec.get("window", 0))
+        max_win = max(max_win, w + 1)
+        grid[(cl, w)] = _metric(rec, metric)
+    clusters.sort()
+
+    vals = [v for v in grid.values() if v is not None]
+    vmax = max(vals) if vals else 1.0
+    vmin = min(vals) if vals else 0.0
+    span = (vmax - vmin) or 1.0
+
+    W = ML + max(max_win, 1) * CELL + 20
+    H = MT + max(len(clusters), 1) * ROW_H + 30
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+           f'height="{H}" font-family="sans-serif" font-size="11">',
+           f'<rect width="{W}" height="{H}" fill="white"/>',
+           f'<text x="{ML}" y="16" font-size="13" font-weight="bold">'
+           f'Fleet telemetry: {html.escape(metric)} per window</text>',
+           f'<text x="{ML}" y="32" fill="#555">'
+           f'{len(clusters)} cluster(s) x {max_win} window(s), '
+           f'range {vmin:g}..{vmax:g}</text>']
+    if not grid:
+        out.append(f'<text x="{ML}" y="{MT + 12}">no window records'
+                   '</text>')
+    for yi, cl in enumerate(clusters):
+        y = MT + yi * ROW_H
+        out.append(f'<text x="{ML - 8}" y="{y + 11}" text-anchor="end">'
+                   f'c{html.escape(str(cl))}</text>')
+        for w in range(max_win):
+            v = grid.get((cl, w))
+            if v is None:
+                fill = "#eee"
+                title = f"c{cl} w{w}: -"
+            else:
+                fill = _ramp((v - vmin) / span)
+                title = f"c{cl} w{w}: {metric}={v:g}"
+            out.append(
+                f'<rect x="{ML + w * CELL}" y="{y}" width="{CELL - 1}" '
+                f'height="{ROW_H - 2}" fill="{fill}">'
+                f'<title>{html.escape(title)}</title></rect>')
+    out.append(f'<text x="{ML}" y="{H - 10}" fill="#555">window '
+               f'(wave) index &#8594;</text>')
+    out.append("</svg>")
+    svg = "\n".join(out)
+    if path:
+        with open(path, "w") as f:
+            f.write(svg)
+    return svg

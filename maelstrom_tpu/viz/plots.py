@@ -7,6 +7,7 @@ rate (ops/sec lines per f)."""
 
 from __future__ import annotations
 
+import html
 import math
 
 W, H = 900, 420
@@ -46,7 +47,18 @@ def _fmt(x: float) -> str:
 
 def svg_chart(series: dict, title: str, xlabel: str, ylabel: str,
               kind: str = "line", log_y: bool = False) -> str:
-    """series: name -> {"points": [(x, y), ...], "color": optional}."""
+    """series: name -> {"points": [(x, y), ...], "color": optional}.
+
+    Degenerate inputs are a contract, not an accident: empty series
+    (an empty or nemesis-only history — ISSUE 13's guard) render a
+    labeled "no data" SVG, non-finite points are dropped, and names /
+    labels are escaped — the renderers must never raise into the
+    checker's plot-error catch."""
+    series = {
+        name: {**s, "points": [(x, y) for x, y in s.get("points", ())
+                               if math.isfinite(x) and math.isfinite(y)]}
+        for name, s in series.items()}
+    title = html.escape(str(title))
     pts_all = [(x, y) for s in series.values() for x, y in s["points"]]
     if not pts_all:
         return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
@@ -101,9 +113,10 @@ def svg_chart(series: dict, title: str, xlabel: str, ylabel: str,
         out.append(f'<line x1="{ML}" y1="{ty:.1f}" x2="{ML+pw}" '
                    f'y2="{ty:.1f}" stroke="#eee"/>')
     out.append(f'<text x="{ML+pw/2}" y="{H-8}" text-anchor="middle">'
-               f'{xlabel}</text>')
+               f'{html.escape(str(xlabel))}</text>')
     out.append(f'<text x="16" y="{MT+ph/2}" text-anchor="middle" '
-               f'transform="rotate(-90 16 {MT+ph/2})">{ylabel}</text>')
+               f'transform="rotate(-90 16 {MT+ph/2})">'
+               f'{html.escape(str(ylabel))}</text>')
 
     for i, (name, s) in enumerate(series.items()):
         color = s.get("color") or COLORS[i % len(COLORS)]
@@ -119,13 +132,19 @@ def svg_chart(series: dict, title: str, xlabel: str, ylabel: str,
         ly = MT + 14 + 16 * i
         out.append(f'<rect x="{W-MR+8}" y="{ly-9}" width="10" height="10" '
                    f'fill="{color}"/>'
-                   f'<text x="{W-MR+22}" y="{ly}">{name}</text>')
+                   f'<text x="{W-MR+22}" y="{ly}">'
+                   f'{html.escape(str(name))}</text>')
     out.append("</svg>")
     return "\n".join(out)
 
 
 def perf_charts(history, out_dir: str):
-    """Writes latency-raw.svg, latency-quantiles.svg, rate.svg."""
+    """Writes latency-raw.svg, latency-quantiles.svg, rate.svg.
+
+    Empty and nemesis-only histories are valid inputs (a pure-fault
+    run, a run preempted before its first op): every chart is still
+    written, as an explicit "no data" SVG — the renderer never raises
+    into PerfChecker's plot-error catch (tests/test_viz.py)."""
     import os
     pairs = history.pairs()
     # latency scatter: x = invoke time (s), y = latency (ms), by outcome

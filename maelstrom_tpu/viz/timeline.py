@@ -9,6 +9,11 @@ COLORS = {"ok": "#a5d6a7", "info": "#ffcc80", "fail": "#ef9a9a"}
 
 
 def render_timeline(history, path: str | None = None) -> str:
+    """Empty and nemesis-only histories are valid inputs: the document
+    renders with zero (or only-nemesis) tracks instead of raising into
+    TimelineChecker's error catch (tests/test_viz.py). Process names
+    and op text are escaped — fleet-merged histories tag processes
+    `c<i>:<p>`, and nothing here may break the HTML."""
     pairs = history.pairs()
     if pairs:
         t_end = max((c.time for _, c in pairs if c is not None),
@@ -37,7 +42,8 @@ def render_timeline(history, path: str | None = None) -> str:
                 f'<div class="op {outcome}" style="left:{x:.1f}px;'
                 f'width:{w:.1f}px" title="{title}">'
                 f'{html.escape(str(invoke.f))}</div>')
-        rows.append(f'<div class="row"><span class="proc">{process}'
+        rows.append(f'<div class="row"><span class="proc">'
+                    f'{html.escape(str(process))}'
                     f'</span><div class="track">{"".join(bars)}</div></div>')
 
     doc = f"""<!doctype html><html><head><meta charset="utf-8">

@@ -84,6 +84,17 @@ step artifacts/bench-checker-r11.json 2400 \
 step artifacts/bench-fleet-stream-r12.json 3600 \
     env BENCH_MODE=fleet_stream python bench.py
 
+# 1h. flight-recorder overhead (BENCH_MODE=telemetry, ISSUE 13): the
+#     same chunked broadcast scan with the device metric rings compiled
+#     out vs in — headline `value` = overhead percent (< 5% acceptance;
+#     CPU r01 measured noise-level -0.25%, artifacts/bench-telemetry-
+#     cpu-r01.json). The TPU number is the one that matters: the ring
+#     fold is ~20 small int32 ops beside the round's sorts, so any
+#     measurable TPU overhead indicates a layout/fusion regression
+#     (doc/observability.md "overhead")
+step artifacts/bench-telemetry-r13.json 2400 \
+    env BENCH_MODE=telemetry python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

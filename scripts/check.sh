@@ -185,4 +185,48 @@ PY
     echo "== device-checker smoke valid =="
 fi
 
+# Flight-recorder smoke (ISSUE 13, doc/observability.md): one AUDITED
+# run with --telemetry — the self-report traces this run's ring-enabled
+# step fns (zero new findings required), the Chrome trace must load as
+# JSON with the phase taxonomy, every telemetry.jsonl record must be
+# schema-valid, and the final record's quantiles must equal the
+# post-hoc PerfChecker block. TELEMETRY_SMOKE=0 skips.
+if [ "${TELEMETRY_SMOKE:-1}" = "1" ]; then
+    echo "== flight-recorder telemetry smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w lin-kv --node tpu:lin-kv \
+        --node-count 5 --rate 20 --time-limit 2 --seed 7 \
+        --telemetry "$SMOKE_STORE/tel" \
+        --store "$SMOKE_STORE" > /dev/null
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+from maelstrom_tpu.telemetry import validate_record
+root = sys.argv[1]
+with open(os.path.join(root, "latest", "results.json")) as f:
+    res = json.load(f)
+assert res["valid"] is True, res.get("valid")
+assert res["net"]["static-audit"]["ok"] is True, res["net"]["static-audit"]
+ring = res["net"]["telemetry"]
+assert ring["sent"] == res["net"]["all"]["send-count"], ring
+with open(os.path.join(root, "tel", "trace.json")) as f:
+    trace = json.load(f)
+names = {e["name"] for e in trace["traceEvents"]}
+assert {"schedule-encode", "dispatch", "device-get"} <= names, names
+recs = [json.loads(line)
+        for line in open(os.path.join(root, "tel", "telemetry.jsonl"))]
+assert recs, "no telemetry records"
+for rec in recs:
+    problems = validate_record(rec)
+    assert not problems, (rec, problems)
+final = [r for r in recs if r["type"] == "final"][-1]
+perf = {k: v for k, v in res["perf"]["latency-ms"].items()
+        if k != "by-f"}
+assert final["lat_ms"] == perf, (final["lat_ms"], perf)
+print("telemetry smoke: audited, trace loads, jsonl schema-valid, "
+      "windowed == post-hoc")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== telemetry smoke valid =="
+fi
+
 echo "== static gate clean =="

@@ -1,0 +1,87 @@
+"""Vectorized latency_stats (ISSUE 13 satellite): the columnar numpy
+path must be bit-equal to the original per-pair Python loop, on random
+histories and on the degenerate shapes the loop handled incidentally."""
+
+from __future__ import annotations
+
+import random
+
+from maelstrom_tpu.checkers.perf import (_latency_stats_loop,
+                                         latency_stats)
+from maelstrom_tpu.history import History
+
+
+def _random_history(seed, n_procs=6, n_ops=300, with_nemesis=True):
+    rng = random.Random(seed)
+    h = History()
+    open_p = {}
+    t = 0
+    fs = ["read", "write", "cas", None]
+    for _ in range(n_ops):
+        t += rng.randint(1, 5) * 1_000_000
+        if with_nemesis and rng.random() < 0.08:
+            if rng.random() < 0.5:
+                h.append_row("invoke", "start-partition", None,
+                             "nemesis", t)
+            else:
+                h.append_row("info", "start-partition", "x",
+                             "nemesis", t)
+            continue
+        p = rng.randrange(n_procs)
+        if p in open_p and rng.random() < 0.8:
+            kind = rng.choice(["ok", "ok", "fail", "info"])
+            h.append_row(kind, open_p.pop(p), [None, rng.randint(0, 9)],
+                         p, t)
+        else:
+            # possibly double-invoke (crashed worker): the old pair
+            # drops the stale invoke
+            f = rng.choice(fs)
+            h.append_row("invoke", f, [None, rng.randint(0, 9)], p, t)
+            open_p[p] = f
+    return h
+
+
+def test_vectorized_matches_loop_random():
+    for seed in range(8):
+        h = _random_history(seed)
+        assert latency_stats(h) == _latency_stats_loop(h), seed
+
+
+def test_vectorized_matches_loop_degenerate():
+    assert latency_stats(History()) == _latency_stats_loop(History())
+    # nemesis-only
+    h = History()
+    h.append_row("invoke", "start-kill", None, "nemesis", 5)
+    h.append_row("info", "start-kill", "x", "nemesis", 9)
+    assert latency_stats(h) == _latency_stats_loop(h) == {}
+    # unpaired invoke only
+    h2 = History()
+    h2.append_row("invoke", "read", None, 0, 5)
+    assert latency_stats(h2) == _latency_stats_loop(h2) == {}
+    # fail/info completions only -> no ok latencies
+    h3 = History()
+    h3.append_row("invoke", "read", None, 0, 0)
+    h3.append_row("info", "read", None, 0, 1_000_000, "net-timeout")
+    h3.append_row("invoke", "write", [None, 1], 1, 0)
+    h3.append_row("fail", "write", [None, 1], 1, 2_000_000)
+    assert latency_stats(h3) == _latency_stats_loop(h3) == {}
+
+
+def test_by_f_breakdown_partitions_the_same_latencies():
+    h = _random_history(3)
+    top = latency_stats(h, by_f=True)
+    plain = latency_stats(h)
+    by_f = top.pop("by-f")
+    assert top == plain
+    # per-f counts sum to the total, every block carries the quantiles
+    assert sum(b["count"] for b in by_f.values()) == plain["count"]
+    for b in by_f.values():
+        assert {"count", "p50", "p95", "p99", "max"} <= set(b)
+    # a single-f history's by-f block IS the top-level block
+    h2 = History()
+    for i in range(10):
+        h2.append_row("invoke", "read", None, 0, i * 10_000_000)
+        h2.append_row("ok", "read", [None, i], 0,
+                      i * 10_000_000 + (i + 1) * 1_000_000)
+    out = latency_stats(h2, by_f=True)
+    assert out["by-f"]["read"] == latency_stats(h2)

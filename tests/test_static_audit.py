@@ -371,6 +371,27 @@ def test_gate_traces_fleet_continuous_scan_variant():
     assert new == [], [f.as_dict() for f in new]
 
 
+def test_gate_traces_telemetry_ring_variants():
+    """ISSUE 13: the gate traces ring-enabled (`--telemetry`) variants
+    of one pool-path and one edge-path workload, proving the flight
+    recorder's per-round fold (telemetry.ring_update) introduces no
+    host transfers, unstable sorts, widenings, or non-unique scatters
+    — zero NEW findings, and no telemetry-attributed finding needed
+    baselining at all."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["lin-kv", "broadcast"], mesh=None, fleet=False)
+    assert any("@telemetry]" in e and e.startswith("scan_fn[lin-kv")
+               for e in entries), entries
+    assert any("@telemetry]" in e and e.startswith("scan_fn[broadcast")
+               for e in entries), entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+    tel_hits = [f for f in findings
+                if "telemetry" in f.key or "telemetry" in f.where]
+    assert tel_hits == [], [f.as_dict() for f in tel_hits]
+
+
 def test_gate_traces_device_checker_kernels():
     """ISSUE 11: the txn-list-append program set traces the
     device-resident checker's jitted entry points — the elle edge

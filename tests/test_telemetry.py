@@ -1,0 +1,338 @@
+"""Flight recorder (ISSUE 13, doc/observability.md).
+
+Pinned contracts:
+  - telemetry-on vs telemetry-off runs are BYTE-IDENTICAL per seed
+    (plain and --fleet in tier-1; --mesh as multichip; the combined
+    nemesis soup in the slow suite) — the rings are observational;
+  - the device ring's message-flow counters equal the NetStats device
+    counters (same run, same drain);
+  - the streaming sketch is exact: the final telemetry.jsonl record's
+    quantiles equal the post-hoc PerfChecker block on the same history;
+  - trace.json is Chrome-trace shaped and carries the phase taxonomy;
+  - the ring carry rides checkpoints: an interrupted+resumed run's
+    final ring equals the uninterrupted run's (slow);
+  - HostNet books the same counter vocabulary (parity test).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu import telemetry as TM
+
+STORE = "/tmp/maelstrom-tpu-telemetry-store"
+
+SOUP = {"kill", "pause", "partition", "duplicate", "weather"}
+
+
+def _run(tmp, tel=None, **kw):
+    opts = dict(store_root=str(tmp), seed=23, workload="lin-kv",
+                node="tpu:lin-kv", node_count=5, rate=15.0,
+                time_limit=2.0, recovery_s=1.0, audit=False,
+                audit_trace=False)
+    if tel:
+        opts["telemetry"] = tel
+    opts.update(kw)
+    res = core.run(opts)
+    with open(os.path.join(str(tmp), "latest", "history.jsonl"),
+              "rb") as f:
+        return res, f.read()
+
+
+# --- unit layer ------------------------------------------------------------
+
+def test_sketch_quantiles_are_exact():
+    from maelstrom_tpu.checkers.perf import _quantile_block
+    import numpy as np
+    for seed in range(6):
+        rng = random.Random(seed)
+        vals = [rng.choice([1.0, 2.0, 5.0, 5.0, 7.5, 100.0])
+                for _ in range(rng.randint(1, 400))]
+        sk = TM.Sketch()
+        for v in vals:
+            sk.add(v)
+        assert sk.quantiles() == _quantile_block(
+            np.sort(np.asarray(vals)))
+
+
+def test_sketch_merge_and_empty():
+    assert TM.Sketch().quantiles() == {}
+    a, b = TM.Sketch(), TM.Sketch()
+    for v in (1.0, 2.0):
+        a.add(v)
+    for v in (2.0, 9.0):
+        b.add(v)
+    a.merge(b)
+    assert a.n == 4 and a.counts[2.0] == 2
+    assert a.quantiles()["max"] == 9.0
+
+
+def test_hostnet_counter_parity_vocabulary():
+    """The host net books the same counter classes the device ring
+    drains, under the same keys — and they behave: lossless send/recv
+    conserves, loss and partitions land in `dropped`."""
+    from maelstrom_tpu.net.host import HostNet
+    net = HostNet()
+    net.add_node("n0")
+    net.add_node("n1")
+    for i in range(10):
+        net.send({"src": "n0", "dest": "n1",
+                  "body": {"type": "echo", "msg_id": i}})
+    got = 0
+    while net.recv("n1", 10) is not None:
+        got += 1
+    c = net.telemetry_counters()
+    assert got == 10
+    assert c == {"sent": 10, "delivered": 10, "dropped": 0,
+                 "duplicated": 0}
+    # the vocabulary matches the device ring's message-flow keys
+    ring_keys = {"sent", "delivered", "dropped", "duplicated"}
+    assert set(c) == ring_keys
+
+    net.flaky(1.0)                   # every send lost
+    net.send({"src": "n0", "dest": "n1",
+              "body": {"type": "echo", "msg_id": 99}})
+    assert net.telemetry_counters()["dropped"] == 1
+    net.flaky(0.0)
+    net.drop_link("n0", "n1")        # partition consumes at recv
+    net.send({"src": "n0", "dest": "n1",
+              "body": {"type": "echo", "msg_id": 100}})
+    assert net.recv("n1", 10) is None
+    assert net.telemetry_counters()["dropped"] == 2
+
+
+def test_render_top_and_validate_record():
+    recs = [
+        {"type": "window", "seq": 0, "window": 0, "round": 100,
+         "t_s": 0.1, "ops": 5, "oks": 4, "fails": 0, "infos": 1,
+         "lat_ms": {"count": 4, "p50": 5.0, "p95": 6.0, "p99": 6.0,
+                    "max": 6.0},
+         "cum_lat_ms": {"count": 4, "p50": 5.0, "p95": 6.0,
+                        "p99": 6.0, "max": 6.0},
+         "cluster": 1, "delivered_rate": 40.0,
+         "checker_lag_rounds": 3},
+        {"type": "final", "seq": 1, "round": 200, "t_s": 0.2,
+         "ops": 9, "oks": 8, "fails": 0, "infos": 1, "windows": 1,
+         "lat_ms": {"count": 8, "p50": 5.0, "p95": 6.0, "p99": 6.0,
+                    "max": 6.0}},
+    ]
+    for r in recs:
+        assert TM.validate_record(r) == [], r
+    out = TM.render_top(recs)
+    assert "cluster" in out and "p99ms" in out
+    assert TM.render_top([]) == "telemetry: no records yet"
+    assert TM.validate_record({"type": "bogus"})
+    assert TM.validate_record({"type": "window", "seq": "x",
+                               "round": 0, "ops": 0, "oks": 0})
+
+
+# --- e2e: byte identity + exactness ---------------------------------------
+
+def test_plain_byte_identity_ring_counters_and_stream(tmp_path):
+    r_off, h_off = _run(tmp_path / "off")
+    tel_dir = str(tmp_path / "teldir")
+    r_on, h_on = _run(tmp_path / "on", tel=tel_dir)
+    assert r_off["valid"] is True and r_on["valid"] is True
+    assert h_on == h_off                 # byte-identical histories
+
+    # ring counters == the device NetStats counters of the same run
+    ring = r_on["net"]["telemetry"]
+    assert ring["sent"] == r_on["net"]["all"]["send-count"]
+    assert ring["delivered"] == r_on["net"]["all"]["recv-count"]
+    assert ring["dropped"] == (r_on["net"]["lost"]
+                               + r_on["net"]["dropped-partition"]
+                               + r_on["net"]["dropped-down"]
+                               + r_on["net"]["dropped-overflow"])
+    assert ring["duplicated"] == r_on["net"]["duplicated"]
+    assert ring["rounds"] > 0
+    # occupancy histograms count every executed round
+    assert sum(ring["pool-occupancy-hist"]) == ring["rounds"]
+    assert ring["latency-count"] > 0
+    assert "nodes" in ring["role-sent"]
+    # the off-run's results carry NO telemetry block (shape preserved)
+    assert "telemetry" not in r_off["net"]
+
+    # telemetry.jsonl: schema-valid, final quantiles == PerfChecker
+    recs = [json.loads(line)
+            for line in open(os.path.join(tel_dir, "telemetry.jsonl"))]
+    assert recs, "no telemetry records"
+    for rec in recs:
+        assert TM.validate_record(rec) == [], rec
+    final = [r for r in recs if r["type"] == "final"][-1]
+    perf = {k: v for k, v in r_on["perf"]["latency-ms"].items()
+            if k != "by-f"}
+    assert final["lat_ms"] == perf
+    assert final["ops"] == sum(r["ops"] for r in recs
+                               if r["type"] == "window")
+
+    # trace.json: Chrome-trace shaped, the phase taxonomy present
+    with open(os.path.join(tel_dir, "trace.json")) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"schedule-encode", "dispatch", "device-get"} <= names
+    for e in trace["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+
+
+def test_fleet_byte_identity_and_per_cluster_stream(tmp_path):
+    kw = dict(fleet=2, continuous=True, time_limit=1.5, seed=31)
+    r_off, _ = _run(tmp_path / "off", **kw)
+    off_root = os.path.join(str(tmp_path / "off"), "latest")
+    h_off = {i: open(os.path.join(off_root, f"cluster-{i:04d}",
+                                  "history.jsonl"), "rb").read()
+             for i in range(2)}
+    tel_dir = str(tmp_path / "teldir")
+    r_on, _ = _run(tmp_path / "on", tel=tel_dir, **kw)
+    on_root = os.path.join(str(tmp_path / "on"), "latest")
+    h_on = {i: open(os.path.join(on_root, f"cluster-{i:04d}",
+                                 "history.jsonl"), "rb").read()
+            for i in range(2)}
+    assert h_on == h_off                 # per-cluster byte identity
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(tel_dir, "telemetry.jsonl"))]
+    finals = {r["cluster"]: r for r in recs if r["type"] == "final"}
+    assert set(finals) == {0, 1}
+    for i in range(2):
+        perf = {k: v
+                for k, v in r_on["clusters"][i]["perf"]
+                ["latency-ms"].items() if k != "by-f"}
+        assert finals[i]["lat_ms"] == perf, i
+        # per-cluster ring in each cluster's net block
+        ring = r_on["clusters"][i]["net"]["telemetry"]
+        assert ring["sent"] == \
+            r_on["clusters"][i]["net"]["all"]["send-count"]
+    # the fleet heatmap rendered (>= 2 clusters in the stream)
+    assert os.path.exists(os.path.join(tel_dir, "fleet-heatmap.svg"))
+    # fleet + per-cluster trace rows
+    with open(os.path.join(tel_dir, "trace.json")) as f:
+        tids = {e["tid"] for e in json.load(f)["traceEvents"]}
+    assert "fleet" in tids and {"c0", "c1"} & tids
+
+
+@pytest.mark.multichip
+def test_mesh_byte_identity(tmp_path):
+    kw = dict(mesh="1,2", seed=37)
+    _, h_off = _run(tmp_path / "off", **kw)
+    _, h_on = _run(tmp_path / "on", tel=str(tmp_path / "teldir"), **kw)
+    assert h_on == h_off
+
+
+@pytest.mark.slow
+def test_soup_byte_identity(tmp_path):
+    kw = dict(nemesis=set(SOUP), nemesis_interval=0.7, time_limit=2.5,
+              seed=41, timeout_ms=1000)
+    r_off, h_off = _run(tmp_path / "off", **kw)
+    r_on, h_on = _run(tmp_path / "on", tel=str(tmp_path / "teldir"),
+                      **kw)
+    assert h_on == h_off
+    # faults actually ran and the ring saw them
+    ring = r_on["net"]["telemetry"]
+    assert ring["dropped"] + ring["duplicated"] >= 0
+    assert ring["rounds"] > 0
+
+
+@pytest.mark.slow
+def test_ring_rides_checkpoint_resume(tmp_path):
+    """The interrupted+resumed run's history AND final ring equal the
+    uninterrupted run's — the MetricRing is part of the deterministic
+    carry, snapshot and restored with the rest of SimState."""
+    from conftest import ops_projection as _ops
+
+    from maelstrom_tpu import checkpoint as cp
+    from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+    def build(sub, **over):
+        opts = {"workload": "pn-counter", "node": "tpu:pn-counter",
+                "node_count": 5, "rate": 20.0, "time_limit": 3.0,
+                "nemesis": {"partition"}, "nemesis_interval": 1.0,
+                "recovery_s": 1.0, "seed": 7,
+                "telemetry": str(tmp_path / "tel"),
+                "store_root": str(tmp_path / sub)}
+        opts.update(over)
+        test = core.build_test(opts)
+        test["store_dir"] = str(tmp_path / sub)
+        return test
+
+    runner_a = TpuRunner(build("a"))
+    hist_a = runner_a.run()
+    ring_a = TM.ring_dict(runner_a._final_ring())
+    assert ring_a["rounds"] > 0
+
+    test_b = build("b", checkpoint_every=1.0)
+    test_b["max_rounds"] = 1500
+    TpuRunner(test_b).run()
+    ck = cp.load(str(tmp_path / "b"))
+    assert ck["sim"].telemetry is not None   # the ring is IN the file
+
+    test_c = build("b")
+    runner_c = TpuRunner(test_c)
+    resume = cp.load(str(tmp_path / "b"))
+    cp.check_fingerprint(resume, test_c)
+    hist_c = runner_c.run(resume=resume)
+    assert _ops(hist_c) == _ops(hist_a)
+    assert TM.ring_dict(runner_c._final_ring()) == ring_a
+
+    # rings-off resume against a rings-on checkpoint is REFUSED (the
+    # carry shapes differ)
+    test_d = build("b", ms_per_round=1.0)
+    test_d.pop("telemetry")
+    with pytest.raises(ValueError, match="telemetry_rings"):
+        cp.check_fingerprint(cp.load(str(tmp_path / "b")), test_d)
+
+
+@pytest.mark.slow
+def test_fleet8_continuous_acceptance(tmp_path):
+    """ISSUE 13 acceptance: a `--fleet 8 --continuous --telemetry` run
+    produces a Chrome-trace JSON that loads (Perfetto format) and a
+    telemetry.jsonl whose per-cluster final quantiles match the
+    post-hoc PerfChecker values on the same histories."""
+    tel_dir = str(tmp_path / "teldir")
+    r_on, _ = _run(tmp_path / "on", tel=tel_dir, fleet=8,
+                   continuous=True, time_limit=1.5, seed=47)
+    recs = [json.loads(line)
+            for line in open(os.path.join(tel_dir, "telemetry.jsonl"))]
+    for rec in recs:
+        assert TM.validate_record(rec) == [], rec
+    finals = {r["cluster"]: r for r in recs if r["type"] == "final"}
+    assert set(finals) == set(range(8))
+    for i in range(8):
+        perf = {k: v
+                for k, v in r_on["clusters"][i]["perf"]
+                ["latency-ms"].items() if k != "by-f"}
+        assert finals[i]["lat_ms"] == perf, i
+    with open(os.path.join(tel_dir, "trace.json")) as f:
+        trace = json.load(f)
+    assert isinstance(trace["traceEvents"], list) and \
+        trace["traceEvents"]
+    for e in trace["traceEvents"]:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    assert os.path.exists(os.path.join(tel_dir, "fleet-heatmap.svg"))
+
+
+def test_continuous_windowed_stream(tmp_path):
+    """Continuous mode: window records stream per wave; the final
+    cumulative quantiles still match the post-hoc PerfChecker."""
+    tel_dir = str(tmp_path / "teldir")
+    r_on, _ = _run(tmp_path / "on", tel=tel_dir, continuous=True,
+                   seed=43)
+    recs = [json.loads(line)
+            for line in open(os.path.join(tel_dir, "telemetry.jsonl"))]
+    wins = [r for r in recs if r["type"] == "window"]
+    assert len(wins) >= 2
+    final = [r for r in recs if r["type"] == "final"][-1]
+    perf = {k: v for k, v in r_on["perf"]["latency-ms"].items()
+            if k != "by-f"}
+    assert final["lat_ms"] == perf
+    # window records carry ring DELTAS; the final record carries the
+    # cumulative ring (== the results block's). Deltas sum to at most
+    # the cumulative value (the recovery tail runs after the last wave)
+    ring_total = r_on["net"]["telemetry"]
+    assert final["ring"] == ring_total
+    delta_sum = sum(r.get("ring", {}).get("sent", 0) for r in wins)
+    assert 0 < delta_sum <= ring_total["sent"]

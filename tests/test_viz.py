@@ -1,0 +1,127 @@
+"""Direct unit tests for the SVG/HTML renderers (ISSUE 13 satellite):
+plots.py and timeline.py had no dedicated test file — exceptions were
+only ever observed as a swallowed `plot-error` in results. These pin
+the degenerate-input contract (empty / nemesis-only / unpaired /
+zero-duration histories render, never raise), escaping, and the new
+fleet telemetry heatmap."""
+
+from __future__ import annotations
+
+import math
+import os
+
+import pytest
+
+from maelstrom_tpu.history import History
+from maelstrom_tpu.viz.fleet import fleet_heatmap
+from maelstrom_tpu.viz.plots import perf_charts, svg_chart
+from maelstrom_tpu.viz.timeline import render_timeline
+
+CHARTS = ("latency-raw.svg", "latency-quantiles.svg", "rate.svg")
+
+
+def _nemesis_only():
+    h = History()
+    h.append_row("invoke", "start-partition", None, "nemesis", 0)
+    h.append_row("info", "start-partition", "isolated", "nemesis",
+                 5_000_000)
+    h.append_row("invoke", "stop-partition", None, "nemesis", 9_000_000)
+    h.append_row("info", "stop-partition", "healed", "nemesis",
+                 10_000_000)
+    return h
+
+
+def _normal():
+    h = History()
+    for i in range(20):
+        h.append_row("invoke", "read" if i % 2 else "write",
+                     [None, i], i % 3, i * 10_000_000)
+        h.append_row("ok" if i % 5 else "info",
+                     "read" if i % 2 else "write", [None, i], i % 3,
+                     i * 10_000_000 + 3_000_000)
+    return h
+
+
+@pytest.mark.parametrize("history", [
+    History(),                      # empty
+    _nemesis_only(),                # nemesis-only (pure-fault run)
+    _normal(),
+], ids=["empty", "nemesis-only", "normal"])
+def test_perf_charts_always_writes_all_three(history, tmp_path):
+    perf_charts(history, str(tmp_path))
+    for name in CHARTS:
+        p = tmp_path / name
+        assert p.exists(), name
+        text = p.read_text()
+        assert text.startswith("<svg"), name
+        assert "</svg>" in text, name
+
+
+def test_perf_charts_unpaired_and_zero_duration(tmp_path):
+    h = History()
+    h.append_row("invoke", "read", None, 0, 0)      # never completes
+    h.append_row("invoke", "write", [None, 1], 1, 0)
+    h.append_row("ok", "write", [None, 1], 1, 0)    # zero latency
+    perf_charts(h, str(tmp_path))
+    for name in CHARTS:
+        assert (tmp_path / name).exists()
+
+
+@pytest.mark.parametrize("history", [
+    History(), _nemesis_only(), _normal(),
+], ids=["empty", "nemesis-only", "normal"])
+def test_timeline_renders(history, tmp_path):
+    path = str(tmp_path / "timeline.html")
+    doc = render_timeline(history, path)
+    assert os.path.exists(path)
+    assert "<html" in doc and "</html>" in doc
+
+
+def test_timeline_escapes_process_and_values(tmp_path):
+    h = History()
+    h.append_row("invoke", "read", "<script>alert(1)</script>",
+                 "c0:<p>", 0)
+    h.append_row("ok", "read", "<script>alert(1)</script>",
+                 "c0:<p>", 1_000_000)
+    doc = render_timeline(h)
+    assert "<script>alert" not in doc
+    assert "c0:<p></span>" not in doc
+
+
+def test_svg_chart_drops_non_finite_points_and_escapes():
+    svg = svg_chart({"a<b": {"points": [(0, 1), (1, math.nan),
+                                        (2, math.inf), (3, 2)]}},
+                    "t<itle", "x<", "y<", log_y=True)
+    assert "nan" not in svg.lower()
+    assert "a<b</text>" not in svg and "a&lt;b" in svg
+    assert "t&lt;itle" in svg
+
+
+def test_svg_chart_all_non_finite_is_no_data():
+    svg = svg_chart({"a": {"points": [(0, math.nan)]}}, "T", "x", "y")
+    assert "no data" in svg
+
+
+def test_fleet_heatmap_basic(tmp_path):
+    records = []
+    for c in range(3):
+        for w in range(5):
+            records.append({"type": "window", "cluster": c, "window": w,
+                            "lat_ms": {"count": 1, "p50": 1.0,
+                                       "p95": 2.0, "p99": float(c + w),
+                                       "max": 3.0}})
+    records.append({"type": "final", "cluster": 0, "lat_ms": {}})
+    path = str(tmp_path / "hm.svg")
+    svg = fleet_heatmap(records, path)
+    assert os.path.exists(path)
+    assert svg.startswith("<svg") and "</svg>" in svg
+    assert svg.count("<rect") >= 15          # one cell per window
+    assert "Fleet telemetry" in svg
+
+
+def test_fleet_heatmap_empty_and_missing_metric(tmp_path):
+    svg = fleet_heatmap([])
+    assert "no window records" in svg
+    # windows with no lat_ms block render grey cells, no exception
+    svg2 = fleet_heatmap([{"type": "window", "cluster": 0, "window": 0}])
+    assert "#eee" in svg2
