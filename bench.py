@@ -1246,6 +1246,128 @@ def _main_compartment():
         sys.exit(1)
 
 
+def bench_failover_record() -> dict:
+    """Leader failover under forced sequencer kills (doc/compartment.md
+    "leader election"): `--nemesis-targets kill=sequencer` repeatedly
+    kills the LIVE elected leader at the PR 9 acceptance shape
+    (leader_slots=128 / inbox 16, 2x2 grid, 2 replicas) with a
+    3-candidate sequencer tier, and the record reports
+
+      - mean/max rounds from candidacy to a won election
+        (`rounds_to_leader`, off the device election counters),
+      - completed failovers (must reach the forced-kill count),
+      - client-ops/s BEFORE / DURING / AFTER the kill windows (virtual
+        throughput segmented by the history's start-kill/stop-kill
+        ops — the availability dip made a number),
+      - the availability block's longest no-ok gap and dip count.
+
+    Gates: every run must grade linearizable and complete >= 2
+    failovers — a failover bench that lost data or never failed over
+    measured nothing."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from maelstrom_tpu import core
+
+    rate = float(os.environ.get("BENCH_FAILOVER_RATE", 200.0))
+    tl = float(os.environ.get("BENCH_FAILOVER_TIME_LIMIT", 6.0))
+    interval = float(os.environ.get("BENCH_FAILOVER_INTERVAL", 0.7))
+    root = tempfile.mkdtemp(prefix="bench-failover-")
+    try:
+        t0 = time.perf_counter()
+        res = core.run(dict(
+            store_root=root, seed=11, workload="lin-kv",
+            node="tpu:compartment",
+            roles="sequencers=3,proxies=4,acceptors=2x2,replicas=2",
+            concurrency=48, rate=rate, time_limit=tl,
+            journal_rows=False, audit=False,
+            leader_slots=128, proxy_slots=8, compartment_inbox=16,
+            kv_keys=1024, timeout_ms=400,
+            nemesis={"kill"}, nemesis_interval=interval,
+            nemesis_targets="kill=sequencer", recovery_s=2))
+        wall = time.perf_counter() - t0
+        ms_pr = 1.0
+        ns_pr = ms_pr * 1e6
+        # segment ok completions by the kill windows
+        kills, heals, oks = [], [], []
+        with open(os.path.join(root, "latest", "history.jsonl")) as f:
+            for ln in f:
+                o = json.loads(ln)
+                if o.get("process") == "nemesis" \
+                        and o.get("type") == "invoke":
+                    if o.get("f") == "start-kill":
+                        kills.append(o["time"] / ns_pr)
+                    elif o.get("f") == "stop-kill":
+                        heals.append(o["time"] / ns_pr)
+                elif o.get("type") == "ok":
+                    oks.append(o["time"] / ns_pr)
+        end_r = tl * 1000.0 / ms_pr
+        first_kill = min(kills) if kills else float("inf")
+
+        def window_close(k):
+            # the heal that closes THIS kill window; a kill the run
+            # ended inside (no later stop-kill) closes at run end, so
+            # windows never go negative
+            return min((h for h in heals if h >= k), default=end_r)
+
+        # where the LAST kill window closed — not the final generator's
+        # trailing stop-kill at run end
+        last_heal = max((window_close(k) for k in kills), default=0.0)
+        in_window = sum(1 for t in oks
+                        for k in kills if k <= t <= window_close(k))
+        windows_r = sum(window_close(k) - k for k in kills)
+        before = sum(1 for t in oks if t < first_kill)
+        after = sum(1 for t in oks if t > last_heal)
+        seg = {
+            "before": round(before / max(first_kill / 1000.0, 1e-9), 1),
+            "during": round(in_window / max(windows_r / 1000.0, 1e-9),
+                            1),
+            "after": round(after / max((end_r - last_heal) / 1000.0,
+                                       1e-9), 1),
+        }
+        avail = res.get("availability", {})
+        elect = avail.get("election", {})
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "failovers": elect.get("failovers", 0),
+        "forced_kills": len(kills),
+        "rounds_to_leader": elect.get("rounds-to-leader"),
+        "client_ops_per_vsec": seg,
+        "longest_ok_gap_rounds": avail.get("longest-ok-gap-rounds"),
+        "dip_count": avail.get("dip-count"),
+        "dip_threshold_rounds": avail.get("dip-threshold-rounds"),
+        "offered_rate": rate, "time_limit_s": tl,
+        "nemesis_interval_s": interval,
+        "wall_s": round(wall, 3),
+        "host_cpus": os.cpu_count(),
+        "devices": jax.device_count(),
+        "valid": res["valid"] is True,
+    }
+
+
+def _main_failover():
+    """`BENCH_MODE=failover`: the leader-failover record as its own
+    artifact, headline `value` = max rounds-to-new-leader (same
+    JSON-line contract as the other modes). Exits nonzero when the run
+    graded invalid or fewer than 2 failovers completed."""
+    rec = bench_failover_record()
+    rtl = rec.get("rounds_to_leader") or {}
+    record = {
+        "metric": "failover_rounds_to_new_leader_max",
+        "value": rtl.get("max"),
+        "unit": "rounds",
+        "vs_baseline": None,
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"] or rec["failovers"] < 2:
+        sys.exit(1)
+
+
 def main():
     from maelstrom_tpu.util import honor_jax_platforms
     honor_jax_platforms()   # JAX_PLATFORMS=cpu smoke runs; no-op unset
@@ -1261,6 +1383,9 @@ def main():
     elif mode == "compartment":
         metric, unit = "compartment_client_ops_per_vsec", "client-ops/vsec"
         fn = _main_compartment
+    elif mode == "failover":
+        metric, unit = "failover_rounds_to_new_leader_max", "rounds"
+        fn = _main_failover
     elif mode == "stream":
         metric, unit = "stream_kafka_msgs_per_sec", "msgs/sec"
         fn = _main_stream

@@ -56,6 +56,18 @@ WORKLOAD_NODES = {
     # gate traces the RolePartition step path too
     "compartment": {"workload": "lin-kv", "node": "tpu:compartment",
                     "opts": {"node_count": None}},
+    # the ELECTED configuration (sequencers > 1, doc/compartment.md
+    # "leader election") compiles a different sequencer/acceptor/proxy
+    # step body — phase-1 prepare/promise, recovery queries, ballot
+    # fencing — under the full fault soup, so the gate traces it as its
+    # own program
+    "compartment-failover": {
+        "workload": "lin-kv", "node": "tpu:compartment",
+        "opts": {"node_count": None,
+                 "roles": "sequencers=3,proxies=2,acceptors=2x2,"
+                          "replicas=2",
+                 "nemesis": {"kill", "pause", "partition",
+                             "duplicate"}}},
     "lin-tso": {"workload": "lin-tso", "node": "tpu:services",
                 "opts": {"node_count": None}},
 }

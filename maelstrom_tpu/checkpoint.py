@@ -119,7 +119,17 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # shape the wire traffic and the nemesis schedule
                     "roles", "service_roles", "nemesis_targets",
                     "leader_slots", "proxy_slots", "compartment_inbox",
-                    "compartment_retry", "log_cap", "kv_keys")
+                    "compartment_retry", "log_cap", "kv_keys",
+                    # leader election (doc/compartment.md): the
+                    # candidate set rides `roles` (sequencers=S); the
+                    # failure-detector deadline and fenced ballot width
+                    # shape the election schedule, so a resume must
+                    # match them exactly — as do the client backoff
+                    # knobs, which set the redirect-requeue due rounds
+                    # (TpuRunner._backoff_rounds) and budget
+                    "election_timeout_rounds", "ballot_width",
+                    "client_retries", "client_backoff_ms",
+                    "client_backoff_cap_ms")
 
 
 class CheckpointError(RuntimeError):

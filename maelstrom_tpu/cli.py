@@ -62,10 +62,27 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--roles", default=None,
                    help="Role-partitioned cluster tiers for --node "
                         "tpu:compartment (doc/compartment.md): "
-                        "'proxies=P,acceptors=RxC,replicas=R' (a plain "
-                        "acceptor count is a 1-row grid). Sizes the "
-                        "cluster: 1 leader + P + R*C + R nodes — drop "
-                        "--node-count and let --roles derive it")
+                        "'sequencers=S,proxies=P,acceptors=RxC,"
+                        "replicas=R' (a plain acceptor count is a "
+                        "1-row grid). Sizes the cluster: S + P + R*C + "
+                        "R nodes — drop --node-count and let --roles "
+                        "derive it. sequencers > 1 makes the leader "
+                        "ELECTED (ballot-numbered MultiPaxos phase 1): "
+                        "kills of the live sequencer fail over instead "
+                        "of stalling")
+    t.add_argument("--election-timeout-rounds", type=int, default=None,
+                   help="Failure-detector deadline for sequencer "
+                        "elections, in virtual rounds (default 60; "
+                        "needs --roles sequencers>1)")
+    t.add_argument("--ballot-width", type=int, default=None,
+                   help="Fenced election ballot-counter width in bits "
+                        "(<= 6, default 6); overflow stalls failover "
+                        "and invalidates the run visibly")
+    t.add_argument("--timeout-ms", type=float, default=None,
+                   help="Client RPC timeout in virtual ms (default "
+                        "5000). Failover runs want it tight: ops in "
+                        "flight to a killed leader hold their worker "
+                        "for exactly this window")
     t.add_argument("--service-roles", default=None,
                    help="In-cluster service tiers for --node "
                         "tpu:services: 'lin-tso=1,seq-kv=1,lww-kv=N' "
@@ -75,7 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(role-partitioned nodes only), e.g. "
                         "'kill=proxies,partition=acceptor-col-0': kill/"
                         "pause sample within the group, partition cuts "
-                        "the group off the rest of the cluster. Groups "
+                        "the group off the rest of the cluster; "
+                        "'kill=sequencer' targets the LIVE elected "
+                        "leader (the failover driver). Groups "
                         "come from the node family's fault_groups "
                         "(role names, acceptor grid rows/columns) or "
                         "literal node names; '+' joins several")
@@ -382,7 +401,8 @@ def opts_from_args(args) -> dict:
               "fleet", "fleet_sweep", "nemesis_seed",
               "kafka_groups", "session_timeout_ms", "poll_batch",
               "continuous_window_ms", "batch_max", "max_values",
-              "roles", "service_roles", "nemesis_targets"):
+              "roles", "service_roles", "nemesis_targets",
+              "election_timeout_rounds", "ballot_width", "timeout_ms"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

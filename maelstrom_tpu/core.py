@@ -112,6 +112,15 @@ DEFAULTS = dict(
     # `nemesis_targets` scopes fault packages to named role groups
     # ("kill=proxies,partition=acceptor-col-0")
     roles=None, service_roles=None, nemesis_targets=None,
+    # leader election + failover (doc/compartment.md "leader
+    # election"): with --roles sequencers=S (S > 1) the compartment's
+    # sequencer is ELECTED — ballot-numbered MultiPaxos phase 1 over
+    # the acceptor grid. election_timeout_rounds is the failure-
+    # detector deadline, ballot_width the fenced ballot-counter width
+    # (bits, <= 6); availability_dip_rounds overrides the availability
+    # checker's dip threshold (default: the RPC timeout in rounds).
+    election_timeout_rounds=60, ballot_width=6,
+    availability_dip_rounds=None,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user

@@ -46,9 +46,10 @@ VOLATILE_RESULT_KEYS = ("net", "analysis-pipeline", "resumed-at-round")
 
 # Wall-clock blocks nested inside a checker's own result (the windowed
 # stream grading carries checker lag, and the window layout depends on
-# drain cadence — doc/streams.md); the verdict fields beside them must
-# still match bit-for-bit.
-VOLATILE_SUBRESULT_KEYS = ("windows", "checker-lag")
+# drain cadence — doc/streams.md; the availability block is virtual-
+# round deterministic EXCEPT its own check wall time); the verdict
+# fields beside them must still match bit-for-bit.
+VOLATILE_SUBRESULT_KEYS = ("windows", "checker-lag", "check-wall-s")
 
 # Fleet results additionally inline the fleet-level TransferStats
 # accounting at the top level (one transfer ledger for the whole fleet)

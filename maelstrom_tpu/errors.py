@@ -104,6 +104,16 @@ ABORT = deferror(
     definite=True)
 
 
+NOT_LEADER = deferror(
+    31, "not-leader",
+    "The contacted node is not the cluster's current leader, so the "
+    "operation definitely did not execute. The error body may carry a "
+    "`hint` naming the node the sender believes leads (-1 when no live "
+    "leader is known, e.g. mid-election); clients should retry against "
+    "the hint under backoff (doc/compartment.md 'leader election').",
+    definite=True)
+
+
 class RPCError(Exception):
     """An error body returned by a node in response to an RPC
     (reference `client.clj:186-199`)."""

@@ -198,28 +198,39 @@ def parse_targets(spec) -> dict:
     return items
 
 
-def resolve_targets(spec, groups: dict, nodes) -> dict | None:
+def resolve_targets(spec, groups: dict, nodes, dynamic=()) -> dict | None:
     """Resolves a target spec's group tokens against the node family's
     fault groups (`NodeProgram.fault_groups`: role names, acceptor grid
     rows/columns, ...) plus literal node names. Returns
     {fault: [node names]} for `NemesisDecisions`, or None when no
-    targeting was requested."""
+    targeting was requested.
+
+    `dynamic` names groups the program resolves at INVOKE time
+    (`NodeProgram.dynamic_fault_groups`, e.g. the compartment's
+    `sequencer` = the live elected leader): their tokens stay symbolic
+    (``"@<token>"``) in the output and the executing nemesis expands
+    them against live cluster state when the fault fires — the
+    kill-as-failover driver (doc/faults.md)."""
     parsed = parse_targets(spec)
     if not parsed:
         return None
     node_set = set(nodes)
+    dynamic = set(dynamic or ())
     out: dict = {}
     for fault, tokens in parsed.items():
         names: list = []
         for tok in tokens:
-            if tok in groups:
+            if tok in dynamic:
+                members = [f"@{tok}"]
+            elif tok in groups:
                 members = groups[tok]
             elif tok in node_set:
                 members = [tok]
             else:
                 raise ValueError(
                     f"--nemesis-targets: unknown group {tok!r} for "
-                    f"{fault!r}; known groups: {sorted(groups)} "
+                    f"{fault!r}; known groups: "
+                    f"{sorted(set(groups) | dynamic)} "
                     f"(or a literal node name)")
             names += [n for n in members if n not in names]
         if not names:
@@ -266,11 +277,35 @@ class NemesisDecisions:
         self.rng = self.rngs["partition"]
         # role-targeted scoping (resolve_targets): {fault: [node names]}
         # restricts kill/pause sampling to the named pool and turns
-        # partition draws into the deterministic isolate-the-set grudge
+        # partition draws into the deterministic isolate-the-set grudge.
+        # "@<group>" entries are DYNAMIC: expanded against live cluster
+        # state at invoke time via `resolve_dynamic` (set by the
+        # executing runner's nemesis — e.g. "@sequencer" -> the elected
+        # leader, the kill-as-failover driver)
         self.targets = dict(targets or {})
+        self.resolve_dynamic = None
+
+    def _expand_pool(self, pool):
+        """Expands dynamic "@group" markers against live cluster state.
+        Deterministic per seed on the TPU path: the state the resolver
+        reads is itself a pure function of the seed."""
+        if pool is None:
+            return None
+        out: list = []
+        for t in pool:
+            if isinstance(t, str) and t.startswith("@"):
+                if self.resolve_dynamic is None:
+                    raise ValueError(
+                        f"dynamic nemesis target {t!r} needs a live "
+                        f"runner to resolve it (TPU path only)")
+                out += [n for n in self.resolve_dynamic(t[1:])
+                        if n not in out]
+            elif t not in out:
+                out.append(t)
+        return out
 
     def next_grudge(self):
-        tg = self.targets.get("partition")
+        tg = self._expand_pool(self.targets.get("partition"))
         if tg:
             return isolate_set(self.nodes, tg)
         rng = self.rngs["partition"]
@@ -283,9 +318,11 @@ class NemesisDecisions:
         minority; there the package still targets one node, accepting a
         transient quorum loss that heals at the stop op. With a
         role-targeted pool (`--nemesis-targets`), the minority is taken
-        OF THE POOL — 'kill a proxy' kills within the proxy tier."""
+        OF THE POOL — 'kill a proxy' kills within the proxy tier, and
+        'kill the sequencer' (a dynamic @group resolving to the live
+        leader) is a forced failover."""
         rng = self.rngs[fault]
-        pool = self.targets.get(fault) or self.nodes
+        pool = self._expand_pool(self.targets.get(fault)) or self.nodes
         k = rng.randint(1, max(1, (len(pool) - 1) // 2))
         return sorted(rng.sample(pool, k))
 

@@ -238,6 +238,27 @@ class NodeProgram:
         {stat-name: int array} (summed and reported per counter)."""
         return {}
 
+    # --- movable-role fault targeting + client redirect hooks ---
+    #
+    # Programs with a MOVABLE role (an elected leader) may additionally
+    # implement, all consumed via getattr by the runner/nemesis:
+    #   - dynamic_fault_groups() -> tuple of target-group names resolved
+    #     at fault-invoke time (e.g. "sequencer" -> the live leader;
+    #     `--nemesis-targets kill=sequencer` becomes a failover driver);
+    #   - current_leader_host(nodes_host) -> global node id, from a host
+    #     copy of the state tree (the dynamic-group resolver);
+    #   - redirect_hint(error_body) -> hinted node id / -1 / None — a
+    #     not-leader reply the runner requeues under seeded backoff
+    #     instead of completing, plus next_probe(contacted),
+    #     note_leader(i), note_timeout(i) to steer the host-side guess;
+    #   - election_report(nodes_host) -> accounting dict for
+    #     checkers/availability.py (failovers, rounds-to-leader, ...).
+
+    def dynamic_fault_groups(self) -> tuple:
+        """Fault-target groups resolved against live cluster state at
+        invoke time; () for programs whose roles never move."""
+        return ()
+
 
 def edge_timing(opts: dict, n_nodes: int) -> tuple[int, int, int]:
     """Shared edge-channel sizing: (ring, retry_rounds, lat_rounds).

@@ -1,5 +1,5 @@
-"""Compartmentalized consensus: role-partitioned proxy/acceptor/replica
-tiers serving lin-kv.
+"""Compartmentalized consensus: role-partitioned sequencer/proxy/
+acceptor/replica tiers serving lin-kv, with live leader failover.
 
 "Scaling Replicated State Machines with Compartmentalization" (PAPERS.md,
 arxiv 2012.15762) decouples MultiPaxos' leader into independently-scalable
@@ -14,86 +14,148 @@ message budget — the claim `bench.py BENCH_MODE=compartment` measures
 This is the first user of `sim.RolePartition` (the multi-program
 node-state tree): four roles over contiguous node-id ranges,
 
-    node 0                      leader     (sequencer, durable)
-    nodes [1, 1+P)              proxies    (stateless, VOLATILE: a kill
-                                            wipes them; the leader's
+    nodes [0, S)                sequencers (candidates; node 0 leads at
+                                            ballot 0 — durable)
+    nodes [S, S+P)              proxies    (stateless, VOLATILE: a kill
+                                            wipes them; the live leader's
                                             resend rebuilds their work)
-    nodes [1+P, 1+P+A)          acceptors  (rows x cols grid, durable)
-    nodes [1+P+A, N)            replicas   (apply the log, durable)
+    nodes [S+P, S+P+A)          acceptors  (rows x cols grid, durable)
+    nodes [S+P+A, N)            replicas   (apply the log, durable)
 
-selected with `--node tpu:compartment --roles proxies=P,acceptors=RxC,
-replicas=R` and graded by the stock linearizable register checker.
+selected with `--node tpu:compartment --roles sequencers=S,proxies=P,
+acceptors=RxC,replicas=R` and graded by the stock linearizable register
+checker.
 
-Protocol (stable-leader MultiPaxos phase 2, simplified: the leader never
-changes, so ballots are unnecessary — slot ownership is unique by
-construction and every stage is idempotent):
+Phase 2 (the stable-leader pipeline, PR 9):
 
-  1. clients send read/write/cas to the leader (reads are logged too, so
-     every op linearizes at its apply point, like `nodes/raft.py`);
+  1. clients send read/write/cas to the sequencer they believe leads
+     (reads are logged too, so every op linearizes at its apply point,
+     like `nodes/raft.py`);
   2. the leader assigns the next slot, parks the command in a durable
      in-flight table, and sends T_ASSIGN to proxy `slot % P` — resending
      on a retry tick until the command is fully executed, which makes
      the leader the retry root: a crashed (volatile) proxy loses
      nothing, the next resend rebuilds its state;
   3. the proxy broadcasts T_P2A to all acceptors and collects T_P2B acks
-     per GRID ROW; any complete row is a write quorum (the paper's
-     flexible grid quorums: phase-1 — which we never run — would read
-     columns, so killing a full column stalls writes but loses nothing);
+     per GRID ROW; any complete row is a write quorum;
   4. on quorum the proxy teaches all replicas (T_LEARN) until every
-     replica acks STORAGE (T_EXEC), then reports T_DONE to the leader;
-  5. replicas store learned commands at their slots — EVERY deduped
-     learn is acked the moment it is durably stored, so a slot's
-     leader->proxy->replica chain completes independently of every
-     other slot (acking at the apply point instead deadlocks: the
-     proxy table fills with high slots that can never apply while the
-     low slots they wait on can never be admitted) — and apply strictly
-     in slot order, the DESIGNATED replica (`slot % R`) answering the
-     client with the value computed at the apply point. Re-learns of
-     stored slots re-ack (never re-reply), so lost acks always recover;
-     liveness holds because the leader retires a slot only once all
-     replicas stored it, so every gap below a stored slot is itself a
-     slot the leader is still pushing to storage.
+     replica acks STORAGE (T_EXEC), then reports T_DONE to the
+     assigning leader (`ballot % S`);
+  5. replicas store learned commands at their slots, acking every
+     deduped learn the moment it is durably stored (apply-point acks
+     would deadlock the proxy table behind slot gaps), apply strictly
+     in slot order, and the DESIGNATED replica (`slot % R`) answers the
+     client with the apply-point value.
 
-Loss, partitions, duplication, pause, and kill therefore only delay:
-duplicates are slot-keyed no-ops, resends are idempotent overwrites of
-identical values, and the only permanent state is fsynced-before-action
-(leader table, acceptor grid, replica log — `durable_keys = None`).
+Phase 1 (leader election and recovery — this module's `sequencers=S`
+extension; with S == 1 all of it compiles out and the cluster is
+byte-identical to the PR 9 stable-leader program):
+
+  - Ballots are `k * S + candidate_id`: every candidate owns a disjoint
+    residue class, so ballots are globally unique without coordination.
+    A candidate's own ballot floor is DURABLE (a restarted candidate can
+    never reuse a ballot it already burned).
+  - Failure detection: the elected leader heartbeats the other
+    candidates (T_HB) on the retry tick; a candidate whose deadline
+    expires (election_timeout_rounds + a per-candidate stagger + a
+    seeded per-round jitter, the raft idiom) starts a candidacy after
+    its randomized backoff — competing candidates converge
+    deterministically per seed on both the plain and mesh paths.
+  - Prepare/promise runs over the acceptor grid with COLUMN quorums:
+    phase-2 write quorums are rows, and every column intersects every
+    row in exactly one cell, so a promised column fences every past and
+    future row quorum at a lower ballot. (Promising rows instead would
+    NOT intersect other rows — the grid geometry is the safety
+    argument.) Acceptors persist `promised` and reject stale T_PREP
+    (T_REJP) and stale-ballot T_P2A (T_P2R), so a deposed sequencer can
+    never split the log: its in-flight T_ASSIGN/T_P2A traffic dies at
+    the grid.
+  - Recovery: promises carry each acceptor's max accepted slot AND its
+    commit watermark (the highest contiguous slot some leader saw
+    DONE — stored on ALL replicas — piggybacked to the grid as T_CMT
+    on the retry tick, durable and monotone at the acceptor). The
+    winner takes `next_slot = hi + 1` and pulls only the slots in
+    (watermark, hi] into its table in QUERY phase — recovery work is
+    bounded by the in-flight window, NOT the history length, which is
+    what keeps late-run failover dips flat — T_QRY fans to the grid, T_QVAL answers
+    with the acceptor's (cmd, accepted-ballot), and a COLUMN quorum of
+    answers resolves the slot to the highest-ballot value (or a NO-OP
+    when none was accepted: gaps must fill or the replicas' in-order
+    apply stalls forever). Resolved slots re-propose through the normal
+    proxy path at the new ballot with mid = -1 (recovered commands
+    never re-reply; their clients timed out as indefinite info ops).
+  - Proxies carry the assigning ballot end to end (T_ASSIGN packs it,
+    T_P2A/T_P2B echo it): a higher-ballot assign REPLACES a stale row
+    for the same slot, a stale assign is dropped, and a T_P2R nack
+    drops the row and notifies the stale leader (T_NLDR), which steps
+    down and drops its table.
+  - Clients: a non-leading sequencer answers T_ERR code 31 (not-leader)
+    with a hint (the candidate owning the highest live ballot it has
+    heard, or -1 mid-election); the host runner follows hints under
+    seeded exponential backoff (doc/compartment.md "election section").
+
+Loss, partitions, duplication, pause, and kill therefore only delay —
+and killing the live sequencer (`--nemesis-targets kill=sequencer`) is a
+FAILOVER, not durable downtime: an availability dip bounded by the
+failure-detector timeout plus the election+recovery window, never a
+linearizability violation (`checkers/availability.py` measures exactly
+this claim).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..net.tpu import I32, Msgs, cat_lanes as _cat_lanes
 from ..sim import RolePartition
 from . import NodeProgram, register
 from .raft import (LinKVWire, T_READ, T_WRITE, T_CAS,
-                   OP_WRITE, OP_CAS, OP_READ)
+                   OP_NOOP, OP_WRITE, OP_CAS, OP_READ)
 
 # client wire codes (shared with raft via LinKVWire): 10..15
 T_ERR = 1
 T_READ_OK = 11
 T_WRITE_OK = 13
 T_CAS_OK = 15
-# compartment RPCs
-T_ASSIGN = 30    # leader -> proxy:    a = client<<16|slot, b = cmd, c = mid
-T_P2A = 31       # proxy -> acceptor:  a = slot, b = cmd
-T_P2B = 32       # acceptor -> proxy:  a = slot, b = acceptor grid index
-T_LEARN = 33     # proxy -> replica:   a = client<<16|slot, b = cmd, c = mid
+# compartment phase-2 RPCs
+T_ASSIGN = 30    # leader -> proxy:    a = packed(bal, client, slot), b = cmd, c = mid
+T_P2A = 31       # proxy -> acceptor:  a = slot, b = cmd, c = ballot
+T_P2B = 32       # acceptor -> proxy:  a = slot, b = acceptor grid index, c = ballot
+T_LEARN = 33     # proxy -> replica:   a = packed(client, slot), b = cmd, c = mid
 T_EXEC = 34      # replica -> proxy:   a = slot, b = replica index
 T_DONE = 35      # proxy -> leader:    a = slot
+# phase-1 (election + recovery) RPCs — only ever sent when S > 1
+T_PREP = 36      # candidate -> acceptor: a = ballot
+T_PROM = 37      # acceptor -> candidate: a = ballot, b = grid index, c = hi+1
+T_REJP = 38      # acceptor -> candidate: a = rejected ballot, c = promised
+T_P2R = 39       # acceptor -> proxy:     a = slot, b = grid index, c = promised
+T_QRY = 40       # leader -> acceptor:    a = slot, c = ballot
+T_QVAL = 41      # acceptor -> leader:    a = slot, b = cmd, c = idx<<16 | bal+1
+T_HB = 42        # leader -> candidates:  a = ballot
+T_NLDR = 43      # proxy -> stale leader: a = higher ballot seen
+T_CMT = 44       # leader -> acceptor:    a = done-frontier watermark
 
-_DEFAULT_ROLES = {"proxies": 2, "rows": 2, "cols": 2, "replicas": 2}
-DEFAULT_ROLES = "proxies=2,acceptors=2x2,replicas=2"
+# protocol error codes on the client surface
+E_UNAVAILABLE = 11   # leader table full: definite backpressure shed
+E_NOT_LEADER = 31    # contacted sequencer does not lead; b = hint or -1
+
+NOOP_CMD = 0         # key 0 / OP_NOOP: fills recovered gaps, applies inert
+
+_DEFAULT_ROLES = {"sequencers": 1, "proxies": 2, "rows": 2, "cols": 2,
+                  "replicas": 2}
+DEFAULT_ROLES = "sequencers=1,proxies=2,acceptors=2x2,replicas=2"
 
 
 def parse_roles(spec) -> dict:
-    """`--roles proxies=P,acceptors=RxC,replicas=R` -> {proxies, rows,
-    cols, replicas}; omitted roles keep their defaults. A plain
+    """`--roles sequencers=S,proxies=P,acceptors=RxC,replicas=R` ->
+    {sequencers, proxies, rows, cols, replicas}; omitted roles keep
+    their defaults (one stable sequencer — the PR 9 shape). A plain
     acceptor count A is a 1 x A grid (single row: the write quorum is
-    all acceptors)."""
+    all acceptors, the phase-1 quorum any single acceptor)."""
     spec = spec or DEFAULT_ROLES
-    out = {"proxies": None, "rows": None, "cols": None, "replicas": None}
+    out = {"sequencers": None, "proxies": None, "rows": None,
+           "cols": None, "replicas": None}
     for part in str(spec).split(","):
         part = part.strip()
         if not part:
@@ -102,7 +164,9 @@ def parse_roles(spec) -> dict:
         k, val = k.strip(), val.strip()
         if not sep or not val:
             raise ValueError(f"--roles: expected name=count, got {part!r}")
-        if k == "proxies":
+        if k == "sequencers":
+            out["sequencers"] = int(val)
+        elif k == "proxies":
             out["proxies"] = int(val)
         elif k == "acceptors":
             if "x" in val:
@@ -114,8 +178,8 @@ def parse_roles(spec) -> dict:
             out["replicas"] = int(val)
         else:
             raise ValueError(
-                f"--roles: unknown role {k!r} (expected proxies, "
-                f"acceptors, replicas)")
+                f"--roles: unknown role {k!r} (expected sequencers, "
+                f"proxies, acceptors, replicas)")
     for k, v in out.items():
         if v is None:
             out[k] = _DEFAULT_ROLES[k]
@@ -126,39 +190,52 @@ def parse_roles(spec) -> dict:
 
 def roles_node_count(spec) -> int:
     r = parse_roles(spec)
-    return 1 + r["proxies"] + r["rows"] * r["cols"] + r["replicas"]
+    return (r["sequencers"] + r["proxies"] + r["rows"] * r["cols"]
+            + r["replicas"])
 
 
 class Layout:
     """Static shape of one compartmentalized cluster, shared by every
-    role program so bases, capacities, and retry pacing can never
-    disagree."""
+    role program so bases, capacities, ballot packing, and retry pacing
+    can never disagree."""
+
+    # S > 1 wire packing: T_ASSIGN's a-word carries bal<<24 |
+    # client<<12 | slot, so the elected configuration narrows the slot
+    # and client fields (the stable S == 1 configuration keeps the PR 9
+    # client<<16 | slot layout bit-for-bit)
+    SLOT_BITS = 12
+    CLIENT_BITS = 12
+    MAX_BAL_BITS = 6
 
     def __init__(self, opts: dict, n_nodes: int):
         r = parse_roles(opts.get("roles"))
+        self.S = r["sequencers"]
         self.P = r["proxies"]
         self.rows, self.cols = r["rows"], r["cols"]
         self.A = self.rows * self.cols
         self.R = r["replicas"]
         self.n_nodes = n_nodes
-        self.leader = 0
-        self.p_base = 1
-        self.a_base = 1 + self.P
-        self.r_base = 1 + self.P + self.A
-        want = 1 + self.P + self.A + self.R
+        self.leader = 0              # the ballot-0 leader
+        self.s_base = 0
+        self.p_base = self.S
+        self.a_base = self.S + self.P
+        self.r_base = self.S + self.P + self.A
+        want = self.S + self.P + self.A + self.R
         if want != n_nodes:
             raise ValueError(
                 f"--roles {opts.get('roles')!r} needs {want} nodes "
-                f"(1 leader + {self.P} proxies + {self.A} acceptors + "
-                f"{self.R} replicas) but the cluster has {n_nodes}; "
-                f"drop --node-count/--nodes and let --roles size it")
+                f"({self.S} sequencers + {self.P} proxies + {self.A} "
+                f"acceptors + {self.R} replicas) but the cluster has "
+                f"{n_nodes}; drop --node-count/--nodes and let --roles "
+                f"size it")
         # slot capacity scales with the expected op count like raft's
         # log (every client op, reads included, takes a slot)
         rate = float(opts.get("rate") or 0.0)
         tl = float(opts.get("time_limit") or 0.0)
         expected = int(2 * rate * tl) + 256
+        slot_max = (1 << self.SLOT_BITS) - 1 if self.S > 1 else 0x7FFF
         self.cap = int(opts.get("log_cap",
-                                min(max(256, expected), 0x7FFF)))
+                                min(max(256, expected), slot_max)))
         self.keys = int(opts.get("kv_keys", 256))
         conc = int(opts.get("concurrency") or n_nodes)
         # leader in-flight table: the sequencer's fixed capacity (the
@@ -169,15 +246,67 @@ class Layout:
         self.K = int(opts.get("compartment_inbox", 8))
         self.AP = self.K              # replica apply chunk per round
         self.retry = int(opts.get("compartment_retry", 10))
-        # packed-word field widths: slot 15 bits, client 15 bits,
-        # key 12 bits + 2-bit op + two value bytes in the cmd word
-        if self.cap > 0x7FFF:
-            raise ValueError("log_cap must fit 15-bit slots")
+        # election pacing (S > 1; fingerprinted — doc/compartment.md):
+        # the failure-detector deadline and the fenced ballot-counter
+        # width (ballots live in a 6-bit wire field; a narrower width
+        # only lowers the overflow threshold)
+        self.etimeout = int(opts.get("election_timeout_rounds") or 60)
+        self.bal_width = int(opts.get("ballot_width") or self.MAX_BAL_BITS)
+        # packed-word field widths
+        if self.cap > slot_max:
+            raise ValueError(
+                f"log_cap must fit {12 if self.S > 1 else 15}-bit slots "
+                f"(<= {slot_max}{' with sequencers > 1' if self.S > 1 else ''})")
         if self.keys > 4095:
             raise ValueError("kv_keys must fit the 12-bit key field")
-        if conc > 0x7FFF:
-            raise ValueError("concurrency must fit 15-bit client ids")
+        if conc > ((1 << self.CLIENT_BITS) - 1 if self.S > 1 else 0x7FFF):
+            raise ValueError(
+                "concurrency must fit the "
+                f"{self.CLIENT_BITS if self.S > 1 else 15}-bit client id "
+                f"field{' with sequencers > 1' if self.S > 1 else ''}")
+        if self.S > 1:
+            if not 1 <= self.bal_width <= self.MAX_BAL_BITS:
+                raise ValueError(
+                    f"ballot_width must be in [1, {self.MAX_BAL_BITS}], "
+                    f"got {self.bal_width}")
+            if self.A > 30:
+                raise ValueError(
+                    "sequencers > 1 needs the acceptor grid to fit a "
+                    f"31-bit promise mask (A <= 30, got {self.A})")
+            if self.S >= (1 << self.bal_width):
+                raise ValueError(
+                    f"{self.S} sequencers need ballot_width > "
+                    f"{self.bal_width} (each candidate owns a residue "
+                    f"class)")
+            if self.etimeout < 2 * self.retry:
+                raise ValueError(
+                    "election_timeout_rounds must cover at least two "
+                    f"heartbeat ticks (>= {2 * self.retry})")
         self.AR = max(self.A, self.R)
+
+    # --- ballot/client/slot wire packing -------------------------------
+
+    def pack_assign_a(self, bal, client, slot):
+        if self.S == 1:
+            return (client << 16) | slot
+        return (bal << 24) | (client << 12) | slot
+
+    def unpack_assign_a(self, a):
+        """-> (bal, client, slot)."""
+        if self.S == 1:
+            return jnp.zeros_like(a), a >> 16, a & 0x7FFF
+        return (a >> 24) & 0x3F, (a >> 12) & 0xFFF, a & 0xFFF
+
+    def pack_learn_a(self, client, slot):
+        if self.S == 1:
+            return (client << 16) | slot
+        return (client << 12) | slot
+
+    def unpack_learn_a(self, a):
+        """-> (client, slot)."""
+        if self.S == 1:
+            return a >> 16, a & 0x7FFF
+        return (a >> 12) & 0xFFF, a & 0xFFF
 
 
 def _pack_cmd(key, op, v1, v2):
@@ -242,37 +371,96 @@ def _match_rows(row_valid, row_slot, msg_valid, msg_slot):
             & (row_slot[:, :, None] == msg_slot[:, None, :]))
 
 
+def _col_quorum(lay: Layout, bits):
+    """True where the acceptor bitmask `bits` (grid index r*cols+c)
+    covers at least one COMPLETE grid column — the phase-1 quorum that
+    intersects every phase-2 row quorum. Works on any leading shape."""
+    pos = (jnp.arange(lay.rows, dtype=I32)[:, None] * lay.cols
+           + jnp.arange(lay.cols, dtype=I32)[None, :])     # [rows, cols]
+    have = ((bits[..., None, None] >> pos) & 1).astype(bool)
+    return have.all(axis=-2).any(axis=-1)
+
+
 def _out(shape, **fields) -> Msgs:
     out = Msgs.empty(shape)
     return out.replace(**fields)
 
 
-class LeaderRole(NodeProgram):
-    """The sequencer: assigns slots, parks commands in a durable
-    in-flight table, resends T_ASSIGN on the retry tick until T_DONE —
-    the retry root that makes volatile proxies safe. O(1) messages per
-    command: its fixed table/inbox budget is the 'leader capacity' the
-    proxy tier scales past."""
+class SequencerRole(NodeProgram):
+    """The sequencer candidates: slot assignment + the in-flight table
+    (the retry root that makes volatile proxies safe) PLUS, with S > 1,
+    ballot-numbered MultiPaxos phase 1 — failure detection, column-
+    quorum prepare/promise, in-flight slot recovery, and client
+    redirects. All state is durable: ballot floors, the table, and an
+    in-progress candidacy ride the durable store, so a mid-election
+    kill/restart (or checkpoint/SIGKILL-resume) continues exactly where
+    it stopped."""
 
-    name = "compartment-leader"
+    name = "compartment-sequencer"
     durable_keys = None          # sequencer state fsyncs before acting
 
     def __init__(self, opts, nodes, lay: Layout):
         super().__init__(opts, nodes)
         self.lay = lay
         self.inbox_cap = lay.K
-        self.outbox_cap = lay.QL + lay.K
+        if lay.S == 1:
+            self.outbox_cap = lay.QL + lay.K
+        else:
+            # per-row fan lanes (T_ASSIGN on lane 0 / T_QRY per
+            # acceptor) + prepare lanes + commit-watermark lanes +
+            # heartbeat lanes + client shed/redirect lanes
+            self.outbox_cap = (lay.QL * lay.AR + 2 * lay.A + lay.S
+                               + lay.K)
 
     def init_state(self):
         n, Q = self.n_nodes, self.lay.QL
         z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
-        return {"next_slot": z(n),
-                "t_valid": jnp.zeros((n, Q), bool),
-                "t_slot": z(n, Q), "t_cmd": z(n, Q),
-                "t_client": z(n, Q), "t_mid": z(n, Q),
-                "t_last": jnp.full((n, Q), -(1 << 20), I32)}
+        st = {"next_slot": z(n),
+              "t_valid": jnp.zeros((n, Q), bool),
+              "t_slot": z(n, Q), "t_cmd": z(n, Q),
+              "t_client": z(n, Q), "t_mid": z(n, Q),
+              "t_last": jnp.full((n, Q), -(1 << 20), I32)}
+        if self.lay.S > 1:
+            me = jnp.arange(n, dtype=I32)
+            st.update({
+                # ballots: own floor (durable monotonic), highest seen
+                "bal": z(n), "seen": z(n),
+                "leading": me == 0,            # node 0 leads at ballot 0
+                "electing": jnp.zeros(n, bool),
+                "prom": z(n),                  # promise bitmask (grid idx)
+                "cand_round": z(n),
+                "rec_hi": jnp.full((n,), -1, I32),
+                "rec_next": z(n),
+                # failure detector + pacing
+                "heard": z(n),
+                "deadline": (jnp.full((n,), self.lay.etimeout, I32)
+                             + me * 2 * self.lay.retry),
+                "boff": z(n),
+                "hb_last": jnp.full((n,), -(1 << 20), I32),
+                "elect_last": jnp.full((n,), -(1 << 20), I32),
+                # per-row ballot + recovery-query bookkeeping
+                "t_bal": z(n, Q),
+                "t_q": jnp.zeros((n, Q), bool),
+                "t_qmask": z(n, Q),
+                "t_qbal": jnp.full((n, Q), -1, I32),
+                # commit watermark: done_bits marks slots retired via
+                # T_DONE (stored on ALL replicas); dfront is the
+                # contiguous frontier piggybacked to the grid (T_CMT)
+                # so the NEXT leader's recovery skips the completed
+                # prefix
+                "done_bits": jnp.zeros((n, self.lay.cap), bool),
+                "dfront": jnp.full((n,), -1, I32),
+                # election accounting (checkers/availability.py)
+                "won_count": z(n), "won_sum": z(n), "won_max": z(n),
+                "bal_overflow": z(n)})
+        return st
 
-    def step(self, state, inbox, ctx):
+    # ------------------------------------------------------------------
+    # S == 1: the PR 9 stable-leader path, bit-for-bit (no ballots, no
+    # elections, legacy client<<16|slot packing, Q-lane outbox)
+    # ------------------------------------------------------------------
+
+    def _step_stable(self, state, inbox, ctx):
         lay, rnd = self.lay, ctx["round"]
         n, Q, K, C = self.n_nodes, lay.QL, lay.K, lay.cap
         s = dict(state)
@@ -313,7 +501,7 @@ class LeaderRole(NodeProgram):
         shed = creq & ~do
         shed_out = _out((n, K), valid=shed, dest=inbox.src,
                         type=jnp.full((n, K), T_ERR, I32),
-                        a=jnp.full((n, K), 11, I32),
+                        a=jnp.full((n, K), E_UNAVAILABLE, I32),
                         reply_to=inbox.mid)
 
         # T_ASSIGN resends: every live row on the retry tick
@@ -327,8 +515,308 @@ class LeaderRole(NodeProgram):
             b=s["t_cmd"], c=s["t_mid"])
         return s, _cat_lanes(assign_out, shed_out)
 
+    # ------------------------------------------------------------------
+    # S > 1: ballot-numbered elections + recovery + fenced assignment
+    # ------------------------------------------------------------------
+
+    def _step_elect(self, state, inbox, ctx):
+        lay, rnd = self.lay, ctx["round"]
+        n, Q, K, C = self.n_nodes, lay.QL, lay.K, lay.cap
+        A, S = lay.A, lay.S
+        s = dict(state)
+        v = inbox.valid
+        me = jnp.arange(n, dtype=I32)
+
+        # ---- observe ballots: heartbeats, depose notices, rejections
+        is_hb = v & (inbox.type == T_HB)
+        is_nl = v & (inbox.type == T_NLDR)
+        is_rj = v & (inbox.type == T_REJP)
+        obs = jnp.max(jnp.where(is_hb | is_nl, inbox.a,
+                                jnp.where(is_rj, inbox.c, -1)),
+                      axis=1, initial=-1)
+        seen = jnp.maximum(s["seen"], obs)
+        # only a CURRENT leader's heartbeat refreshes the failure
+        # detector (a stale leader's HB must not suppress elections)
+        hb_cur = (is_hb & (inbox.a >= seen[:, None])).any(axis=1)
+        heard = jnp.where(hb_cur, rnd, s["heard"])
+
+        # deposed/overtaken: a higher ballot exists — step down, abort
+        # any candidacy, and drop the table (its rows are fenced at the
+        # grid; chosen ones will be recovered by the new leader)
+        higher = seen > s["bal"]
+        dep = s["leading"] & higher
+        abort = (s["electing"]
+                 & (higher
+                    | (is_rj & (inbox.a == s["bal"][:, None])).any(axis=1)))
+        leading = s["leading"] & ~dep
+        electing = s["electing"] & ~abort
+        t_valid = s["t_valid"] & ~dep[:, None]
+
+        # seeded per-round jitter (the raft election-timer idiom):
+        # deterministic per seed, identical plain and --mesh
+        key_r = jax.random.fold_in(ctx["key"], 23)
+        jit1 = jax.random.randint(key_r, (n,), 0, 2 * lay.retry + 1)
+        boff = jnp.where(abort | dep, rnd + lay.retry + jit1, s["boff"])
+        # re-arm the failure detector on leader activity (or on losing)
+        deadline = jnp.where(
+            hb_cur | abort | dep,
+            rnd + lay.etimeout + me * 2 * lay.retry + jit1,
+            s["deadline"])
+
+        # ---- T_DONE retires rows (slot-keyed: DONE means chosen AND
+        # stored everywhere, so retiring even a query-phase row is
+        # sound — the value needs no re-proposal) and feeds the commit
+        # watermark: the contiguous done-frontier bounds the NEXT
+        # leader's recovery scan
+        done = v & (inbox.type == T_DONE)
+        hit = _match_rows(t_valid, s["t_slot"], done, inbox.a)
+        t_valid = t_valid & ~hit.any(axis=2)
+        done_d = _first_per_key(done, inbox.a)
+        d_ok = done_d & (inbox.a >= 0) & (inbox.a < C)
+        nn = me[:, None]
+        kk0 = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+        done_bits = s["done_bits"].at[
+            nn, jnp.where(d_ok, jnp.clip(inbox.a, 0, C - 1),
+                          C + kk0)].set(True, mode="drop",
+                                        unique_indices=True)
+        dfront = s["dfront"]
+        for _ in range(8):            # bounded advance; backlog drains
+            nxt = jnp.clip(dfront + 1, 0, C - 1)
+            bit = jnp.take_along_axis(done_bits, nxt[:, None],
+                                      axis=1)[:, 0]
+            dfront = jnp.where(bit & (dfront + 1 < C), dfront + 1,
+                               dfront)
+
+        # ---- T_PROM folds onto an open candidacy (c packs the
+        # acceptor's commit watermark and max accepted slot, 13 bits
+        # each: cap <= 4095 guarantees the fit)
+        pr = (v & (inbox.type == T_PROM) & electing[:, None]
+              & (inbox.a == s["bal"][:, None]))
+        prom = s["prom"]
+        rec_hi = s["rec_hi"]
+        for k in range(K):
+            bit = 1 << jnp.clip(inbox.b[:, k], 0, 30)
+            prom = jnp.where(pr[:, k], prom | bit, prom)
+            rec_hi = jnp.where(pr[:, k],
+                               jnp.maximum(rec_hi,
+                                           (inbox.c[:, k] & 0x1FFF) - 1),
+                               rec_hi)
+            dfront = jnp.where(pr[:, k],
+                               jnp.maximum(dfront,
+                                           (inbox.c[:, k] >> 13) - 1),
+                               dfront)
+        won = electing & _col_quorum(lay, prom)
+        leading = leading | won
+        electing = electing & ~won
+        next_slot = jnp.where(won, rec_hi + 1, s["next_slot"])
+        # recovery starts ABOVE the commit watermark: slots <= dfront
+        # are stored on every replica already
+        rec_next = jnp.where(won, dfront + 1, s["rec_next"])
+        heard = jnp.where(won, rnd, heard)
+        dur = rnd - s["cand_round"]
+        won_count = s["won_count"] + won.astype(I32)
+        won_sum = s["won_sum"] + jnp.where(won, dur, 0)
+        won_max = jnp.maximum(s["won_max"], jnp.where(won, dur, 0))
+        hb_last = jnp.where(won, rnd - lay.retry, s["hb_last"])
+
+        # ---- T_QVAL folds onto query-phase rows (recovery reads)
+        qv = v & (inbox.type == T_QVAL)
+        q_idx = (inbox.c >> 16) & 0x7FFF
+        q_bal = (inbox.c & 0xFFFF) - 1          # -1 = nothing accepted
+        qmask, qbal = s["t_qmask"], s["t_qbal"]
+        t_cmd = s["t_cmd"]
+        for k in range(K):
+            m = (t_valid & s["t_q"] & qv[:, k][:, None]
+                 & (s["t_slot"] == inbox.a[:, k][:, None]))
+            bit = (1 << jnp.clip(q_idx[:, k], 0, 30))[:, None]
+            qmask = jnp.where(m, qmask | bit, qmask)
+            better = m & (q_bal[:, k][:, None] > qbal)
+            qbal = jnp.where(better, q_bal[:, k][:, None], qbal)
+            t_cmd = jnp.where(better, inbox.b[:, k][:, None], t_cmd)
+        # a COLUMN of answers resolves the slot: highest-ballot value,
+        # or the inert NO-OP when nothing was accepted (gap fill)
+        res = t_valid & s["t_q"] & _col_quorum(lay, qmask)
+        t_q = s["t_q"] & ~res
+        t_cmd = jnp.where(res & (qbal < 0), NOOP_CMD, t_cmd)
+        t_last = jnp.where(res, rnd - lay.retry, s["t_last"])
+
+        # ---- client commands: serve when leading, redirect otherwise
+        creq = v & ((inbox.type == T_READ) | (inbox.type == T_WRITE)
+                    | (inbox.type == T_CAS))
+        op_of = jnp.where(inbox.type == T_WRITE, OP_WRITE,
+                          jnp.where(inbox.type == T_CAS, OP_CAS, OP_READ))
+        keyk = jnp.clip(inbox.a, 0, lay.keys - 1)
+        wc = (inbox.type == T_WRITE) | (inbox.type == T_CAS)
+        v1 = jnp.clip(jnp.where(wc, inbox.b + 1, 0), 0, 0xFF)
+        v2 = jnp.clip(jnp.where(inbox.type == T_CAS, inbox.c + 1, 0),
+                      0, 0xFF)
+        cmd_in = _pack_cmd(keyk, op_of, v1, v2)
+        client = jnp.clip(inbox.src - lay.n_nodes, 0,
+                          (1 << lay.CLIENT_BITS) - 1)
+        serve = creq & leading[:, None]
+        redir = creq & ~leading[:, None]
+
+        # recovery pulls first (low slots keep the replica apply
+        # frontier moving), then client allocations on what's left
+        t_slot, t_client, t_mid = s["t_slot"], s["t_client"], s["t_mid"]
+        t_bal = s["t_bal"]
+        kk = jnp.arange(K, dtype=I32)[None, :]
+        want_rec = (leading[:, None]
+                    & (rec_next[:, None] + kk <= rec_hi[:, None]))
+        okr, rowr = _alloc_rows(t_valid, want_rec)
+        rec_slot = rec_next[:, None] + kk
+        t_valid = _put_rows(t_valid, okr, rowr, True)
+        t_slot = _put_rows(t_slot, okr, rowr, rec_slot)
+        t_cmd = _put_rows(t_cmd, okr, rowr, NOOP_CMD)
+        t_client = _put_rows(t_client, okr, rowr, 0)
+        t_mid = _put_rows(t_mid, okr, rowr, -1)    # recovered: no reply
+        t_bal = _put_rows(t_bal, okr, rowr, s["bal"][:, None])
+        t_q = _put_rows(t_q, okr, rowr, True)
+        qmask = _put_rows(qmask, okr, rowr, 0)
+        qbal = _put_rows(qbal, okr, rowr, -1)
+        t_last = _put_rows(t_last, okr, rowr, rnd - lay.retry)
+        rec_next = rec_next + jnp.sum(okr.astype(I32), axis=1)
+
+        ok, row = _alloc_rows(t_valid, serve)
+        ok_rank = jnp.cumsum(ok.astype(I32), axis=1) - 1
+        slot = next_slot[:, None] + ok_rank
+        do = ok & (slot < C)
+        t_valid = _put_rows(t_valid, do, row, True)
+        t_slot = _put_rows(t_slot, do, row, slot)
+        t_cmd = _put_rows(t_cmd, do, row, cmd_in)
+        t_client = _put_rows(t_client, do, row, client)
+        t_mid = _put_rows(t_mid, do, row, inbox.mid)
+        t_bal = _put_rows(t_bal, do, row, s["bal"][:, None])
+        t_q = _put_rows(t_q, do, row, False)
+        t_last = _put_rows(t_last, do, row, rnd - lay.retry)
+        next_slot = next_slot + jnp.sum(do.astype(I32), axis=1)
+
+        # shed (backpressure, code 11) and redirect (code 31 + hint)
+        shed = serve & ~do
+        know = (rnd - heard <= lay.etimeout) & ((seen % S) != me)
+        hint = jnp.where(know, seen % S, -1)
+        err_valid = shed | redir
+        err_out = _out(
+            (n, K), valid=err_valid, dest=inbox.src,
+            type=jnp.full((n, K), T_ERR, I32),
+            a=jnp.where(redir, E_NOT_LEADER, E_UNAVAILABLE),
+            b=jnp.where(redir, hint[:, None],
+                        jnp.zeros((n, K), I32)),
+            reply_to=inbox.mid)
+
+        # ---- candidacy start: failure detector fired, backoff spent
+        start = (~leading & ~electing & (rnd > deadline) & (rnd >= boff))
+        newbal = (jnp.maximum(s["bal"], seen) // S + 1) * S + me
+        over = start & (newbal >= (1 << lay.bal_width))
+        start = start & ~over
+        bal = jnp.where(start, newbal, s["bal"])
+        bal_overflow = s["bal_overflow"] + over.astype(I32)
+        # `newbal` is monotone, so an overflowed candidate is out of
+        # ballots until a live leader re-arms its detector (hb_cur
+        # above): park the deadline so the counter records EVENTS —
+        # stalled candidacies — not every remaining round of the run
+        deadline = jnp.where(over, jnp.int32(0x7FFFFFFF), deadline)
+        electing = electing | start
+        prom = jnp.where(start, 0, prom)
+        rec_hi = jnp.where(start, -1, rec_hi)
+        cand_round = jnp.where(start, rnd, s["cand_round"])
+        t_valid = t_valid & ~start[:, None]     # stale rows are fenced
+        elect_last = jnp.where(start, rnd - lay.retry, s["elect_last"])
+
+        # ---- outbox lanes
+        # prepares: electing, retry tick, only acceptors not yet heard
+        ptick = electing & (rnd - elect_last >= lay.retry)
+        elect_last = jnp.where(ptick, rnd, elect_last)
+        jjA = jnp.arange(A, dtype=I32)[None, :]
+        prep_out = _out(
+            (n, A),
+            valid=ptick[:, None] & (((prom[:, None] >> jjA) & 1) == 0),
+            dest=jnp.broadcast_to(lay.a_base + jjA, (n, A)),
+            type=jnp.full((n, A), T_PREP, I32),
+            a=jnp.broadcast_to(bal[:, None], (n, A)))
+        # heartbeats: leading, retry tick, to the other candidates;
+        # the commit watermark rides the same tick to the grid (T_CMT)
+        htick = leading & (rnd - hb_last >= lay.retry)
+        hb_last = jnp.where(htick, rnd, hb_last)
+        jjS = jnp.arange(S, dtype=I32)[None, :]
+        hb_out = _out(
+            (n, S),
+            valid=htick[:, None] & (jjS != me[:, None]),
+            dest=jnp.broadcast_to(lay.s_base + jjS, (n, S)),
+            type=jnp.full((n, S), T_HB, I32),
+            a=jnp.broadcast_to(bal[:, None], (n, S)))
+        cmt_out = _out(
+            (n, A),
+            valid=htick[:, None] & (dfront >= 0)[:, None]
+            & jnp.ones((n, A), bool),
+            dest=jnp.broadcast_to(lay.a_base + jjA, (n, A)),
+            type=jnp.full((n, A), T_CMT, I32),
+            a=jnp.broadcast_to(dfront[:, None], (n, A)))
+        # per-row fan: query rows ask unanswered acceptors, assign rows
+        # send T_ASSIGN (lane 0) to the slot's proxy — on the retry tick
+        due = t_valid & (rnd - t_last >= lay.retry)
+        t_last = jnp.where(due, rnd, t_last)
+        AR = lay.AR
+        jj = jnp.arange(AR, dtype=I32)[None, None, :]
+        isq = t_q[:, :, None]
+        unanswered = ((qmask[:, :, None] >> jj) & 1) == 0
+        lane_valid = due[:, :, None] & jnp.where(
+            isq, (jj < A) & unanswered, jj == 0)
+        lane_dest = jnp.where(
+            isq, lay.a_base + jj,
+            jnp.broadcast_to((lay.p_base + (t_slot % lay.P))[:, :, None],
+                             (n, Q, AR)))
+        lane_type = jnp.where(isq, T_QRY, T_ASSIGN)
+        pack_a = lay.pack_assign_a(t_bal, t_client, t_slot)
+        lane_a = jnp.broadcast_to(
+            jnp.where(t_q, t_slot, pack_a)[:, :, None], (n, Q, AR))
+        lane_b = jnp.broadcast_to(
+            jnp.where(t_q, 0, t_cmd)[:, :, None], (n, Q, AR))
+        lane_c = jnp.broadcast_to(
+            jnp.where(t_q, t_bal, t_mid)[:, :, None], (n, Q, AR))
+        fan_out = _out(
+            (n, Q * AR),
+            valid=lane_valid.reshape(n, Q * AR),
+            dest=lane_dest.reshape(n, Q * AR),
+            type=jnp.broadcast_to(lane_type, (n, Q, AR)
+                                  ).reshape(n, Q * AR),
+            a=lane_a.reshape(n, Q * AR),
+            b=lane_b.reshape(n, Q * AR),
+            c=lane_c.reshape(n, Q * AR))
+
+        s.update(next_slot=next_slot, t_valid=t_valid, t_slot=t_slot,
+                 t_cmd=t_cmd, t_client=t_client, t_mid=t_mid,
+                 t_last=t_last, bal=bal, seen=seen, leading=leading,
+                 electing=electing, prom=prom, cand_round=cand_round,
+                 rec_hi=rec_hi, rec_next=rec_next, heard=heard,
+                 deadline=deadline, boff=boff, hb_last=hb_last,
+                 elect_last=elect_last, t_bal=t_bal, t_q=t_q,
+                 t_qmask=qmask, t_qbal=qbal, done_bits=done_bits,
+                 dfront=dfront, won_count=won_count,
+                 won_sum=won_sum, won_max=won_max,
+                 bal_overflow=bal_overflow)
+        return s, _cat_lanes(fan_out, prep_out, cmt_out, hb_out,
+                             err_out)
+
+    def step(self, state, inbox, ctx):
+        if self.lay.S == 1:
+            return self._step_stable(state, inbox, ctx)
+        return self._step_elect(state, inbox, ctx)
+
     def quiescent(self, state):
-        return ~state["t_valid"].any()
+        if self.lay.S == 1:
+            return ~state["t_valid"].any()
+        # an elected cluster is never quiescent: heartbeats and failure
+        # detectors tick in real (virtual) time, so skipping rounds
+        # would fire spurious elections (the raft posture)
+        return jnp.array(False)
+
+    def invalid_counters(self, state) -> dict:
+        if self.lay.S == 1:
+            return {}
+        # a candidacy that ran out of fenced ballot space stalls
+        # failover silently — the same class as a capacity shed
+        return {"ballot-overflow": state["bal_overflow"]}
 
 
 class ProxyRole(NodeProgram):
@@ -336,7 +824,10 @@ class ProxyRole(NodeProgram):
     grid, row-quorum collection, then learn-until-every-replica-acks.
     VOLATILE (`durable_keys = ()`): a crash wipes the table and the
     leader's resends rebuild it — kill faults exercise exactly the
-    paper's 'any proxy can do any command' property."""
+    paper's 'any proxy can do any command' property. With S > 1, rows
+    carry their assigning BALLOT: higher-ballot assigns replace stale
+    rows, acks must echo the row's ballot, and a T_P2R fence nack drops
+    the row and notifies the stale leader (T_NLDR)."""
 
     name = "compartment-proxy"
     durable_keys = ()            # stateless tier: nothing survives
@@ -345,40 +836,53 @@ class ProxyRole(NodeProgram):
         super().__init__(opts, nodes)
         self.lay = lay
         self.inbox_cap = lay.K
-        self.outbox_cap = lay.QP * lay.AR + lay.QP
+        self.outbox_cap = lay.QP * lay.AR + lay.QP \
+            + (lay.K if lay.S > 1 else 0)
 
     def init_state(self):
         n, Q, AR = self.n_nodes, self.lay.QP, self.lay.AR
         z = lambda *s: jnp.zeros(s, I32)  # noqa: E731
-        return {"p_valid": jnp.zeros((n, Q), bool),
-                "p_learn": jnp.zeros((n, Q), bool),
-                "p_slot": z(n, Q), "p_cmd": z(n, Q),
-                "p_client": z(n, Q), "p_mid": z(n, Q),
-                "p_last": jnp.full((n, Q), -(1 << 20), I32),
-                "p_acks": jnp.zeros((n, Q, AR), bool)}
+        st = {"p_valid": jnp.zeros((n, Q), bool),
+              "p_learn": jnp.zeros((n, Q), bool),
+              "p_slot": z(n, Q), "p_cmd": z(n, Q),
+              "p_client": z(n, Q), "p_mid": z(n, Q),
+              "p_last": jnp.full((n, Q), -(1 << 20), I32),
+              "p_acks": jnp.zeros((n, Q, AR), bool)}
+        if self.lay.S > 1:
+            st["p_bal"] = z(n, Q)
+        return st
 
     def step(self, state, inbox, ctx):
         lay, rnd = self.lay, ctx["round"]
         n, Q, K, AR = self.n_nodes, lay.QP, lay.K, lay.AR
+        S = lay.S
         s = dict(state)
         v = inbox.valid
         idx_ar = jnp.arange(AR, dtype=I32)[None, :]
         onehot = (inbox.b[:, :, None] == idx_ar[None])        # [n, K, AR]
 
-        # acceptor acks onto phase-2 rows; replica acks onto learn rows
+        # acceptor acks onto phase-2 rows; replica acks onto learn rows.
+        # With ballots, a P2B must echo the row's ballot (an ack for a
+        # superseded proposal of the same slot must not count toward
+        # the new ballot's quorum)
         p2b = _match_rows(s["p_valid"] & ~s["p_learn"], s["p_slot"],
                           v & (inbox.type == T_P2B), inbox.a)
+        if S > 1:
+            p2b = p2b & (s["p_bal"][:, :, None]
+                         == inbox.c[:, None, :])
         ex = _match_rows(s["p_valid"] & s["p_learn"], s["p_slot"],
                          v & (inbox.type == T_EXEC), inbox.a)
         s["p_acks"] = s["p_acks"] | (
             ((p2b | ex)[:, :, :, None]) & onehot[:, None]).any(axis=2)
 
-        # every replica acked: retire the row and report T_DONE
+        # every replica acked: retire the row and report T_DONE to the
+        # ASSIGNING leader (ballot % S — the movable sequencer)
         done = (s["p_valid"] & s["p_learn"]
                 & s["p_acks"][:, :, :lay.R].all(axis=2))
+        done_dest = (jnp.full((n, Q), lay.leader, I32) if S == 1
+                     else lay.s_base + (s["p_bal"] % S))
         done_out = _out(
-            (n, Q), valid=done,
-            dest=jnp.full((n, Q), lay.leader, I32),
+            (n, Q), valid=done, dest=done_dest,
             type=jnp.full((n, Q), T_DONE, I32), a=s["p_slot"])
         s["p_valid"] = s["p_valid"] & ~done
 
@@ -390,22 +894,55 @@ class ProxyRole(NodeProgram):
         s["p_acks"] = jnp.where(chosen[:, :, None], False, s["p_acks"])
         s["p_last"] = jnp.where(chosen, rnd - lay.retry, s["p_last"])
 
-        # new assignments (slot-keyed dedup: duplicates and re-deliveries
-        # of slots already in the table are no-ops; a full table drops —
-        # the leader's retry tick re-delivers)
-        asg = _first_per_key(v & (inbox.type == T_ASSIGN), inbox.a)
-        slot_in = inbox.a & 0x7FFF
-        known = _match_rows(s["p_valid"], s["p_slot"], asg,
-                            slot_in).any(axis=1)
-        asg = asg & ~known
+        # T_P2R: the grid fenced this row's ballot — drop the row and
+        # tell the stale leader it is deposed (T_NLDR carries the
+        # higher promised ballot, routed by the ROW's ballot residue)
+        nldr_out = None
+        if S > 1:
+            rej = v & (inbox.type == T_P2R)
+            rejhit = (_match_rows(s["p_valid"], s["p_slot"], rej,
+                                  inbox.a)
+                      & (s["p_bal"][:, :, None] < inbox.c[:, None, :]))
+            drop = rejhit.any(axis=2)
+            lane_hit = rejhit.any(axis=1)                    # [n, K]
+            stale_bal = jnp.max(
+                jnp.where(rejhit, s["p_bal"][:, :, None], 0), axis=1)
+            s["p_valid"] = s["p_valid"] & ~drop
+            nldr_out = _out(
+                (n, K), valid=lane_hit,
+                dest=lay.s_base + (stale_bal % S),
+                type=jnp.full((n, K), T_NLDR, I32), a=inbox.c)
+
+        # new assignments (slot-keyed dedup; with ballots, a HIGHER-
+        # ballot assign replaces a stale row — reset acks/learn — and a
+        # stale assign is dropped; a full table drops and the leader's
+        # retry tick re-delivers)
+        if S == 1:
+            bal_in = jnp.zeros((n, K), I32)
+            _b, client_in, slot_in = lay.unpack_assign_a(inbox.a)
+        else:
+            bal_in, client_in, slot_in = lay.unpack_assign_a(inbox.a)
+        asg = _first_per_key(v & (inbox.type == T_ASSIGN), slot_in)
+        hitS = _match_rows(s["p_valid"], s["p_slot"], asg, slot_in)
+        if S > 1:
+            stale_msg = (hitS & (s["p_bal"][:, :, None]
+                                 >= bal_in[:, None, :])).any(axis=1)
+            upgrade = (hitS & (s["p_bal"][:, :, None]
+                               < bal_in[:, None, :])).any(axis=2)
+            s["p_valid"] = s["p_valid"] & ~upgrade
+            asg = asg & ~stale_msg
+        else:
+            asg = asg & ~hitS.any(axis=1)
         ok, row = _alloc_rows(s["p_valid"], asg)
         s["p_valid"] = _put_rows(s["p_valid"], ok, row, True)
         s["p_learn"] = _put_rows(s["p_learn"], ok, row, False)
         s["p_slot"] = _put_rows(s["p_slot"], ok, row, slot_in)
         s["p_cmd"] = _put_rows(s["p_cmd"], ok, row, inbox.b)
-        s["p_client"] = _put_rows(s["p_client"], ok, row, inbox.a >> 16)
+        s["p_client"] = _put_rows(s["p_client"], ok, row, client_in)
         s["p_mid"] = _put_rows(s["p_mid"], ok, row, inbox.c)
         s["p_last"] = _put_rows(s["p_last"], ok, row, rnd - lay.retry)
+        if S > 1:
+            s["p_bal"] = _put_rows(s["p_bal"], ok, row, bal_in)
         nn = jnp.arange(n, dtype=I32)[:, None]
         kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
         s["p_acks"] = s["p_acks"].at[
@@ -422,13 +959,15 @@ class ProxyRole(NodeProgram):
             learn, jj < lay.R, jj < lay.A)
         lane_dest = jnp.where(learn, lay.r_base + jj, lay.a_base + jj)
         lane_type = jnp.where(learn, T_LEARN, T_P2A)
+        learn_a = lay.pack_learn_a(s["p_client"], s["p_slot"])
         lane_a = jnp.where(learn,
-                           (s["p_client"][:, :, None] << 16)
-                           | s["p_slot"][:, :, None],
+                           learn_a[:, :, None],
                            jnp.broadcast_to(s["p_slot"][:, :, None],
                                             (n, Q, AR)))
         lane_b = jnp.broadcast_to(s["p_cmd"][:, :, None], (n, Q, AR))
-        lane_c = jnp.where(learn, s["p_mid"][:, :, None], 0)
+        p2a_c = (jnp.zeros((n, Q), I32) if S == 1 else s["p_bal"])
+        lane_c = jnp.where(learn, s["p_mid"][:, :, None],
+                           p2a_c[:, :, None])
         fan_out = _out(
             (n, Q * AR),
             valid=lane_valid.reshape(n, Q * AR),
@@ -438,18 +977,23 @@ class ProxyRole(NodeProgram):
             a=lane_a.reshape(n, Q * AR),
             b=lane_b.reshape(n, Q * AR),
             c=jnp.broadcast_to(lane_c, (n, Q, AR)).reshape(n, Q * AR))
-        return s, _cat_lanes(fan_out, done_out)
+        outs = [fan_out, done_out]
+        if nldr_out is not None:
+            outs.append(nldr_out)
+        return s, _cat_lanes(*outs)
 
     def quiescent(self, state):
         return ~state["p_valid"].any()
 
 
 class AcceptorRole(NodeProgram):
-    """One grid cell: stores the command proposed for each slot (single
-    stable proposer: first write is the only value ever proposed;
-    re-accepts are idempotent overwrites) and acks with its grid index
-    so proxies can assemble row quorums. Durable: accepted state
-    fsyncs before the ack leaves."""
+    """One grid cell: stores the command proposed for each slot and acks
+    with its grid index so proxies can assemble row quorums. Durable:
+    accepted state fsyncs before the ack leaves. With S > 1 it is a
+    full Paxos acceptor: `promised` (durable) fences stale T_PREP
+    (T_REJP) and stale-ballot T_P2A (T_P2R), promises carry the max
+    accepted slot for `next_slot` recovery, and T_QRY reads back the
+    per-slot (cmd, accepted-ballot) pair for value recovery."""
 
     name = "compartment-acceptor"
     durable_keys = None
@@ -462,10 +1006,16 @@ class AcceptorRole(NodeProgram):
 
     def init_state(self):
         n, C = self.n_nodes, self.lay.cap
-        return {"acc_cmd": jnp.zeros((n, C), I32),
-                "acc_has": jnp.zeros((n, C), bool)}
+        st = {"acc_cmd": jnp.zeros((n, C), I32),
+              "acc_has": jnp.zeros((n, C), bool)}
+        if self.lay.S > 1:
+            st.update({"promised": jnp.zeros((n,), I32),
+                       "acc_bal": jnp.zeros((n, C), I32),
+                       "acc_hi": jnp.full((n,), -1, I32),
+                       "acc_cmt": jnp.full((n,), -1, I32)})
+        return st
 
-    def step(self, state, inbox, ctx):
+    def _step_stable(self, state, inbox, ctx):
         lay = self.lay
         n, K, C = self.n_nodes, lay.K, lay.cap
         s = dict(state)
@@ -485,6 +1035,98 @@ class AcceptorRole(NodeProgram):
                     b=jnp.broadcast_to(me, (n, K)))
         return s, acks
 
+    def _step_elect(self, state, inbox, ctx):
+        lay = self.lay
+        n, K, C = self.n_nodes, lay.K, lay.cap
+        s = dict(state)
+        v = inbox.valid
+        nn = jnp.arange(n, dtype=I32)[:, None]
+        kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
+        me = jnp.arange(n, dtype=I32)[:, None]
+
+        # commit watermark (monotone, durable): "all slots <= cmt are
+        # stored on every replica" — a fact piggybacked by leaders
+        # (T_CMT) that bounds the next recovery scan
+        cmt = v & (inbox.type == T_CMT)
+        s["acc_cmt"] = jnp.maximum(
+            s["acc_cmt"],
+            jnp.max(jnp.where(cmt, inbox.a, -1), axis=1, initial=-1))
+
+        # promises: only the round's highest prepare is promised (a
+        # strictly sound batching of the sequential rule); the rest are
+        # rejected with the new floor
+        prep = _first_per_key(v & (inbox.type == T_PREP), inbox.a)
+        pmax = jnp.maximum(
+            s["promised"],
+            jnp.max(jnp.where(prep, inbox.a, -1), axis=1, initial=-1))
+        prom_ok = prep & (inbox.a == pmax[:, None])
+        prom_rej = prep & ~prom_ok
+        s["promised"] = pmax
+
+        # phase 2a: accept iff the proposal's ballot clears the promise
+        # floor; fenced proposals nack (T_P2R) so stale proxies/leaders
+        # learn they are deposed instead of retrying forever
+        p2a = _first_per_key(v & (inbox.type == T_P2A), inbox.a)
+        in_cap = p2a & (inbox.a >= 0) & (inbox.a < C)
+        ok2a = in_cap & (inbox.c >= pmax[:, None])
+        nack = in_cap & ~ok2a
+        # accepting ballot b IMPLIES promising b (the classic acceptor
+        # rule): without raising the floor here, an acceptor that never
+        # saw the new leader's prepare (promise quorums are one COLUMN)
+        # would happily let a stale lower-ballot proposal overwrite the
+        # higher-ballot value it accepted — erasing a possibly-CHOSEN
+        # command, which a later recovery would then resolve wrongly
+        s["promised"] = jnp.maximum(
+            pmax, jnp.max(jnp.where(ok2a, inbox.c, -1), axis=1,
+                          initial=-1))
+        tgt = jnp.where(ok2a, jnp.clip(inbox.a, 0, C - 1), C + kk)
+        s["acc_cmd"] = s["acc_cmd"].at[nn, tgt].set(
+            inbox.b, mode="drop", unique_indices=True)
+        s["acc_has"] = s["acc_has"].at[nn, tgt].set(
+            True, mode="drop", unique_indices=True)
+        s["acc_bal"] = s["acc_bal"].at[nn, tgt].set(
+            inbox.c, mode="drop", unique_indices=True)
+        s["acc_hi"] = jnp.maximum(
+            s["acc_hi"],
+            jnp.max(jnp.where(ok2a, inbox.a, -1), axis=1, initial=-1))
+
+        # recovery reads: per-slot (cmd, accepted ballot) snapshot,
+        # post-update (deterministic same-round ordering)
+        qry = v & (inbox.type == T_QRY)
+        qs = jnp.clip(inbox.a, 0, C - 1)
+        g_cmd = jnp.take_along_axis(s["acc_cmd"], qs, axis=1)
+        g_bal = jnp.take_along_axis(s["acc_bal"], qs, axis=1)
+        g_has = (jnp.take_along_axis(s["acc_has"], qs, axis=1)
+                 & (inbox.a >= 0) & (inbox.a < C))
+
+        # one reply per inbox lane (each lane is exactly one RPC kind)
+        rvalid = ok2a | nack | prom_ok | prom_rej | qry
+        rtype = jnp.where(
+            ok2a, T_P2B,
+            jnp.where(nack, T_P2R,
+                      jnp.where(prom_ok, T_PROM,
+                                jnp.where(prom_rej, T_REJP, T_QVAL))))
+        rb = jnp.where(qry, g_cmd,
+                       jnp.where(prom_rej, 0,
+                                 jnp.broadcast_to(me, (n, K))))
+        qval_c = (me << 16) | jnp.where(g_has, g_bal + 1, 0)
+        prom_c = ((s["acc_cmt"] + 1) << 13) | (s["acc_hi"] + 1)
+        rc = jnp.where(
+            ok2a, inbox.c,
+            jnp.where(nack | prom_rej, pmax[:, None],
+                      jnp.where(prom_ok,
+                                jnp.broadcast_to(prom_c[:, None],
+                                                 (n, K)),
+                                qval_c)))
+        out = _out((n, K), valid=rvalid, dest=inbox.src,
+                   type=rtype, a=inbox.a, b=rb, c=rc)
+        return s, out
+
+    def step(self, state, inbox, ctx):
+        if self.lay.S == 1:
+            return self._step_stable(state, inbox, ctx)
+        return self._step_elect(state, inbox, ctx)
+
     def quiescent(self, state):
         return jnp.array(True)
 
@@ -496,9 +1138,8 @@ class ReplicaRole(NodeProgram):
     on another's (see the module docstring's deadlock note). Commands
     apply strictly in slot order, and the designated replica
     (`slot % R`) answers the client with the apply-point value.
-    Re-learns of stored slots re-ack — never re-reply (a duplicate
-    client reply would be stale anyway, but the ack must always be
-    recoverable)."""
+    Recovered commands (mid = -1: re-proposals and no-op gap fills
+    whose clients already timed out) apply without replying."""
 
     name = "compartment-replica"
     durable_keys = None
@@ -522,9 +1163,9 @@ class ReplicaRole(NodeProgram):
         n, K, C = self.n_nodes, lay.K, lay.cap
         s = dict(state)
         me = jnp.arange(n, dtype=I32)
+        _client_in, slot_in = lay.unpack_learn_a(inbox.a)
         lr = _first_per_key(inbox.valid & (inbox.type == T_LEARN),
-                            inbox.a & 0x7FFF)
-        slot_in = inbox.a & 0x7FFF
+                            slot_in)
         in_cap = lr & (slot_in < C)
         nn = me[:, None]
         kk = jnp.broadcast_to(jnp.arange(K, dtype=I32)[None, :], (n, K))
@@ -534,7 +1175,7 @@ class ReplicaRole(NodeProgram):
             return dst.at[nn, tgt].set(val, mode="drop",
                                        unique_indices=True)
         s["r_cmd"] = put(s["r_cmd"], inbox.b)
-        s["r_client"] = put(s["r_client"], inbox.a >> 16)
+        s["r_client"] = put(s["r_client"], _client_in)
         s["r_mid"] = put(s["r_mid"], inbox.c)
         s["r_has"] = put(s["r_has"], True)
 
@@ -571,8 +1212,10 @@ class ReplicaRole(NodeProgram):
                     new_v, mode="drop", unique_indices=True)
             s["applied"] = jnp.where(active, idx, s["applied"])
             # the designated replica answers the client with the
-            # apply-point value (storage was acked at the learn)
-            desig = active & ((idx % lay.R) == me)
+            # apply-point value (storage was acked at the learn);
+            # recovered commands (mid < 0) apply silently
+            desig = active & ((idx % lay.R) == me) & (mid >= 0) \
+                & (op != OP_NOOP)
             rtype = jnp.where(
                 op == OP_READ,
                 jnp.where(cur_v > 0, T_READ_OK, T_ERR),
@@ -629,15 +1272,23 @@ class GridAcceptors(AcceptorRole):
 class CompartmentProgram(LinKVWire, RolePartition):
     """`--node tpu:compartment`: the role-partitioned compartmentalized
     consensus cluster (see module docstring). Serves lin-kv through the
-    shared wire vocabulary; clients talk to the leader (node 0)."""
+    shared wire vocabulary; clients talk to the sequencer the host
+    currently believes leads, following not-leader redirects (code 31
+    with a `hint` node) through the runner's seeded backoff requeue."""
 
     name = "compartment"
 
     def __init__(self, opts, nodes):
         lay = Layout(opts, len(nodes))
         self.lay = lay
+        # host-side leader guess: where new client ops are routed.
+        # Updated by redirect hints and probed round-robin on timeouts;
+        # checkpointed (host_state) so a resumed run replays the same
+        # routing decisions.
+        self._leader_guess = lay.leader
         roles = [
-            ("leader", LeaderRole(opts, nodes[:1], lay)),
+            ("sequencers",
+             SequencerRole(opts, nodes[:lay.p_base], lay)),
             ("proxies",
              ProxyRole(opts, nodes[lay.p_base:lay.a_base], lay)),
             ("acceptors",
@@ -647,4 +1298,102 @@ class CompartmentProgram(LinKVWire, RolePartition):
         RolePartition.__init__(self, opts, nodes, roles)
 
     def node_for_op(self, op):
-        return self.lay.leader
+        return self._leader_guess
+
+    # --- leader-redirect client routing (runner hooks) ------------------
+
+    def decode_body(self, t, a, b, c, intern):
+        if t == T_ERR and a == E_NOT_LEADER:
+            return {"type": "error", "code": E_NOT_LEADER,
+                    "text": "not leader", "hint": int(b)}
+        return super().decode_body(t, a, b, c, intern)
+
+    def redirect_hint(self, body):
+        """A leader-redirect error body -> the hinted node id (-1 = no
+        live leader known: probe the next candidate), or None for every
+        other error (complete normally)."""
+        if body.get("code") == E_NOT_LEADER:
+            return int(body.get("hint", -1))
+        return None
+
+    def next_probe(self, contacted: int) -> int:
+        """Round-robin candidate probe when a redirect carries no hint
+        (mid-election)."""
+        return (int(contacted) + 1) % self.lay.S
+
+    def note_leader(self, node_idx: int):
+        if 0 <= int(node_idx) < self.lay.S:
+            self._leader_guess = int(node_idx)
+
+    def note_timeout(self, node_idx: int):
+        """An RPC to `node_idx` timed out: if that was our leader guess
+        (killed/paused/partitioned leader), rotate to the next
+        candidate so new ops probe the rest of the tier."""
+        if self.lay.S > 1 and int(node_idx) == self._leader_guess:
+            self._leader_guess = (self._leader_guess + 1) % self.lay.S
+
+    # --- host session state (rides checkpoints) -------------------------
+
+    def host_state(self):
+        st = RolePartition.host_state(self)
+        if self.lay.S <= 1:
+            return st
+        return {"roles": st, "leader_guess": self._leader_guess}
+
+    def set_host_state(self, st):
+        if isinstance(st, dict) and "leader_guess" in st:
+            self._leader_guess = int(st["leader_guess"])
+            RolePartition.set_host_state(self, st.get("roles"))
+        else:
+            RolePartition.set_host_state(self, st)
+
+    # --- dynamic nemesis targeting + election accounting ----------------
+
+    def dynamic_fault_groups(self):
+        """`--nemesis-targets kill=sequencer` resolves at invoke time to
+        the LIVE leader (the failover driver), unlike the static
+        `sequencers` group (the whole candidate tier)."""
+        return ("sequencer",)
+
+    def current_leader_host(self, nodes_host) -> int:
+        """The live leader's global node id, from a host copy of the
+        node state tree (the nemesis reads this at each targeted kill;
+        deterministic per seed because the state is)."""
+        if self.lay.S == 1:
+            return self.lay.leader
+        import numpy as np
+        seq = nodes_host["sequencers"]
+        lead = np.asarray(seq["leading"])
+        bal = np.asarray(seq["bal"])
+        if lead.any():
+            return int(np.argmax(np.where(lead, bal, -1)))
+        return int(np.max(np.asarray(seq["seen"])) % self.lay.S)
+
+    def election_report(self, nodes_host) -> dict | None:
+        """Election accounting for `checkers/availability.py`: completed
+        failovers (wins past node 0's ballot-0 incumbency), rounds from
+        candidacy to win (mean/max), highest ballot burned, and the
+        current leader. None with a stable (S == 1) sequencer."""
+        if self.lay.S == 1:
+            return None
+        import numpy as np
+        seq = nodes_host["sequencers"]
+        won = np.asarray(seq["won_count"])
+        wsum = np.asarray(seq["won_sum"])
+        wmax = np.asarray(seq["won_max"])
+        total = int(won.sum())
+        rep = {
+            "candidates": int(self.lay.S),
+            "failovers": total,
+            "wins-per-candidate": [int(x) for x in won],
+            "ballot": int(np.asarray(seq["bal"]).max()),
+            "leader": self.current_leader_host(nodes_host),
+            "ballot-overflows": int(
+                np.asarray(seq["bal_overflow"]).sum()),
+        }
+        if total:
+            rep["rounds-to-leader"] = {
+                "mean": round(float(wsum.sum()) / total, 2),
+                "max": int(wmax.max()),
+            }
+        return rep

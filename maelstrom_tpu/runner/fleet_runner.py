@@ -98,6 +98,9 @@ class _FleetClusterShell(TpuRunner):
     def _read_state(self, node_idx: int):
         return self.fleet.read_state(self.idx, node_idx)
 
+    def _nodes_host(self):
+        return self.fleet.nodes_host_row(self.idx)
+
     def _init_next_mid(self):
         self._next_mid = self.fleet.shell_next_mid(self.idx)
 
@@ -340,6 +343,13 @@ class FleetRunner:
         return jax.tree.map(lambda a: np.array(a[i, node_idx]),
                             self._state_cache)
 
+    def nodes_host_row(self, i: int):
+        """Cluster i's whole node-state tree on the host (the shell's
+        `_nodes_host`: dynamic nemesis targets, election reports)."""
+        if self._state_cache is None:
+            self._state_cache = self.transfer.fetch(self.sim.nodes)
+        return jax.tree.map(lambda a: np.array(a[i]), self._state_cache)
+
     def shell_next_mid(self, i: int) -> int:
         if self._setup_mids is None:
             self._setup_mids = np.asarray(
@@ -573,6 +583,11 @@ class FleetRunner:
             # both ride the coalesced fleet checkpoint exactly like the
             # standalone checkpoint's meta
             "carry": getattr(sh, "_carry_live", None),
+            # leader-redirect requeue (open retried invokes) rides the
+            # coalesced checkpoint like the standalone meta
+            "requeue": {"rows": list(sh._requeue),
+                        "attempt": dict(sh._retry_attempt),
+                        "open": sorted(sh._retry_open)},
             "program_host": sh.program.host_state(),
             "history_columns": history.snapshot_columns(),
         }
@@ -913,6 +928,11 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
             os.makedirs(cdir, exist_ok=True)
             t_i["store_dir"] = cdir
             t_i["checker"].checkers["net"] = TpuNetStats(sh)
+            # per-cluster availability block: same shape as standalone
+            # (the per-cluster bit-identity contract covers it)
+            from ..checkers.availability import AvailabilityChecker
+            t_i["checker"].checkers["availability"] = \
+                AvailabilityChecker(sh)
             if sh.pipeline is not None:
                 t_i["analysis"] = sh.pipeline
             if runner.session is not None:

@@ -530,6 +530,23 @@ class TpuRunner:
         self._ckpt_writer = None
         self._preempt = threading.Event()
         self.nemesis = None
+        # leader-redirect requeue (doc/compartment.md "leader
+        # election"): a not-leader reply (definite: the op did NOT
+        # execute) re-issues the SAME op — same open invoke window —
+        # against the hinted node after a seeded exponential backoff in
+        # ROUNDS. Budget from --client-retries; client_retries=0 is the
+        # global DEFAULT ("no generic RPC retries", core.DEFAULTS /
+        # client.py's falsy idiom), so 0 means UNSPECIFIED here and the
+        # budget falls back to 16 hops — a real client always follows
+        # redirects, and failover must work on a default config.
+        # Backoff pacing from --client-backoff-ms /
+        # --client-backoff-cap-ms. Rows are (due_round, process, op,
+        # node_idx, t, a, b, c) — the continuous carry_sched shape —
+        # and ride checkpoints.
+        self._requeue: list = []
+        self._retry_attempt: dict = {}
+        self._retry_open: set = set()
+        self._redirect_budget = int(test.get("client_retries") or 0) or 16
         # donated carry: the bump is pure round-counter surgery on the
         # full state tree, so buffer reuse saves a whole-tree copy per
         # quiescent fast-forward. In mesh mode its shardings are pinned
@@ -694,6 +711,51 @@ class TpuRunner:
         return jax.tree.map(lambda a: np.array(a[node_idx]),
                             self._state_cache)
 
+    def _nodes_host(self):
+        """A host copy of the whole node-state tree at the current
+        round (cached per round; values are read synchronously by the
+        callers, so the CPU zero-copy hazard window never spans a
+        dispatch). The fleet shell overrides this to read its row of
+        the batched tree."""
+        if self._state_cache is None:
+            self._state_cache = self.transfer.fetch(self.sim.nodes)
+        return self._state_cache
+
+    def _resolve_dynamic_target(self, token: str) -> list:
+        """Expands one dynamic nemesis target group against live
+        cluster state (nemesis.NemesisDecisions._expand_pool). Today's
+        vocabulary: "sequencer" -> the program's current elected leader
+        (`current_leader_host`) — `--nemesis-targets kill=sequencer` is
+        the failover driver."""
+        if token == "sequencer":
+            fn = getattr(self.program, "current_leader_host", None)
+            if fn is None:
+                raise ValueError(
+                    f"program {self.program.name!r} has no movable "
+                    f"sequencer to target")
+            idx = int(fn(self._nodes_host()))
+            return [self.nodes[idx]]
+        raise ValueError(f"unknown dynamic nemesis target {token!r}")
+
+    def _backoff_rounds(self, process, attempt: int) -> int:
+        """Seeded truncated-exponential backoff in ROUNDS for the
+        leader-redirect requeue: full jitter like client.RetryPolicy,
+        but drawn from a stable hash of (seed, process, attempt) so a
+        checkpoint/SIGKILL-resume replays the identical schedule
+        without carrying RNG state."""
+        import hashlib
+        bo_ms = self.test.get("client_backoff_ms")
+        cap_ms = self.test.get("client_backoff_cap_ms")
+        base = max(1, int(float(50.0 if bo_ms is None else bo_ms)
+                          / self.ms_per_round))
+        cap = max(base, int(float(2000.0 if cap_ms is None else cap_ms)
+                            / self.ms_per_round))
+        bound = min(cap, base << min(int(attempt), 16))
+        h = int.from_bytes(hashlib.md5(
+            f"{self.test.get('seed', 0)}:{process}:{attempt}"
+            .encode()).digest()[:4], "big")
+        return 1 + (h % bound)
+
     def _complete(self, history, gen, ctx, process, completed, free):
         # columnar segment-append: completion rows go straight into the
         # history's columns, no per-op Op materialization on the hot path
@@ -702,6 +764,9 @@ class TpuRunner:
                            process, ctx["time"], completed.get("error"),
                            completed.get("final", False))
         free.add(process)
+        # the op's redirect-retry chain (if any) ends with its window
+        self._retry_attempt.pop(process, None)
+        self._retry_open.discard(process)
         return gen.update(ctx, completed)
 
 
@@ -774,6 +839,9 @@ class TpuRunner:
             bound = min(bound, int(math.ceil(nt / ns_pr)))
         if pending:
             bound = min(bound, min(v[3] for v in pending.values()))
+        if self._requeue:
+            # a redirect retry becomes injectable at its due round
+            bound = min(bound, min(rw[0] for rw in self._requeue))
         if next_ckpt is not None:
             bound = min(bound, next_ckpt)
         bound = min(bound, max_rounds)
@@ -813,8 +881,14 @@ class TpuRunner:
                             if self.nemesis else None),
             # continuous-mode carry (None on the round-synchronous path)
             "carry": getattr(self, "_carry_live", None),
+            # leader-redirect requeue: retried ops whose invoke windows
+            # are still open must re-issue identically after a resume
+            "requeue": {"rows": list(self._requeue),
+                        "attempt": dict(self._retry_attempt),
+                        "open": sorted(self._retry_open)},
             # program host-side session state (kafka consumer sessions,
-            # polled-offset tracking): the op stream depends on it
+            # polled-offset tracking, the compartment's leader guess):
+            # the op stream depends on it
             "program_host": self.program.host_state(),
         }
         state = {
@@ -890,15 +964,21 @@ class TpuRunner:
             nem_seed = test.get("seed", 0)
         # role-targeted faults (--nemesis-targets): group tokens resolve
         # against the node family's fault groups (role ranges, acceptor
-        # grid rows/columns) plus literal node names
+        # grid rows/columns) plus literal node names; dynamic groups
+        # (the compartment's live `sequencer`) stay symbolic and expand
+        # at invoke time against the runner's cluster state
         from .. import nemesis as nem
         groups = getattr(self.program, "fault_groups", lambda: {})()
+        dyn = getattr(self.program, "dynamic_fault_groups",
+                      lambda: ())()
         targets = nem.resolve_targets(test.get("nemesis_targets"),
-                                      groups, self.nodes)
+                                      groups, self.nodes, dynamic=dyn)
         nemesis = (TpuCombinedNemesis(self, self.nodes, nem_seed,
                                       targets=targets)
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
+        if nemesis is not None:
+            nemesis.resolve_dynamic = self._resolve_dynamic_target
         self.nemesis = nemesis
         processes = list(range(C)) + ([g.NEMESIS] if nemesis else [])
         free = set(processes)
@@ -971,6 +1051,11 @@ class TpuRunner:
         # but not yet injected at checkpoint time (the schedule cannot
         # be re-drawn — generators share mutable RNGs across states)
         self._resume_carry = resume.get("carry") if resume else None
+        # leader-redirect requeue state rides the checkpoint with it
+        rq = (resume.get("requeue") or {}) if resume else {}
+        self._requeue = [tuple(rw) for rw in (rq.get("rows") or [])]
+        self._retry_attempt = dict(rq.get("attempt") or {})
+        self._retry_open = set(rq.get("open") or ())
         # host mirror of the device message-id counter (refreshed by
         # every dispatch's combined fetch)
         self._init_next_mid()
@@ -1190,7 +1275,21 @@ class TpuRunner:
             self.transfer.record_poll(_poll_t1 - _poll_t0)
             self._tel_span("schedule-encode", _poll_t0, _poll_t1)
 
-            if exhausted and not pending and free == set(processes):
+            # leader-redirect retries whose backoff elapsed re-inject
+            # now (their invoke windows are already open — no new
+            # history rows, just fresh pending registrations)
+            if self._requeue:
+                due_rows = sorted((rw for rw in self._requeue
+                                   if rw[0] <= r),
+                                  key=lambda rw: rw[0])
+                if due_rows:
+                    self._requeue = [rw for rw in self._requeue
+                                     if rw[0] > r]
+                    inject_rows += [(rw[1], rw[2], rw[3], rw[4], rw[5],
+                                     rw[6], rw[7]) for rw in due_rows]
+
+            if exhausted and not pending and not self._requeue \
+                    and free == set(processes):
                 break
 
             # fast-forward quiescent stretches (nothing in flight, nothing
@@ -1240,10 +1339,15 @@ class TpuRunner:
                 gen = self._apply_reply(program, gen, history, pending,
                                         free, processes, rep)
 
-            # timeouts -> indefinite :info (client.clj:214-233)
+            # timeouts -> indefinite :info (client.clj:214-233); a
+            # timed-out node may be a dead leader — let the program
+            # rotate its routing guess so new ops probe elsewhere
+            nt = getattr(self.program, "note_timeout", None)
             expired = [m for m, (_, _, _, dl) in pending.items() if dl <= r]
             for m in expired:
                 process, op, _ni, _dl = pending.pop(m)
+                if nt is not None:
+                    nt(_ni)
                 completed = {**op, "type": "info", "error": "net-timeout"}
                 gen = self._complete(history, gen, ctx, process, completed,
                                      free)
@@ -1272,6 +1376,33 @@ class TpuRunner:
         process, op, node_idx, _dl = entry
         body = program.decode_body(t_, a_, b_, c_, self.intern)
         if body.get("type") == "error":
+            # leader redirect (doc/compartment.md): a not-leader reply
+            # is definite — the op did NOT execute — so re-issue the
+            # SAME op (same open invoke window) against the hinted node
+            # under seeded backoff instead of completing it. Budget
+            # exhaustion falls through to the ordinary definite fail.
+            hint_fn = getattr(program, "redirect_hint", None)
+            if hint_fn is not None:
+                h = hint_fn(body)
+                if h is not None:
+                    attempt = self._retry_attempt.get(process, 0)
+                    if attempt < self._redirect_budget:
+                        target = int(h)
+                        if not 0 <= target < self.cfg.n_nodes:
+                            # no live leader known: probe the tier
+                            target = int(program.next_probe(node_idx))
+                        note = getattr(program, "note_leader", None)
+                        if note is not None:
+                            note(target)
+                        t2, a2, b2, c2 = program.encode_body(
+                            program.request_for_op(op), self.intern)
+                        self._retry_attempt[process] = attempt + 1
+                        self._retry_open.add(process)
+                        due = int(stamp) + self._backoff_rounds(process,
+                                                                attempt)
+                        self._requeue.append(
+                            (due, process, op, target, t2, a2, b2, c2))
+                        return gen
             err = ERROR_REGISTRY.get(body.get("code"))
             definite = err.definite if err else False
             completed = {**op,
@@ -1456,6 +1587,13 @@ class TpuRunner:
                     carry_nem = nem
                 break
             exhausted = end_kind == "exhausted"
+            # leader-redirect retries join the scheduled rows (their
+            # due rounds clamp to this window's start; rd gates the
+            # in-window injection like any scheduled op)
+            if self._requeue:
+                carry_sched += [(max(int(rw[0]), r),) + tuple(rw[1:])
+                                for rw in self._requeue]
+                self._requeue = []
             # stable by round: carried rows precede same-round new ones
             carry_sched.sort(key=lambda rw: rw[0])
             _poll_t1 = time.perf_counter()
@@ -1522,10 +1660,13 @@ class TpuRunner:
                             f"continuous scan executed {k} rounds but "
                             f"reported no mid for row {seq} at round "
                             f"{rd}")
-                    history.append_row("invoke", op.get("f"),
-                                       op.get("value"), process,
-                                       self._time_ns(rd),
-                                       final=op.get("final", False))
+                    if process not in self._retry_open:
+                        # a leader-redirect retry keeps its original
+                        # open invoke window — no second invoke row
+                        history.append_row("invoke", op.get("f"),
+                                           op.get("value"), process,
+                                           self._time_ns(rd),
+                                           final=op.get("final", False))
                     pending[mid] = (process, op, node_idx,
                                     rd + self.timeout_rounds)
                 else:
@@ -1537,10 +1678,13 @@ class TpuRunner:
             ctx = {"time": self._time_ns(r),
                    "free": self._free_rotated(free, history),
                    "processes": processes}
+            nt = getattr(self.program, "note_timeout", None)
             expired = [m for m, (_, _, _, dl) in pending.items()
                        if dl <= r]
             for m in expired:
                 process, op, _ni, _dl = pending.pop(m)
+                if nt is not None:
+                    nt(_ni)
                 completed = {**op, "type": "info",
                              "error": "net-timeout"}
                 gen = self._complete(history, gen, ctx, process,
@@ -1861,8 +2005,13 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         runner.telemetry = TM.TelemetrySession(
             TM.resolve_dir(test.get("telemetry"), test_dir),
             ms_per_round=runner.ms_per_round)
-    # swap the host-net stats checker for the device-counter one
+    # swap the host-net stats checker for the device-counter one, and
+    # add the availability block (no-committed-reply gaps in virtual
+    # rounds + election accounting; doc/compartment.md) — deterministic
+    # per seed apart from its stripped check-wall-s
+    from ..checkers.availability import AvailabilityChecker
     test["checker"].checkers["net"] = TpuNetStats(runner)
+    test["checker"].checkers["availability"] = AvailabilityChecker(runner)
     test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
         else None
 

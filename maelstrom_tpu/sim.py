@@ -360,6 +360,21 @@ class RolePartition(NodeProgram):
                 out.update(sub(names))
         return out
 
+    def dynamic_fault_groups(self) -> tuple:
+        """Target-group names resolved against LIVE cluster state at
+        fault-invoke time (doc/faults.md) — the movable-role metadata a
+        partition exposes on top of its static ranges. A subclass that
+        owns a movable role (the compartment's elected `sequencer`)
+        overrides this together with the resolver the runner calls
+        (`current_leader_host`); role programs may also contribute via
+        their own `dynamic_fault_groups`."""
+        out: list = []
+        for _name, prog in self.roles:
+            f = getattr(prog, "dynamic_fault_groups", None)
+            if f is not None:
+                out += [t for t in f() if t not in out]
+        return tuple(out)
+
 
 def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
     """One simulation round. `inject` is a flat Msgs batch of client

@@ -306,7 +306,8 @@ class _Cursor:
     drain (for deltas)."""
 
     __slots__ = ("row", "open", "win", "cum", "invokes", "oks", "fails",
-                 "infos", "last_round", "last_ring", "windows")
+                 "infos", "last_round", "last_ring", "windows",
+                 "last_ok_ns", "max_gap_ns")
 
     def __init__(self):
         self.row = 0
@@ -317,6 +318,11 @@ class _Cursor:
         self.last_round = 0
         self.last_ring: dict | None = None
         self.windows = 0
+        # availability tracking (checkers/availability.py has the
+        # post-hoc equivalent): time of the last committed reply and
+        # the longest no-ok gap seen so far
+        self.last_ok_ns = 0
+        self.max_gap_ns = 0
 
 
 class TelemetrySession:
@@ -404,8 +410,12 @@ class TelemetrySession:
             t0 = cur.open.pop(p, None)
             if types[i] == 1:               # ok
                 cur.oks += 1
+                t_ok = int(times[i])
+                cur.max_gap_ns = max(cur.max_gap_ns,
+                                     t_ok - cur.last_ok_ns)
+                cur.last_ok_ns = max(cur.last_ok_ns, t_ok)
                 if t0 is not None:
-                    lat_ms = (int(times[i]) - t0) / 1e6
+                    lat_ms = (t_ok - t0) / 1e6
                     cur.win.add(lat_ms)
                     cur.cum.add(lat_ms)
             elif types[i] == 2:
@@ -443,6 +453,16 @@ class TelemetrySession:
         if span_s > 0:
             rec["offered_rate"] = round((cur.invokes - inv0) / span_s, 3)
             rec["delivered_rate"] = round((cur.oks - ok0) / span_s, 3)
+        # live availability view (doc/compartment.md "leader election"):
+        # the running longest no-committed-reply gap and the current
+        # open gap, in virtual rounds — a failover dip shows up here
+        # windows before the post-hoc availability block lands
+        ns_pr = self.ms_per_round * 1e6
+        rec["availability"] = {
+            "max_ok_gap_rounds": int(cur.max_gap_ns / ns_pr),
+            "rounds_since_ok": max(int(r) - int(cur.last_ok_ns / ns_pr),
+                                   0),
+        }
         if pipeline is not None and getattr(pipeline, "windows", None):
             lag = pipeline.windows[-1].get("lag-rounds")
             if lag is not None:

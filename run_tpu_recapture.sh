@@ -95,6 +95,17 @@ step artifacts/bench-fleet-stream-r12.json 3600 \
 step artifacts/bench-telemetry-r13.json 2400 \
     env BENCH_MODE=telemetry python bench.py
 
+# 1i. leader failover (BENCH_MODE=failover, ISSUE 14): repeated
+#     kill-the-live-sequencer (`--nemesis-targets kill=sequencer`) on
+#     the 3-candidate elected compartment at the PR 9 acceptance shape
+#     — headline `value` = max rounds-to-new-leader, with client-ops/s
+#     before/during/after the kill windows and the availability block's
+#     longest no-ok gap in the record (doc/compartment.md "leader
+#     election"; CPU r01 in artifacts/bench-failover-cpu-r01.json).
+#     Gates: linearizable at every point and >= 2 completed failovers
+step artifacts/bench-failover-r14.json 2400 \
+    env BENCH_MODE=failover python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

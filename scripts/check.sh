@@ -229,4 +229,41 @@ PY
     echo "== telemetry smoke valid =="
 fi
 
+# Leader-failover smoke (ISSUE 14, doc/compartment.md "leader
+# election"): one AUDITED `--nemesis-targets kill=sequencer` run under
+# the combined kill/pause/partition/duplicate soup on the 3-candidate
+# elected compartment — must complete >= 1 failover, grade
+# linearizable, carry the availability block (bounded dips), and pass
+# the static audit with the election step fns traced at zero new
+# findings. FAILOVER_SMOKE=0 skips.
+if [ "${FAILOVER_SMOKE:-1}" = "1" ]; then
+    echo "== leader-failover smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w lin-kv --node tpu:compartment \
+        --roles sequencers=3,proxies=2,acceptors=2x2,replicas=2 \
+        --rate 30 --time-limit 4 --seed 11 --timeout-ms 400 \
+        --nemesis kill,pause,partition,duplicate \
+        --nemesis-interval 0.8 --nemesis-targets kill=sequencer \
+        --store "$SMOKE_STORE" > /dev/null
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+with open(os.path.join(root, "latest", "results.json")) as f:
+    res = json.load(f)
+assert res["valid"] is True, res.get("valid")
+assert res["workload"]["valid"] is True, res["workload"]
+audit = res["net"]["static-audit"]
+assert audit["ok"] is True, audit
+avail = res["availability"]
+assert avail["election"]["failovers"] >= 1, avail["election"]
+assert avail["longest-ok-gap-rounds"] < avail["final-round"], avail
+assert "failover-recovery-rounds" in avail, avail
+print(f"failover smoke: {avail['election']['failovers']} failovers, "
+      f"longest dip {avail['longest-ok-gap-rounds']} rounds, "
+      f"linearizable, audited")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== failover smoke valid =="
+fi
+
 echo "== static gate clean =="

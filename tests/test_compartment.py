@@ -25,12 +25,20 @@ def run(opts):
 
 def test_parse_roles():
     assert parse_roles("proxies=4,acceptors=2x3,replicas=2") == {
-        "proxies": 4, "rows": 2, "cols": 3, "replicas": 2}
+        "sequencers": 1, "proxies": 4, "rows": 2, "cols": 3,
+        "replicas": 2}
     # a plain acceptor count is a single-row grid
     assert parse_roles("acceptors=3") == {
-        "proxies": 2, "rows": 1, "cols": 3, "replicas": 2}
+        "sequencers": 1, "proxies": 2, "rows": 1, "cols": 3,
+        "replicas": 2}
+    # the elected configuration: a candidate tier
+    assert parse_roles("sequencers=3,acceptors=3") == {
+        "sequencers": 3, "proxies": 2, "rows": 1, "cols": 3,
+        "replicas": 2}
     assert roles_node_count(None) == 9          # 1 + 2 + 2x2 + 2
     assert roles_node_count("proxies=4,acceptors=2x3,replicas=3") == 14
+    assert roles_node_count("sequencers=3,proxies=4,acceptors=2x3,"
+                            "replicas=3") == 16
     with pytest.raises(ValueError, match="unknown role"):
         parse_roles("leaders=2")
     with pytest.raises(ValueError, match=">= 1"):
@@ -52,7 +60,7 @@ def test_fault_groups_name_roles_and_grid_lines():
     prog = get_program("compartment", {"rate": 5, "time_limit": 1},
                        [f"n{i}" for i in range(9)])
     g = prog.fault_groups()
-    assert g["leader"] == ["n0"]
+    assert g["sequencers"] == ["n0"]
     assert g["proxies"] == ["n1", "n2"]
     assert g["acceptors"] == ["n3", "n4", "n5", "n6"]
     assert g["replicas"] == ["n7", "n8"]
@@ -172,7 +180,7 @@ def test_compartment_checkpoints_heterogeneous_tree():
     assert res["valid"] is True, res.get("workload")
     latest = os.path.join(STORE, "latest")
     state = cp.load(os.path.realpath(latest))
-    assert set(state["sim"].nodes) == {"leader", "proxies",
+    assert set(state["sim"].nodes) == {"sequencers", "proxies",
                                        "acceptors", "replicas"}
     assert state["fingerprint"]["roles"] is None      # default spec
     # a different role spec must refuse to resume this checkpoint

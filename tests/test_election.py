@@ -1,0 +1,515 @@
+"""Leader election and live failover (ISSUE 14, doc/compartment.md
+"leader election"): ballot-numbered MultiPaxos phase 1 on the
+compartmentalized cluster — quorum geometry, acceptor fencing,
+dueling-candidate units, the kill-as-failover soup, availability
+accounting, and the election-schedule byte-identity/resume pins."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu import nemesis as nem
+from maelstrom_tpu.errors import ERROR_REGISTRY
+from maelstrom_tpu.net.tpu import Msgs
+from maelstrom_tpu.nodes.compartment import (
+    AcceptorRole, Layout, SequencerRole, _col_quorum,
+    T_ASSIGN, T_P2A, T_P2B, T_P2R, T_PREP, T_PROM, T_QRY, T_QVAL,
+    T_REJP)
+
+STORE = "/tmp/maelstrom-election-store"
+
+# ONE compact elected config shared by every e2e test in this file
+# (2 candidates, 1 proxy, a 1x2 grid, 1 replica): the shapes stay
+# identical across tests, so the compiled step is paid once per config
+ELECT = dict(store_root=STORE, seed=11, rate=30.0, time_limit=2.5,
+             journal_rows=False, audit=False, node="tpu:compartment",
+             workload="lin-kv", timeout_ms=300,
+             election_timeout_rounds=40,
+             roles="sequencers=2,proxies=1,acceptors=1x2,replicas=1",
+             nemesis_targets="kill=sequencer", recovery_s=1)
+
+
+def _opts(**over):
+    base = {"roles": "sequencers=2,proxies=1,acceptors=2x2,replicas=1",
+            "rate": 5, "time_limit": 1}
+    base.update(over)
+    return base
+
+
+def _ctx(rnd):
+    return {"round": jnp.int32(rnd), "key": jax.random.PRNGKey(0)}
+
+
+def _inbox(n, k, rows):
+    """Msgs [n, k] from sparse rows: (node, lane, field dict)."""
+    ib = Msgs.empty((n, k))
+    cols = {f: np.array(getattr(ib, f)) for f in
+            ("valid", "src", "dest", "type", "a", "b", "c", "mid")}
+    for node, lane, fields in rows:
+        cols["valid"][node, lane] = True
+        for f, v in fields.items():
+            cols[f][node, lane] = v
+    return ib.replace(**{f: jnp.asarray(v) for f, v in cols.items()})
+
+
+# --- layout / validation ---------------------------------------------------
+
+def test_layout_election_validation():
+    lay = Layout(_opts(), 8)
+    assert (lay.S, lay.P, lay.A, lay.R) == (2, 1, 4, 1)
+    assert lay.p_base == 2 and lay.a_base == 3 and lay.r_base == 7
+    # S > 1 narrows the packed wire fields and validates them
+    with pytest.raises(ValueError, match="12-bit slots"):
+        Layout(_opts(log_cap=5000), 8)
+    with pytest.raises(ValueError, match="client id"):
+        Layout(_opts(concurrency=5000), 8)
+    with pytest.raises(ValueError, match="ballot_width"):
+        Layout(_opts(ballot_width=9), 8)
+    with pytest.raises(ValueError, match="residue"):
+        Layout(_opts(ballot_width=1), 8)
+    with pytest.raises(ValueError, match="heartbeat"):
+        Layout(_opts(election_timeout_rounds=5), 8)
+    # the stable configuration keeps the PR 9 15-bit fields
+    lay1 = Layout({"roles": None, "rate": 5, "time_limit": 1,
+                   "log_cap": 5000}, 9)
+    assert lay1.S == 1 and lay1.cap == 5000
+
+
+def test_assign_packing_roundtrip():
+    lay = Layout(_opts(), 8)
+    a = lay.pack_assign_a(jnp.int32(5), jnp.int32(77), jnp.int32(123))
+    bal, client, slot = lay.unpack_assign_a(a)
+    assert (int(bal), int(client), int(slot)) == (5, 77, 123)
+    la = lay.pack_learn_a(jnp.int32(77), jnp.int32(123))
+    client2, slot2 = lay.unpack_learn_a(la)
+    assert (int(client2), int(slot2)) == (77, 123)
+
+
+def test_col_quorum_geometry():
+    """Phase-1 quorums are COLUMNS: every column intersects every
+    phase-2 row quorum; a full row does NOT (two different rows are
+    disjoint) — the grid geometry the safety argument rests on."""
+    lay = Layout(_opts(), 8)          # 2x2 grid: idx r*2+c
+    col0 = (1 << 0) | (1 << 2)
+    row0 = (1 << 0) | (1 << 1)
+    assert bool(_col_quorum(lay, jnp.int32(col0)))
+    assert not bool(_col_quorum(lay, jnp.int32(row0)))
+    assert not bool(_col_quorum(lay, jnp.int32(1 << 0)))
+    assert bool(_col_quorum(lay, jnp.int32((1 << 1) | (1 << 3))))
+
+
+def test_not_leader_error_is_definite():
+    err = ERROR_REGISTRY[31]
+    assert err.name == "not-leader" and err.definite is True
+
+
+# --- acceptor: promises, fencing, recovery reads ---------------------------
+
+def test_acceptor_promises_highest_and_rejects_rest():
+    lay = Layout(_opts(), 8)
+    acc = AcceptorRole(_opts(), [f"n{i}" for i in range(3, 7)], lay)
+    st = acc.init_state()
+    # dueling prepares in one round: only the max is promised
+    ib = _inbox(4, lay.K, [
+        (0, 0, {"type": T_PREP, "a": 3, "src": 0}),
+        (0, 1, {"type": T_PREP, "a": 5, "src": 1}),
+    ])
+    st, out = acc.step(st, ib, _ctx(1))
+    assert int(st["promised"][0]) == 5
+    types = np.array(out.type[0])[np.array(out.valid[0])]
+    assert set(types) == {T_PROM, T_REJP}
+    prom_lane = int(np.array(out.type[0]).tolist().index(T_PROM))
+    assert int(out.a[0, prom_lane]) == 5      # the winning ballot
+    assert int(out.c[0, prom_lane]) == 0      # hi+1: nothing accepted
+
+
+def test_acceptor_fences_stale_p2a_and_answers_queries():
+    """The deposed-sequencer replay fixture: after promising ballot 5,
+    a stale-ballot T_P2A (the revived old leader's in-flight traffic)
+    is NACKED (T_P2R) and never stored; a current-ballot T_P2A stores
+    and acks; T_QRY reads back (cmd, accepted ballot)."""
+    lay = Layout(_opts(), 8)
+    acc = AcceptorRole(_opts(), [f"n{i}" for i in range(3, 7)], lay)
+    st = acc.init_state()
+    st, _ = acc.step(st, _inbox(4, lay.K, [
+        (0, 0, {"type": T_PREP, "a": 5, "src": 1})]), _ctx(1))
+    st, out = acc.step(st, _inbox(4, lay.K, [
+        (0, 0, {"type": T_P2A, "a": 7, "b": 111, "c": 3, "src": 2}),
+        (0, 1, {"type": T_P2A, "a": 8, "b": 222, "c": 5, "src": 2}),
+    ]), _ctx(2))
+    assert not bool(st["acc_has"][0, 7])      # stale: fenced
+    assert bool(st["acc_has"][0, 8])
+    assert int(st["acc_bal"][0, 8]) == 5
+    assert int(st["acc_hi"][0]) == 8
+    lanes = np.array(out.type[0])
+    assert lanes[0] == T_P2R and int(out.c[0, 0]) == 5
+    assert lanes[1] == T_P2B and int(out.c[0, 1]) == 5
+    st, out = acc.step(st, _inbox(4, lay.K, [
+        (0, 0, {"type": T_QRY, "a": 8, "c": 5, "src": 1}),
+        (0, 1, {"type": T_QRY, "a": 9, "c": 5, "src": 1}),
+    ]), _ctx(3))
+    assert int(out.type[0, 0]) == T_QVAL
+    assert int(out.b[0, 0]) == 222
+    assert int(out.c[0, 0]) & 0xFFFF == 6     # accepted ballot 5 -> 5+1
+    assert int(out.c[0, 1]) & 0xFFFF == 0     # slot 9: nothing accepted
+
+
+def test_accept_raises_promise_floor():
+    """The classic acceptor rule: accepting ballot b implies promising
+    b. An acceptor that never saw the new leader's prepare (promise
+    quorums are one COLUMN) accepts a value at the new ballot — a
+    stale lower-ballot proposal arriving afterwards must be NACKED,
+    not allowed to overwrite the (possibly chosen) higher-ballot
+    value."""
+    lay = Layout(_opts(), 8)
+    acc = AcceptorRole(_opts(), [f"n{i}" for i in range(3, 7)], lay)
+    st = acc.init_state()
+    # promised still 0 (no prepare seen); accept Y=222 @ ballot 1
+    st, out = acc.step(st, _inbox(4, lay.K, [
+        (1, 0, {"type": T_P2A, "a": 10, "b": 222, "c": 1, "src": 2}),
+    ]), _ctx(1))
+    assert int(out.type[1, 0]) == T_P2B
+    assert int(st["promised"][1]) == 1        # accept raised the floor
+    # the old leader's stale X=111 @ ballot 0 replay: fenced, value kept
+    st, out = acc.step(st, _inbox(4, lay.K, [
+        (1, 0, {"type": T_P2A, "a": 10, "b": 111, "c": 0, "src": 2}),
+    ]), _ctx(2))
+    assert int(out.type[1, 0]) == T_P2R
+    assert int(st["acc_cmd"][1, 10]) == 222
+    assert int(st["acc_bal"][1, 10]) == 1
+
+
+# --- sequencer: candidacy, duel, column win --------------------------------
+
+def test_sequencer_duel_loser_backs_off_winner_takes_column():
+    lay = Layout(_opts(), 8)
+    seq = SequencerRole(_opts(), ["n0", "n1"], lay)
+    st = seq.init_state()
+    # candidate 1 (residue 1) mid-candidacy at ballot 3
+    st["electing"] = jnp.asarray([False, True])
+    st["leading"] = jnp.asarray([False, False])
+    st["bal"] = jnp.asarray([0, 3], jnp.int32)
+    st["cand_round"] = jnp.asarray([0, 10], jnp.int32)
+
+    # a full ROW of promises (idx 0, 1) is NOT a phase-1 quorum
+    st, _ = seq.step(st, _inbox(2, lay.K, [
+        (1, 0, {"type": T_PROM, "a": 3, "b": 0, "c": 9}),
+        (1, 1, {"type": T_PROM, "a": 3, "b": 1, "c": 4}),
+    ]), _ctx(12))
+    assert not bool(st["leading"][1])
+    # completing a COLUMN (idx 0 + idx 2) wins; next_slot = hi + 1
+    st, _ = seq.step(st, _inbox(2, lay.K, [
+        (1, 0, {"type": T_PROM, "a": 3, "b": 2, "c": 9}),
+    ]), _ctx(14))
+    assert bool(st["leading"][1]) and not bool(st["electing"][1])
+    assert int(st["next_slot"][1]) == 9       # promised hi+1 = 9 -> hi 8
+    assert int(st["won_count"][1]) == 1
+    assert int(st["won_sum"][1]) == 4         # candidacy 10 -> win 14
+
+    # a rival's rejection aborts a candidacy and backs off
+    st["electing"] = jnp.asarray([True, False])
+    st["bal"] = jnp.asarray([4, 3], jnp.int32)
+    st, _ = seq.step(st, _inbox(2, lay.K, [
+        (0, 0, {"type": T_REJP, "a": 4, "c": 7}),
+    ]), _ctx(20))
+    assert not bool(st["electing"][0])
+    assert int(st["seen"][0]) == 7
+    assert int(st["boff"][0]) > 20
+
+
+def test_sequencer_redirects_when_not_leading():
+    from maelstrom_tpu.nodes.raft import T_READ
+    lay = Layout(_opts(), 8)
+    seq = SequencerRole(_opts(), ["n0", "n1"], lay)
+    st = seq.init_state()
+    st, out = seq.step(st, _inbox(2, lay.K, [
+        (1, 0, {"type": T_READ, "a": 1, "src": 8, "mid": 42}),
+    ]), _ctx(1))
+    # node 1 does not lead: T_ERR code 31 with hint -> node 0
+    v = np.array(out.valid[1])
+    lane = int(np.argmax(v))
+    assert int(out.type[1, lane]) == 1
+    assert int(out.a[1, lane]) == 31
+    assert int(out.b[1, lane]) == 0           # ballot-0 leader hint
+    assert int(out.reply_to[1, lane]) == 42
+
+
+# --- nemesis: dynamic sequencer target -------------------------------------
+
+def test_resolve_dynamic_targets_and_expansion():
+    groups = {"sequencers": ["n0", "n1"]}
+    nodes = [f"n{i}" for i in range(6)]
+    t = nem.resolve_targets("kill=sequencer", groups, nodes,
+                            dynamic=("sequencer",))
+    assert t == {"kill": ["@sequencer"]}
+    # without the dynamic vocabulary the token is an unknown group
+    with pytest.raises(ValueError, match="unknown group"):
+        nem.resolve_targets("kill=sequencer", groups, nodes)
+    d = nem.NemesisDecisions(nodes, seed=3, targets=t)
+    with pytest.raises(ValueError, match="needs a live runner"):
+        d.next_kill_targets()
+    d.resolve_dynamic = lambda tok: ["n1"] if tok == "sequencer" else []
+    assert d.next_kill_targets() == ["n1"]
+
+
+# --- availability accounting (pure part) -----------------------------------
+
+def test_availability_block_units():
+    from maelstrom_tpu.checkers.availability import (availability_block,
+                                                     gaps_rounds)
+    assert gaps_rounds([5, 6, 20], 0, 25) == [(0, 5), (5, 1), (6, 14),
+                                              (20, 5)]
+    ms = 1.0
+    rows = []
+    for t_r, typ in ((5, "ok"), (6, "ok"), (500, "ok"), (900, "ok")):
+        rows.append({"type": "invoke", "f": "read", "process": 0,
+                     "time": int((t_r - 1) * 1e6)})
+        rows.append({"type": typ, "f": "read", "process": 0,
+                     "time": int(t_r * 1e6)})
+    rows.append({"type": "invoke", "f": "start-kill",
+                 "process": "nemesis", "time": int(100 * 1e6)})
+    blk = availability_block(rows, ms, end_round=1000,
+                             dip_threshold_rounds=200)
+    assert blk["ok-count"] == 4
+    assert blk["longest-ok-gap-rounds"] == 494
+    assert blk["dip-count"] == 2              # 6->500 and 500->900
+    rec = blk["failover-recovery-rounds"]
+    assert rec["per-kill"] == [400]           # kill @100 -> ok @500
+    assert rec["max"] == 400
+
+
+# --- e2e: the kill-as-failover soup ----------------------------------------
+
+def test_failover_kill_sequencer_soup():
+    """The acceptance run in miniature: `kill=sequencer` under the
+    combined kill/pause/partition/duplicate soup on the elected
+    compartment — >= 2 completed failovers, a LINEARIZABLE verdict,
+    bounded availability dips, and the stale-ballot fencing path
+    actually exercised (a revived deposed sequencer replays its
+    in-flight T_ASSIGNs; the grid must nack them)."""
+    res = core.run({**ELECT,
+                    "nemesis": {"kill", "pause", "partition",
+                                "duplicate"},
+                    "nemesis_interval": 0.6})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+    avail = res["availability"]
+    assert avail["election"]["failovers"] >= 2, avail["election"]
+    assert avail["election"]["ballot-overflows"] == 0
+    assert avail["ok-count"] > 10
+    # dips, never durable unavailability: committed replies resume
+    # inside the run after every kill window
+    assert avail["longest-ok-gap-rounds"] < avail["final-round"] * 0.8
+    assert "failover-recovery-rounds" in avail
+    by_type = res["net"]["send-count-by-type"]
+    assert by_type.get("prep", 0) > 0         # elections ran
+    assert by_type.get("hb", 0) > 0           # leaders heartbeated
+    # the kill ops targeted the LIVE leader (dynamic resolution):
+    # every recorded kill names exactly one sequencer candidate
+    with open(os.path.join(STORE, "latest", "history.jsonl")) as f:
+        kills = [json.loads(ln) for ln in f
+                 if '"start-kill"' in ln and '"info"' in ln]
+    assert len(kills) >= 2
+    for k in kills:
+        v = str(k.get("value"))
+        assert "n0" in v or "n1" in v, v
+
+
+@pytest.mark.slow
+def test_election_schedule_byte_identity_plain():
+    """Same seed -> same elections, same failovers, same history BYTES
+    (the election schedule is a pure function of the seed)."""
+    runs = []
+    for sub in ("bi-a", "bi-b"):
+        root = os.path.join(STORE, sub)
+        res = core.run({**ELECT, "store_root": root,
+                        "nemesis": {"kill"}, "nemesis_interval": 0.6})
+        with open(os.path.join(root, "latest", "history.jsonl"),
+                  "rb") as f:
+            runs.append((res, f.read()))
+    (r1, h1), (r2, h2) = runs
+    assert h1 == h2
+    a1 = {k: v for k, v in r1["availability"].items()
+          if k != "check-wall-s"}
+    a2 = {k: v for k, v in r2["availability"].items()
+          if k != "check-wall-s"}
+    assert a1 == a2
+    assert r1["availability"]["election"]["failovers"] >= 2
+
+
+@pytest.mark.slow
+def test_election_resume_byte_identity():
+    """An in-progress election rides the durable store + checkpoint:
+    a run checkpointed mid-soup (ballot state in the carry) truncated
+    and resumed produces the BYTE-IDENTICAL history of the
+    uninterrupted baseline."""
+    from maelstrom_tpu import checkpoint as cp
+    base_root = os.path.join(STORE, "resume-base")
+    res = core.run({**ELECT, "store_root": base_root,
+                    "nemesis": {"kill"}, "nemesis_interval": 0.6})
+    assert res["valid"] is True
+
+    part_root = os.path.join(STORE, "resume-part")
+    core.run({**ELECT, "store_root": part_root,
+              "nemesis": {"kill"}, "nemesis_interval": 0.6,
+              "checkpoint_every": 0.7, "sync_checkpoint": True,
+              "max_rounds": 1500})
+    ck_dir = os.path.realpath(os.path.join(part_root, "latest"))
+    state = cp.load(ck_dir)
+    # the checkpoint carries election ballot state (the seam is real:
+    # a kill window opened before round 1400, so ballots moved)
+    seq = state["sim"].nodes["sequencers"]
+    assert int(np.max(np.asarray(seq["bal"]))) > 0
+    assert state["fingerprint"]["election_timeout_rounds"] == 40
+
+    res2 = core.run({**ELECT, "store_root": part_root,
+                     "nemesis": {"kill"}, "nemesis_interval": 0.6,
+                     "checkpoint_every": 0.7, "sync_checkpoint": True,
+                     "resume": ck_dir})
+    assert res2["valid"] is True
+    with open(os.path.join(base_root, "latest",
+                           "history.jsonl"), "rb") as f:
+        h_base = f.read()
+    with open(os.path.join(part_root, "latest",
+                           "history.jsonl"), "rb") as f:
+        h_res = f.read()
+    assert h_res == h_base
+    ab = {k: v for k, v in res["availability"].items()
+          if k != "check-wall-s"}
+    ar = {k: v for k, v in res2["availability"].items()
+          if k != "check-wall-s"}
+    assert ab == ar
+
+
+def test_fingerprint_pins_election_options():
+    """A resume may not change the election schedule's inputs: the
+    failure-detector deadline, ballot width, and candidate set (via
+    roles) are all fingerprinted."""
+    from maelstrom_tpu import checkpoint as cp
+    t1 = core.build_test({**ELECT})
+    fp = cp.fingerprint(t1)
+    assert fp["election_timeout_rounds"] == 40
+    assert fp["ballot_width"] == 6
+    assert "sequencers=2" in fp["roles"]
+    state = {"fingerprint": fp}
+    t2 = core.build_test({**ELECT, "election_timeout_rounds": 80})
+    with pytest.raises(ValueError, match="election_timeout_rounds"):
+        cp.check_fingerprint(state, t2)
+
+
+@pytest.mark.slow
+def test_election_spans_acceptor_column_partition():
+    """kill=sequencer + a partitioned acceptor COLUMN: phase 1 elects
+    through the other column (column quorums need only one), writes
+    stall until the heal (row quorums cross every column), and the
+    verdict stays linearizable."""
+    res = core.run({**ELECT, "seed": 13, "time_limit": 3.0,
+                    "roles": "sequencers=2,proxies=1,acceptors=1x2,"
+                             "replicas=1",
+                    "nemesis": {"kill", "partition"},
+                    "nemesis_interval": 0.7,
+                    "nemesis_targets": "kill=sequencer,"
+                                       "partition=acceptor-col-0",
+                    "recovery_s": 2})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+    assert res["availability"]["election"]["failovers"] >= 1
+
+
+@pytest.mark.slow
+def test_failover_composes_with_continuous():
+    """Open-world composition: the elected cluster under --continuous
+    (ops injected mid-window while the kill=sequencer soup runs).
+    Exercises the redirect requeue's carry_sched path — a retried op
+    re-injects inside a later window WITHOUT a second invoke row —
+    and must stay linearizable with completed failovers."""
+    res = core.run({**ELECT, "store_root": os.path.join(STORE, "cont"),
+                    "continuous": True,
+                    "nemesis": {"kill"}, "nemesis_interval": 0.6})
+    assert res["valid"] is True, res.get("workload")
+    assert res["workload"]["valid"] is True
+    assert res["availability"]["election"]["failovers"] >= 1
+    # pairing sanity: every process alternates invoke/completion (a
+    # doubled invoke from a retried op would break this)
+    with open(os.path.join(STORE, "cont", "latest",
+                           "history.jsonl")) as f:
+        open_p: dict = {}
+        for ln in f:
+            o = json.loads(ln)
+            p = o.get("process")
+            if p == "nemesis":
+                continue
+            if o["type"] == "invoke":
+                assert p not in open_p, o
+                open_p[p] = o
+            elif o["type"] in ("ok", "fail", "info"):
+                assert p in open_p, o
+                del open_p[p]
+
+
+@pytest.mark.slow
+def test_election_sigkill_resume_bit_identical(tmp_path):
+    """The real seam: the CLI run SIGKILLed mid-soup (a checkpoint
+    cadence tight enough that the kill lands between checkpoints, with
+    an election-driving kill=sequencer nemesis live) and resumed
+    produces history + results bit-identical to an uninterrupted
+    baseline — ballot state rides the durable store and the redirect
+    requeue rides the checkpoint meta."""
+    import random
+
+    from maelstrom_tpu import crash_soak
+
+    opts = {
+        "-w": "lin-kv", "--node": "tpu:compartment",
+        "--roles": "sequencers=2,proxies=1,acceptors=1x2,replicas=1",
+        "--rate": "30", "--time-limit": "2.5", "--seed": "11",
+        "--timeout-ms": "300", "--election-timeout-rounds": "40",
+        "--nemesis": "kill", "--nemesis-interval": "0.6",
+        "--nemesis-targets": "kill=sequencer",
+        "--checkpoint-every": "0.25",
+    }
+    base_root = str(tmp_path / "base")
+    os.makedirs(base_root, exist_ok=True)
+    base_dir = crash_soak.run_once(
+        base_root, opts, os.path.join(base_root, "baseline.log"))
+    res = crash_soak.run_with_kills(str(tmp_path / "soak"), opts,
+                                    kills=1, rng=random.Random(5))
+    verdict = crash_soak.compare_runs(base_dir, res["dir"])
+    assert verdict["history_identical"], verdict
+    assert verdict["results_identical"], verdict
+    assert verdict["valid"] == (True, True)
+    with open(os.path.join(res["dir"], "results.json")) as f:
+        avail = json.load(f)["availability"]
+    assert avail["election"]["failovers"] >= 2, avail["election"]
+
+
+@pytest.mark.multichip
+@pytest.mark.slow
+def test_failover_soup_mesh_byte_identity():
+    """The acceptance soup under --mesh 1,2: valid, >= 2 failovers, and
+    history bytes IDENTICAL to the single-chip run of the same seed —
+    the election schedule is mesh-invariant."""
+    plain_root = os.path.join(STORE, "mesh-plain")
+    res1 = core.run({**ELECT, "store_root": plain_root,
+                     "nemesis": {"kill", "pause", "partition",
+                                 "duplicate"},
+                     "nemesis_interval": 0.6})
+    mesh_root = os.path.join(STORE, "mesh-sharded")
+    res2 = core.run({**ELECT, "store_root": mesh_root, "mesh": "1,2",
+                     "nemesis": {"kill", "pause", "partition",
+                                 "duplicate"},
+                     "nemesis_interval": 0.6})
+    assert res1["valid"] is True and res2["valid"] is True
+    assert res2["availability"]["election"]["failovers"] >= 2
+    with open(os.path.join(plain_root, "latest",
+                           "history.jsonl"), "rb") as f:
+        h1 = f.read()
+    with open(os.path.join(mesh_root, "latest",
+                           "history.jsonl"), "rb") as f:
+        h2 = f.read()
+    assert h1 == h2
