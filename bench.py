@@ -1339,6 +1339,12 @@ def bench_failover_record() -> dict:
         "longest_ok_gap_rounds": avail.get("longest-ok-gap-rounds"),
         "dip_count": avail.get("dip-count"),
         "dip_threshold_rounds": avail.get("dip-threshold-rounds"),
+        # the client-side leader lease (doc/compartment.md "client
+        # lease") defaults ON at 2x the election timeout: r01 predates
+        # it (longest gap ~ the 400-round RPC timeout); with it the gap
+        # tracks lease + election (r02: 419 -> 156 rounds, dips 4 -> 0)
+        "leader_lease_rounds":
+            2 * core.DEFAULTS["election_timeout_rounds"],
         "offered_rate": rate, "time_limit_s": tl,
         "nemesis_interval_s": interval,
         "wall_s": round(wall, 3),
@@ -1365,6 +1371,97 @@ def _main_failover():
     }
     print(json.dumps(record))
     if not rec["valid"] or rec["failovers"] < 2:
+        sys.exit(1)
+
+
+def bench_ordering_record() -> dict:
+    """The ordering-layer matrix made a number (doc/ordering.md):
+    lin-kv — the SAME applier — driven end to end over each ordering
+    engine (`--ordering raft|compartment|batched`) at EQUAL node count
+    (5 nodes: raft's default quintet, the compartment's minimal
+    1+1+1x2+1 tier split, a 5-node broadcast cohort), reporting
+    client-ops per VIRTUAL second per engine. Every point must grade
+    linearizable — the matrix's whole claim is that the stock checker
+    vouches for every combination. Virtual throughput is the
+    engine-economics number (messages/slots per command under equal
+    per-node budgets); wall seconds ride along for the host-speed
+    caveat."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from maelstrom_tpu import core
+
+    rate = float(os.environ.get("BENCH_ORDERING_RATE", 2000.0))
+    tl = float(os.environ.get("BENCH_ORDERING_TIME_LIMIT", 2.0))
+    conc = int(os.environ.get("BENCH_ORDERING_CONC", 32))
+    engines = [e for e in os.environ.get(
+        "BENCH_ORDERING_ENGINES", "raft,compartment,batched").split(",")
+        if e.strip()]
+    rows = []
+    root = tempfile.mkdtemp(prefix="bench-ordering-")
+    try:
+        for eng in engines:
+            opts = dict(
+                store_root=root, seed=11, workload="lin-kv",
+                ordering=eng, concurrency=conc, rate=rate,
+                time_limit=tl, journal_rows=False, audit=False,
+                timeout_ms=20000, kv_keys=1024)
+            if eng == "compartment":
+                # 5 nodes, matching the other engines' cohort
+                opts["roles"] = "proxies=1,acceptors=1x2,replicas=1"
+            else:
+                opts["node_count"] = 5
+            t0 = time.perf_counter()
+            res = core.run(opts)
+            dt = time.perf_counter() - t0
+            ok = res["stats"]["ok-count"]
+            rows.append({
+                "engine": eng,
+                "ok_ops": ok,
+                "ops_per_vsec": round(ok / tl, 1),
+                "wall_s": round(dt, 3),
+                "ops_per_wall_sec": round(ok / dt, 1),
+                "failed_ops": res["stats"]["fail-count"],
+                "valid": (res.get("workload") or {}).get("valid")
+                is True,
+            })
+            print(f"bench[ordering {eng}]: "
+                  f"{rows[-1]['ops_per_vsec']:.0f} client-ops/vsec "
+                  f"({ok} ok, {dt:.1f}s wall), "
+                  f"valid={rows[-1]['valid']}", file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "engines": rows,
+        "applier": "lin-kv",
+        "node_count": 5,
+        "offered_rate": rate, "time_limit_s": tl, "concurrency": conc,
+        "host_cpus": os.cpu_count(),
+        "devices": jax.device_count(),
+        "valid": all(r["valid"] for r in rows),
+    }
+
+
+def _main_ordering():
+    """`BENCH_MODE=ordering`: the per-engine record as its own
+    artifact, headline `value` = the fastest engine's client-ops/vsec
+    (same JSON-line contract as the other modes). Exits nonzero when
+    any engine's run graded invalid."""
+    rec = bench_ordering_record()
+    top = max(rec["engines"], key=lambda r: r["ops_per_vsec"])
+    record = {
+        "metric": "ordering_client_ops_per_vsec",
+        "value": top["ops_per_vsec"],
+        "unit": "client-ops/vsec",
+        "vs_baseline": None,
+        "fastest_engine": top["engine"],
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"]:
         sys.exit(1)
 
 
@@ -1400,6 +1497,9 @@ def main():
     elif mode == "telemetry":
         metric, unit = "telemetry_ring_overhead_pct", "percent"
         fn = _main_telemetry
+    elif mode == "ordering":
+        metric, unit = "ordering_client_ops_per_vsec", "client-ops/vsec"
+        fn = _main_ordering
     else:
         metric = ("raft_cluster_rounds_per_sec_10k_clusters" if raft
                   else "broadcast_sim_msgs_per_sec_100k_nodes")

@@ -70,6 +70,20 @@ WORKLOAD_NODES = {
                              "duplicate"}}},
     "lin-tso": {"workload": "lin-tso", "node": "tpu:services",
                 "opts": {"node_count": None}},
+    # the ordering-layer axis (doc/ordering.md): `--ordering` composes
+    # an engine's UNCHANGED device program with a host-side applier, so
+    # the step bodies are the welded engines' — but the gate traces the
+    # composed programs anyway (config drift in the composition would
+    # surface here). Two entries cover the two engine families whose
+    # composition differs from any welded audit entry: the batched
+    # broadcast under a non-broadcast workload, and the role-partitioned
+    # compartment under kafka. ordered[raft] is config-identical to the
+    # txn-list-append entry (same program class, same opts shape).
+    "ordered-batched": {"workload": "lin-kv", "node": "tpu:ordered",
+                        "opts": {"ordering": "batched"}},
+    "ordered-compartment": {"workload": "kafka", "node": "tpu:ordered",
+                            "opts": {"ordering": "compartment",
+                                     "node_count": None}},
 }
 DEFAULT_PROGRAMS = tuple(WORKLOAD_NODES)
 # mesh variants are traced for one pool-path and one edge-path program;

@@ -35,6 +35,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -105,6 +106,53 @@ class _KeyPart:
 _NONREG = object()          # open slot held by a non-register invoke
 
 
+class AnalysisPool:
+    """A shared grader pool: one fixed set of worker threads serving
+    MANY AnalysisPipelines (the fleet posture — `--fleet 512` with one
+    dedicated grader thread per cluster would dwarf the host, so
+    shells multiplex over this pool instead, sized by
+    `--check-workers`). Pipelines submit drain jobs; each pipeline
+    drains its own task deque from at most one worker at a time, so
+    per-pipeline segment ORDER is preserved and verdicts stay
+    bit-identical to the dedicated-thread path (pinned by
+    tests/test_ordering.py::test_pooled_pipeline_bit_equal)."""
+
+    def __init__(self, workers: int = 1):
+        self.workers = max(1, int(workers))
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads = []
+        for i in range(self.workers):
+            t = threading.Thread(target=self._run,
+                                 name=f"maelstrom-analysis-pool-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._closed = False
+
+    def submit(self, fn):
+        self._q.put(fn)
+
+    def _run(self):
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                self._q.put(None)       # wake the next worker too
+                return
+            try:
+                fn()
+            finally:
+                self._q.task_done()
+
+    def close(self):
+        """Stops the workers after the queued jobs drain. Idempotent;
+        pipelines must be finish()ed/close()d first."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            for t in self._threads:
+                t.join(timeout=5)
+
+
 class AnalysisPipeline:
     """Background, in-order history analysis. `feed(history, lo, hi)`
     enqueues a segment (cheap; called from the runner's dispatch loop);
@@ -114,7 +162,7 @@ class AnalysisPipeline:
 
     def __init__(self, workers: int = 1, observers: dict | None = None,
                  ns_per_round: float | None = None, head_round=None,
-                 label=None, tracer=None):
+                 label=None, tracer=None, pool: AnalysisPool | None = None):
         self.workers = max(1, int(workers))
         # flight recorder (doc/observability.md): an optional
         # TelemetrySession; each analyzed segment lands a
@@ -145,16 +193,52 @@ class AnalysisPipeline:
         self.windows: list = []
         self._history = None        # the (single) history being fed
         self._finished = False
-        self._q: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(
-            target=self._run, name="maelstrom-analysis", daemon=True)
-        self._thread.start()
+        # two execution modes: a dedicated worker thread (standalone
+        # runs — today's behavior), or a SHARED AnalysisPool (fleet
+        # shells): tasks queue locally and a drain job runs them in
+        # order from whichever pool worker picks it up, never two at
+        # once for the same pipeline
+        self._pool = pool
+        self._thread = None
+        if pool is None:
+            self._q: "queue.Queue" = queue.Queue()
+            self._thread = threading.Thread(
+                target=self._run, name="maelstrom-analysis", daemon=True)
+            self._thread.start()
+        else:
+            self._tasks: deque = deque()
+            self._tlock = threading.Lock()
+            self._scheduled = False
+            self._idle = threading.Event()
+            self._idle.set()
 
     # --- main-thread API ---
 
     def feed(self, history, lo: int, hi: int):
-        if hi > lo and not self._finished:
+        if hi <= lo or self._finished:
+            return
+        if self._pool is None:
             self._q.put((history, lo, hi))
+            return
+        with self._tlock:
+            self._tasks.append((history, lo, hi))
+            self._idle.clear()
+            if not self._scheduled:
+                self._scheduled = True
+                self._pool.submit(self._drain)
+
+    def _drain(self):
+        """Pool-mode worker body: runs THIS pipeline's queued segments
+        in order, then yields the pool worker back. The scheduled flag
+        guarantees at most one drain job per pipeline is ever live."""
+        while True:
+            with self._tlock:
+                if not self._tasks:
+                    self._scheduled = False
+                    self._idle.set()
+                    return
+                item = self._tasks.popleft()
+            self._process(item)
 
     def seed_resumed(self, history, n: int):
         """Feeds a resumed run's pre-existing rows [0, n) as segment 0,
@@ -174,8 +258,13 @@ class AnalysisPipeline:
         if not self._finished:
             self._finished = True
             self.error = self.error or "closed before finish"
-            self._q.put(None)
-            self._thread.join(timeout=5)
+            if self._pool is None:
+                self._q.put(None)
+                self._thread.join(timeout=5)
+            else:
+                with self._tlock:
+                    self._tasks.clear()
+                self._idle.wait(timeout=5)
 
     def finish(self):
         """Blocks until every fed segment is analyzed, then flushes
@@ -186,8 +275,13 @@ class AnalysisPipeline:
         tables)."""
         if self._finished:
             return self
-        self._q.put(None)
-        self._thread.join()
+        if self._pool is None:
+            self._q.put(None)
+            self._thread.join()
+        else:
+            # every fed segment either ran already or sits in _tasks
+            # with a drain job scheduled; idle fires when both empty
+            self._idle.wait()
         self._finished = True
         try:
             open_rows = sorted(self._open.values(),
@@ -288,24 +382,31 @@ class AnalysisPipeline:
             item = self._q.get()
             if item is None:
                 return
-            t0 = time.perf_counter()
             try:
-                if self.error is None:
-                    self._analyze(*item)
-            except Exception as e:
-                self.error = repr(e)
+                self._process(item)
             finally:
-                t1 = time.perf_counter()
-                self.busy_s += t1 - t0
-                if self._tracer is not None:
-                    try:
-                        self._tracer.span(
-                            "pipeline-grade", t0, t1, tid="analysis",
-                            args={"rows": self.rows,
-                                  "segments": self.segments})
-                    except Exception:   # pragma: no cover - defensive
-                        pass
                 self._q.task_done()
+
+    def _process(self, item):
+        """One segment's analysis + accounting — shared by the
+        dedicated-thread and pooled modes."""
+        t0 = time.perf_counter()
+        try:
+            if self.error is None:
+                self._analyze(*item)
+        except Exception as e:
+            self.error = repr(e)
+        finally:
+            t1 = time.perf_counter()
+            self.busy_s += t1 - t0
+            if self._tracer is not None:
+                try:
+                    self._tracer.span(
+                        "pipeline-grade", t0, t1, tid="analysis",
+                        args={"rows": self.rows,
+                              "segments": self.segments})
+                except Exception:   # pragma: no cover - defensive
+                    pass
 
     def _analyze(self, history, lo: int, hi: int):
         """One segment: the open-slot pairing scan over rows [lo, hi).

@@ -129,7 +129,13 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # (TpuRunner._backoff_rounds) and budget
                     "election_timeout_rounds", "ballot_width",
                     "client_retries", "client_backoff_ms",
-                    "client_backoff_cap_ms")
+                    "client_backoff_cap_ms",
+                    # the client-side leader lease rotates the routing
+                    # guess on a round schedule, and the ordering axis
+                    # (doc/ordering.md) selects the composed
+                    # engine x applier program — both shape the op
+                    # stream, so a resume must pin them
+                    "leader_lease_ms", "ordering")
 
 
 class CheckpointError(RuntimeError):

@@ -31,6 +31,28 @@ def build_parser() -> argparse.ArgumentParser:
                             "lin-tso", "txn-list-append", "unique-ids",
                             "kafka", "txn-rw-register"],
                    help="What workload to run")
+    t.add_argument("--ordering", choices=["raft", "compartment",
+                                          "batched"],
+                   help="Run the workload's state machine as a "
+                        "deterministic applier over this ordering "
+                        "engine's command stream (doc/ordering.md): "
+                        "'raft' = the raft log, 'compartment' = the "
+                        "compartmentalized slot sequence (elections/"
+                        "failover included; --roles sizes it), "
+                        "'batched' = Chop Chop-style batched atomic "
+                        "broadcast. Composes with -w lin-kv / kafka / "
+                        "txn-list-append; the workload's stock checker "
+                        "grades every combination. Implies --node "
+                        "tpu:ordered")
+    t.add_argument("--leader-lease-ms", type=float, default=None,
+                   help="Client-side leader lease for the elected "
+                        "compartment (doc/compartment.md): the host's "
+                        "leader guess expires this much virtual time "
+                        "after the last reply from it, so new ops "
+                        "rotate off a dead leader at detection speed "
+                        "instead of waiting out the RPC timeout "
+                        "(default: 2x the election timeout; 0 "
+                        "disables)")
     t.add_argument("--node-count", type=int,
                    help="How many nodes to run. Overrides --nodes.")
     t.add_argument("--nodes", help="Comma-separated node names")
@@ -402,10 +424,15 @@ def opts_from_args(args) -> dict:
               "kafka_groups", "session_timeout_ms", "poll_batch",
               "continuous_window_ms", "batch_max", "max_values",
               "roles", "service_roles", "nemesis_targets",
-              "election_timeout_rounds", "ballot_width", "timeout_ms"):
+              "election_timeout_rounds", "ballot_width", "timeout_ms",
+              "ordering", "leader_lease_ms"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v
+    if opts.get("ordering") and not opts.get("node"):
+        # the ordering axis is TPU-path by construction: resolve the
+        # composed program spec here so the TPU-path guards below see it
+        opts["node"] = args.node = "tpu:ordered"
     # flight recorder: "off" is the explicit disable spelling
     if args.telemetry and args.telemetry != "off":
         opts["telemetry"] = args.telemetry

@@ -121,6 +121,19 @@ DEFAULTS = dict(
     # checker's dip threshold (default: the RPC timeout in rounds).
     election_timeout_rounds=60, ballot_width=6,
     availability_dip_rounds=None,
+    # client-side leader lease (doc/compartment.md "client lease"):
+    # the host's leader guess expires leader_lease_ms of virtual time
+    # after the last reply from it, so ops stop piling onto a dead
+    # leader's RPC timeout — the failover dip shrinks toward the
+    # detection window. None = derived default (2x the election
+    # timeout); 0 disables (the pre-lease posture). S == 1 ignores it.
+    leader_lease_ms=None,
+    # the ordering-layer axis (doc/ordering.md): --ordering
+    # raft|compartment|batched runs the workload's state machine as a
+    # deterministic applier over that ordering engine's stream
+    # (`maelstrom_tpu/ordering/`), graded by the workload's stock
+    # checker. None = the workload's welded default program.
+    ordering=None,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user
@@ -195,11 +208,11 @@ class FleetSpec:
             # ONE static-audit block at the fleet level (per-cluster
             # blocks would repeat the identical trace F times)
             audit=False, audit_trace=False)
-        if test.get("check_workers") is None and self.fleet > 16:
-            # one background analysis worker PER CLUSTER is the default
-            # for small fleets; past that the thread pool would dwarf
-            # the host — opt in explicitly with --check-workers
-            opts["no_overlap"] = True
+        # windowed grading is the default posture at EVERY fleet size:
+        # shells multiplex over one shared AnalysisPool sized by
+        # --check-workers (checkers/pipeline.py), so a fleet of 512
+        # costs a few grader threads, not 512 (the old past-16
+        # no_overlap opt-out is gone)
         base_seed = int(test.get("seed", 0) or 0)
         if self.sweep == "seed":
             opts["seed"] = base_seed + i
@@ -231,6 +244,17 @@ def parse_nodes(opts: dict) -> list[str]:
 
 def build_test(opts: dict) -> dict:
     opts = {**DEFAULTS, **opts}
+    if opts.get("ordering"):
+        # the ordering axis (doc/ordering.md) runs the composed
+        # engine x applier program; an explicit conflicting --node is
+        # a config error, not something to silently override
+        node = opts.get("node")
+        if node and str(node) != "tpu:ordered":
+            raise ValueError(
+                f"--ordering {opts['ordering']!r} selects the composed "
+                f"program tpu:ordered; drop --node {node} (the engine "
+                f"is the ordering axis, the applier is the workload)")
+        opts["node"] = "tpu:ordered"
     nodes = parse_nodes(opts)
     opts["nodes"] = nodes
     if not opts.get("concurrency"):

@@ -192,6 +192,16 @@ class NodeProgram:
         """Completes a HOST-routed op from device state."""
         raise NotImplementedError
 
+    def state_row(self, tree, node_idx: int):
+        """One node's state rows, copied out of a host view of the
+        state tree (`runner._read_state`). Homogeneous programs index
+        every leaf by the node id; `sim.RolePartition` overrides to
+        map the GLOBAL id into its role's subtree (whose leaves lead
+        with the role's node count, not the cluster's)."""
+        import jax
+        import numpy as np
+        return jax.tree.map(lambda a: np.array(a[node_idx]), tree)
+
     # --- checkpointable host-side session state ---
 
     def host_state(self):
@@ -342,6 +352,12 @@ def get_program(name: str, opts: dict, nodes: list[str]) -> NodeProgram:
                    services, txn_list_append,  # noqa: F401
                    txn_rw_register, unique_ids,  # noqa: F401
                    kafka)  # noqa: F401
+    if name == "ordered":
+        # the ordering-layer axis (doc/ordering.md): the engine named
+        # by opts["ordering"] composed with the applier serving
+        # opts["workload"] — `--ordering raft|compartment|batched`
+        from ..ordering import make_ordered
+        return make_ordered(opts, nodes)
     if name.startswith("solo:"):
         # any built-in program wrapped as a ONE-role RolePartition:
         # pure delegation, bit-identical histories (the role-partition
@@ -367,4 +383,7 @@ def partition_node_count(name: str, opts: dict) -> int | None:
     if name == "services":
         from .services import roles_node_count
         return roles_node_count(opts.get("service_roles"))
+    if name == "ordered":
+        from ..ordering import ordered_node_count
+        return ordered_node_count(opts)
     return None

@@ -1286,6 +1286,28 @@ class CompartmentProgram(LinKVWire, RolePartition):
         # checkpointed (host_state) so a resumed run replays the same
         # routing decisions.
         self._leader_guess = lay.leader
+        # client-side leader LEASE (doc/compartment.md "client lease",
+        # the ISSUE 14 follow-on): without it, a dead leader's clients
+        # discover the failover only by waiting out the full RPC
+        # timeout per in-flight op — the PR 14 availability dip was
+        # ~the 400-round timeout, not the 2-round election. The lease
+        # expires the guess `leader_lease_ms` of virtual time after
+        # the last REPLY from it (any reply proves liveness: the
+        # runner's note_reply hook), so new ops rotate to the next
+        # candidate at detection-window speed. One probe per expired
+        # window (the expiry re-arms), deterministic per seed; rides
+        # host_state for resume; S == 1 (and lease 0) disables — the
+        # stable-sequencer path keeps its byte-identical routing.
+        self._host_round = 0
+        self._lease_ok = None       # not armed until the first contact
+        self._lease_rounds = 0
+        if lay.S > 1:
+            mpr = float(opts.get("ms_per_round", 1.0) or 1.0)
+            lease_ms = opts.get("leader_lease_ms")
+            if lease_ms is None:
+                self._lease_rounds = 2 * lay.etimeout
+            else:
+                self._lease_rounds = max(0, int(float(lease_ms) / mpr))
         roles = [
             ("sequencers",
              SequencerRole(opts, nodes[:lay.p_base], lay)),
@@ -1298,7 +1320,32 @@ class CompartmentProgram(LinKVWire, RolePartition):
         RolePartition.__init__(self, opts, nodes, roles)
 
     def node_for_op(self, op):
+        if self._lease_rounds:
+            if self._lease_ok is None:
+                # arm at the first routed op: the lease measures
+                # silence since last contact, and before any op was
+                # ever sent there is nothing to be silent about — an
+                # idle start must not rotate off the true leader
+                self._lease_ok = self._host_round
+            elif self._host_round - self._lease_ok > self._lease_rounds:
+                # lease expired: rotate to the next candidate and
+                # re-arm, so each expired window probes one new node
+                self._leader_guess = (self._leader_guess + 1) % self.lay.S
+                self._lease_ok = self._host_round
         return self._leader_guess
+
+    def observe_round(self, r: int):
+        """Runner hook: the current virtual round at each routing
+        boundary (what the lease expiry is measured against)."""
+        self._host_round = int(r)
+
+    def note_reply(self, node_idx: int, rnd: int | None = None):
+        """Runner hook: ANY reply from the guessed leader (ok, shed,
+        or redirect) proves it alive and renews the lease."""
+        if int(node_idx) == self._leader_guess:
+            r = int(rnd) if rnd is not None else self._host_round
+            self._lease_ok = (r if self._lease_ok is None
+                              else max(self._lease_ok, r))
 
     # --- leader-redirect client routing (runner hooks) ------------------
 
@@ -1324,6 +1371,9 @@ class CompartmentProgram(LinKVWire, RolePartition):
     def note_leader(self, node_idx: int):
         if 0 <= int(node_idx) < self.lay.S:
             self._leader_guess = int(node_idx)
+            # a fresh hint is lease evidence: don't immediately expire
+            # the node a redirect just pointed at
+            self._lease_ok = self._host_round
 
     def note_timeout(self, node_idx: int):
         """An RPC to `node_idx` timed out: if that was our leader guess
@@ -1338,11 +1388,17 @@ class CompartmentProgram(LinKVWire, RolePartition):
         st = RolePartition.host_state(self)
         if self.lay.S <= 1:
             return st
-        return {"roles": st, "leader_guess": self._leader_guess}
+        return {"roles": st, "leader_guess": self._leader_guess,
+                "lease": [self._host_round, self._lease_ok]}
 
     def set_host_state(self, st):
         if isinstance(st, dict) and "leader_guess" in st:
             self._leader_guess = int(st["leader_guess"])
+            lease = st.get("lease")
+            if lease is not None:
+                self._host_round = int(lease[0])
+                self._lease_ok = (None if lease[1] is None
+                                  else int(lease[1]))
             RolePartition.set_host_state(self, st.get("roles"))
         else:
             RolePartition.set_host_state(self, st)

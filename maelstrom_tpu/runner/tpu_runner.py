@@ -707,9 +707,10 @@ class TpuRunner:
         # copy the row out: on CPU, device_get returns zero-copy views
         # into device buffers, and a donated dispatch may recycle those
         # buffers while a completion (or the history it built) still
-        # holds the row
-        return jax.tree.map(lambda a: np.array(a[node_idx]),
-                            self._state_cache)
+        # holds the row. Extraction is program-defined (state_row):
+        # role partitions map the global node id into their role's
+        # subtree instead of indexing every leaf by it.
+        return self.program.state_row(self._state_cache, node_idx)
 
     def _nodes_host(self):
         """A host copy of the whole node-state tree at the current
@@ -1025,6 +1026,10 @@ class TpuRunner:
             from ..checkers.pipeline import AnalysisPipeline
             self.pipeline = AnalysisPipeline(
                 workers=self.check_workers,
+                # fleet-level grader pool (doc/perf.md): shells share
+                # ONE worker pool instead of one thread per cluster;
+                # None (standalone) keeps the dedicated thread
+                pool=getattr(self, "_analysis_pool", None),
                 observers=_stream_observers(test.get("checker"), test),
                 ns_per_round=self.ms_per_round * 1e6,
                 head_round=lambda: getattr(self, "_r_live", 0),
@@ -1192,12 +1197,18 @@ class TpuRunner:
         (pending/free/history/intern/nemesis) is shared mutable state."""
         N, C = cfg.n_nodes, self.concurrency
         exhausted = False
+        observe_round = getattr(self.program, "observe_round", None)
         while r < max_rounds:
             self._gen_live, self._r_live = gen, r
             # stretch boundary: the previous dispatch has landed and its
             # replies are in the history, so this is the graceful spot
             # to honor a pending SIGTERM/SIGINT
             self._check_preempted(gen, history, pending, free, r)
+            if observe_round is not None:
+                # programs with host-side routing leases (the
+                # compartment's client-side leader lease) read the
+                # current round before this boundary's ops are routed
+                observe_round(r)
             # one host poll pass per stretch boundary: the generator
             # poll loop below (plus the pending/deadline scans riding
             # this iteration) — surfaced as host-polls/host-poll-s so
@@ -1375,6 +1386,11 @@ class TpuRunner:
             return gen              # stale reply (client.clj:167-168)
         process, op, node_idx, _dl = entry
         body = program.decode_body(t_, a_, b_, c_, self.intern)
+        # any reply (success OR error) proves the contacted node alive:
+        # programs with a client-side leader lease refresh it here
+        nr = getattr(program, "note_reply", None)
+        if nr is not None:
+            nr(node_idx, int(stamp))
         if body.get("type") == "error":
             # leader redirect (doc/compartment.md): a not-leader reply
             # is definite — the op did NOT execute — so re-issue the
@@ -1523,6 +1539,7 @@ class TpuRunner:
         carry_nem = rc.get("nem")
         carry_host: list = list(rc.get("host") or [])
         exhausted = False
+        observe_round = getattr(self.program, "observe_round", None)
         while r < max_rounds:
             self._gen_live, self._r_live = gen, r
             self._carry_live = {"sched": carry_sched, "nem": carry_nem,
@@ -1530,6 +1547,9 @@ class TpuRunner:
             # stretch boundary: the previous window has landed and its
             # replies are folded in — the graceful SIGTERM spot
             self._check_preempted(gen, history, pending, free, r)
+            if observe_round is not None:
+                # host-side routing leases see the window-boundary round
+                observe_round(r)
 
             # host-boundary work due now
             while carry_nem is not None and carry_nem[0] <= r:

@@ -322,17 +322,37 @@ class RolePartition(NodeProgram):
     def decode_body(self, t, a, b, c, intern):
         return self._client_prog.decode_body(t, a, b, c, intern)
 
+    def state_row(self, tree, node_idx: int):
+        """Maps the GLOBAL node id into its role's subtree: the host
+        view of a partition's state is {role: subtree} with each
+        subtree's leaves leading with the ROLE's node count, so the
+        homogeneous whole-leaf indexing of `NodeProgram.state_row`
+        would read the wrong row (or walk off a smaller role's axis).
+        Used by `runner._read_state` for completions that read device
+        state (e.g. the ordered-stream compartment engine replaying
+        the replica log, doc/ordering.md)."""
+        import jax
+        import numpy as np
+        for (name, _prog), (lo, hi) in zip(self.roles, self._bounds):
+            if lo <= node_idx < hi:
+                return jax.tree.map(lambda a: np.array(a[node_idx - lo]),
+                                    tree[name])
+        raise IndexError(f"node {node_idx} outside the partition "
+                         f"({self.n_nodes} nodes)")
+
     def completion(self, op, body, read_state, intern):
-        return self._client_prog.completion(
-            op, body, lambda: read_state()[self._client_name], intern)
+        # read_state passes through unwrapped: the runner's state_row
+        # extraction already lands in the destination node's ROLE
+        # subtree, and programs that read other nodes' rows (the
+        # ordered-stream engines) call read_state(i) with explicit ids
+        return self._client_prog.completion(op, body, read_state, intern)
 
     def completion_payload(self, op, body, payload, intern):
         return self._client_prog.completion_payload(op, body, payload,
                                                     intern)
 
     def host_op(self, op, read_state, intern):
-        return self._client_prog.host_op(
-            op, lambda: read_state()[self._client_name], intern)
+        return self._client_prog.host_op(op, read_state, intern)
 
     def host_state(self):
         st = {name: prog.host_state() for name, prog in self.roles}

@@ -106,6 +106,16 @@ step artifacts/bench-telemetry-r13.json 2400 \
 step artifacts/bench-failover-r14.json 2400 \
     env BENCH_MODE=failover python bench.py
 
+# 1j. ordering-layer matrix (BENCH_MODE=ordering, ISSUE 15): lin-kv —
+#     the SAME applier — end to end over each ordering engine
+#     (`--ordering raft|compartment|batched`) at equal node count,
+#     headline `value` = the fastest engine's client-ops/vsec
+#     (doc/ordering.md; CPU r01 in artifacts/bench-ordering-cpu-
+#     r01.json: batched 1594 > raft 1414 > compartment 645). Gate:
+#     every engine's run grades linearizable
+step artifacts/bench-ordering-r15.json 2400 \
+    env BENCH_MODE=ordering python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -266,4 +266,32 @@ PY
     echo "== failover smoke valid =="
 fi
 
+# Ordering-layer smoke (ISSUE 15, doc/ordering.md): one NEW
+# (engine x applier) combination — txn-list-append over batched atomic
+# broadcast — driven through the CLI's --ordering axis under a fault
+# soup, graded by the stock Elle checker, static-audit block ok.
+# ORDERING_SMOKE=0 skips.
+if [ "${ORDERING_SMOKE:-1}" = "1" ]; then
+    echo "== ordering-layer smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w txn-list-append --ordering batched \
+        --node-count 5 --rate 20 --time-limit 3 --seed 11 \
+        --nemesis kill,partition,duplicate --nemesis-interval 0.8 \
+        --store "$SMOKE_STORE" > /dev/null
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+with open(os.path.join(root, "latest", "results.json")) as f:
+    res = json.load(f)
+assert res["valid"] is True, res.get("valid")
+assert res["workload"]["valid"] is True, res["workload"]
+audit = res["net"]["static-audit"]
+assert audit["ok"] is True, audit
+print("ordering smoke: txn-list-append over batched broadcast under "
+      "kill/partition/duplicate — Elle-valid, audited")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== ordering smoke valid =="
+fi
+
 echo "== static gate clean =="

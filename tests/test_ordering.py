@@ -1,0 +1,392 @@
+"""OrderedStream (ISSUE 15, doc/ordering.md): the pluggable ordering
+layer — engine adapters over raft / compartment / batched broadcast,
+deterministic appliers for lin-kv / kafka / txn-list-append, the
+`--ordering` CLI axis, the shared fleet grader pool, and the
+compartment's client-side leader lease.
+
+Budget note: the e2e combination matrix runs TINY configs (a couple of
+virtual seconds each) — the point is that every (engine x applier)
+pair runs end to end and grades valid with the STOCK checkers, not
+that it soaks. The combined-nemesis soup on a new combination is
+slow-marked."""
+
+import hashlib
+import os
+
+import pytest
+
+from maelstrom_tpu import core
+from maelstrom_tpu.nodes import EncodeCapacityError, Intern, get_program
+from maelstrom_tpu.ordering import (get_applier, make_ordered,
+                                    ordered_node_count)
+from maelstrom_tpu.ordering.appliers import (KafkaApplier, LinKVApplier,
+                                             TxnListAppendApplier)
+
+STORE = "/tmp/maelstrom-ordering-store"
+NODES5 = [f"n{i}" for i in range(5)]
+
+
+def run(opts):
+    base = dict(store_root=STORE, seed=7, rate=12.0, time_limit=1.6,
+                journal_rows=False, audit=False)
+    return core.run({**base, **opts})
+
+
+def hist_md5():
+    with open(os.path.join(STORE, "latest", "history.jsonl"), "rb") as f:
+        return hashlib.md5(f.read()).hexdigest()
+
+
+# --- applier units (the pure services machines are the oracles) ----------
+
+def test_linkv_applier_matches_dict_model():
+    import random
+    ap = LinKVApplier({})
+    st = ap.init_state()
+    model = {}
+    rng = random.Random(42)
+    for i in range(300):
+        k = rng.randrange(4)
+        r = rng.random()
+        if r < 0.4:
+            op = {"f": "read", "value": [k, None], "process": 0}
+        elif r < 0.7:
+            op = {"f": "write", "value": [k, rng.randrange(5)],
+                  "process": 0}
+        else:
+            op = {"f": "cas", "value": [k, [rng.randrange(5),
+                                            rng.randrange(5)]],
+                  "process": 0}
+        st, res = ap.apply(st, ap.command(op))
+        done = ap.completed(op, res)
+        if op["f"] == "read":
+            if k in model:
+                assert done["type"] == "ok"
+                assert done["value"] == [k, model[k]]
+            else:
+                assert done["type"] == "fail"
+                assert done["error"][0] == "key-does-not-exist"
+        elif op["f"] == "write":
+            model[k] = op["value"][1]
+            assert done["type"] == "ok"
+        else:
+            frm, to = op["value"][1]
+            if k not in model:
+                assert done["type"] == "fail"
+                assert done["error"][0] == "key-does-not-exist"
+            elif model[k] == frm:
+                model[k] = to
+                assert done["type"] == "ok"
+            else:
+                assert done["type"] == "fail"
+                assert done["error"][0] == "precondition-failed"
+
+
+def test_kafka_applier_replay_semantics():
+    ap = KafkaApplier({})
+    st = ap.init_state()
+    # sends assign dense offsets per key
+    for i, (k, m) in enumerate([(0, "a"), (0, "b"), (1, "c")]):
+        st, res = ap.apply(st, ["send", k, m])
+        done = ap.completed({"f": "send", "value": [k, m]}, res)
+        assert done["value"][2] == (i if k == 0 else 0)
+    # polls observe the full prefix and raise the session floors
+    st, res = ap.apply(st, ["poll"])
+    done = ap.completed({"f": "poll"}, res)
+    assert done["value"] == {"0": [[0, "a"], [1, "b"]], "1": [[0, "c"]]}
+    assert ap._polled == {"0": 1, "1": 0}
+    # commits claim exactly the polled floors and are monotone
+    claim = ap.command({"f": "commit"})
+    st, res = ap.apply(st, claim)
+    st, res2 = ap.apply(st, ["commit", {"0": 0}])    # stale re-claim
+    st, res3 = ap.apply(st, ["list"])
+    assert res3[1] == {"0": 1, "1": 0}
+    # host session state rides checkpoints
+    view = ap.host_view()
+    ap2 = KafkaApplier({})
+    ap2.restore(view)
+    assert ap2._polled == ap._polled
+
+
+def test_txn_applier_reuses_welded_interpreter():
+    ap = TxnListAppendApplier({})
+    st = ap.init_state()
+    st, out = ap.apply(st, ["txn", [["append", 1, 7], ["r", 1, None]]])
+    assert out == [["append", 1, 7], ["r", 1, [7]]]
+
+
+def test_applier_registry_rejects_unserved_workloads():
+    with pytest.raises(ValueError, match="no applier"):
+        get_applier("broadcast", {})
+    with pytest.raises(ValueError, match="--ordering"):
+        make_ordered({"ordering": "gossip", "workload": "lin-kv"},
+                     NODES5)
+
+
+# --- stream boundary units -----------------------------------------------
+
+def _batched(opts=None):
+    return make_ordered({"ordering": "batched", "workload": "lin-kv",
+                         "rate": 5, "time_limit": 1, **(opts or {})},
+                        NODES5)
+
+
+def test_proposals_are_stable_across_reencode():
+    prog = _batched()
+    intern = Intern()
+    op = {"f": "write", "value": [1, 3], "process": 2}
+    w1 = prog.encode_body(prog.request_for_op(op), intern)
+    # a redirect requeue re-encodes the SAME op: same seq, same words
+    w2 = prog.encode_body(prog.request_for_op(op), intern)
+    assert w1 == w2
+    # a DIFFERENT op (even with identical content) gets a fresh command
+    op2 = {"f": "write", "value": [1, 3], "process": 4}
+    w3 = prog.encode_body(prog.request_for_op(op2), intern)
+    assert w3 != w1
+    assert len(intern) == 2
+
+
+def test_capacity_exhaustion_fails_definitely():
+    prog = _batched({"max_values": 2})
+    intern = Intern()
+    for p in range(2):
+        prog.encode_body(prog.request_for_op(
+            {"f": "read", "value": [p, None], "process": p}), intern)
+    with pytest.raises(EncodeCapacityError, match="max-values"):
+        prog.encode_body(prog.request_for_op(
+            {"f": "read", "value": [9, None], "process": 9}), intern)
+
+
+def test_duplicate_delivery_applies_once():
+    prog = _batched()
+    intern = Intern()
+    op = {"f": "write", "value": [0, 4], "process": 0}
+    prog.encode_body(prog.request_for_op(op), intern)
+    prog._apply_cid(0, intern)
+    st1 = prog._app_state
+    prog._apply_cid(0, intern)      # duplicate-nemesis re-delivery
+    assert prog._app_state is st1   # at-most-once: no second apply
+
+
+def test_host_state_roundtrip_preserves_stream_session():
+    prog = make_ordered({"ordering": "raft", "workload": "kafka",
+                         "rate": 5, "time_limit": 1}, NODES5)
+    intern = Intern()
+    prog.encode_body(prog.request_for_op({"f": "poll", "process": 0}),
+                     intern)
+    prog.applier._polled = {"0": 3}
+    st = prog.host_state()
+    prog2 = make_ordered({"ordering": "raft", "workload": "kafka",
+                          "rate": 5, "time_limit": 1}, NODES5)
+    prog2.set_host_state(st)
+    assert prog2._oseq == 1
+    assert prog2.applier._polled == {"0": 3}
+
+
+def test_ordering_axis_wiring():
+    # the compartment engine sizes the cluster from --roles
+    assert ordered_node_count({"ordering": "compartment"}) == 9
+    assert ordered_node_count({"ordering": "batched"}) is None
+    nodes = core.parse_nodes({"node": "tpu:ordered",
+                              "ordering": "compartment",
+                              "roles": "proxies=1,acceptors=1x2,"
+                                       "replicas=1"})
+    assert len(nodes) == 5
+    # get_program resolves the composed spec
+    prog = get_program("ordered", {"ordering": "batched",
+                                   "workload": "txn-list-append",
+                                   "rate": 5, "time_limit": 1}, NODES5)
+    assert prog.stream_engine == "batched"
+    assert prog.applier.name == "txn-list-append"
+    # an explicit conflicting --node is a config error
+    with pytest.raises(ValueError, match="tpu:ordered"):
+        core.build_test({"ordering": "raft", "node": "tpu:lin-kv"})
+
+
+# --- shared fleet grader pool --------------------------------------------
+
+def _feed_rows(pipe):
+    from maelstrom_tpu.history import History
+    h = History()
+    t = 0
+    lo = 0
+    for seg in range(4):
+        for i in range(6):
+            p = i % 3
+            h.append_row("invoke", "write", [0, i], p, t)
+            t += 1
+            h.append_row("ok", "write", [0, i], p, t)
+            t += 1
+        pipe.feed(h, lo, len(h))
+        lo = len(h)
+    pipe.finish()
+    return h
+
+
+def test_pooled_pipeline_bit_equal():
+    """The shared AnalysisPool path produces bit-identical analysis to
+    the dedicated-thread path (the fleet 512 default-posture pin)."""
+    from maelstrom_tpu.checkers.pipeline import (AnalysisPipeline,
+                                                 AnalysisPool)
+    threaded = AnalysisPipeline(workers=1)
+    h1 = _feed_rows(threaded)
+    pool = AnalysisPool(workers=3)
+    try:
+        pooled = AnalysisPipeline(workers=1, pool=pool)
+        h2 = _feed_rows(pooled)
+    finally:
+        pool.close()
+    assert threaded.error is None and pooled.error is None
+    pt = threaded.register_partitions(len(h1))
+    pp = pooled.register_partitions(len(h2))
+    assert pt is not None and pp is not None
+    assert len(pt) == len(pp) == 1
+    (k1, a1, s1), (k2, a2, s2) = pt[0], pp[0]
+    assert k1 == k2 and s1 == s2
+    for f in a1:
+        assert list(a1[f]) == list(a2[f])
+    rt = {k: v for k, v in threaded.report().items() if k != "busy-s"}
+    rp = {k: v for k, v in pooled.report().items() if k != "busy-s"}
+    assert rt == rp
+
+
+def test_pool_preserves_per_pipeline_order():
+    """Many pipelines multiplexed over few workers: per-pipeline
+    segment order (and hence analysis state) is preserved."""
+    from maelstrom_tpu.checkers.pipeline import (AnalysisPipeline,
+                                                 AnalysisPool)
+    pool = AnalysisPool(workers=2)
+    try:
+        pipes = [AnalysisPipeline(workers=1, pool=pool)
+                 for _ in range(8)]
+        hs = [_feed_rows(p) for p in pipes]
+    finally:
+        pool.close()
+    for p, h in zip(pipes, hs):
+        assert p.error is None
+        assert p.rows == len(h)
+        assert p.segments == 4
+
+
+# --- client-side leader lease --------------------------------------------
+
+def _compartment(roles, **opts):
+    from maelstrom_tpu.nodes.compartment import roles_node_count
+    return get_program("compartment",
+                       {"roles": roles, "rate": 5, "time_limit": 1,
+                        **opts},
+                       [f"n{i}" for i in range(roles_node_count(roles))])
+
+
+def test_lease_rotates_off_a_silent_leader():
+    prog = _compartment("sequencers=3,proxies=1,acceptors=1x2,"
+                        "replicas=1", election_timeout_rounds=20)
+    assert prog._lease_rounds == 40          # 2x the election timeout
+    prog.observe_round(10)
+    assert prog.node_for_op({"f": "read"}) == 0
+    # replies from the guess renew the lease
+    prog.note_reply(0, 30)
+    prog.observe_round(60)
+    assert prog.node_for_op({}) == 0         # 60 - 30 <= 40: held
+    # silence past the lease rotates to the next candidate, re-armed
+    prog.observe_round(120)
+    assert prog.node_for_op({}) == 1
+    assert prog.node_for_op({}) == 1         # one probe per window
+    # a redirect hint is lease evidence for the hinted node
+    prog.note_leader(2)
+    assert prog.node_for_op({}) == 2
+    # lease state rides host_state (resume determinism)
+    st = prog.host_state()
+    assert st["lease"] == [120, 120]
+
+
+def test_lease_is_inert_on_the_stable_sequencer():
+    prog = _compartment("proxies=2,acceptors=2x2,replicas=2")
+    assert prog._lease_rounds == 0
+    prog.observe_round(10_000)
+    assert prog.node_for_op({}) == 0         # never rotates (S == 1)
+
+
+def test_lease_disabled_by_zero():
+    prog = _compartment("sequencers=2,proxies=1,acceptors=1x2,"
+                        "replicas=1", leader_lease_ms=0)
+    assert prog._lease_rounds == 0
+
+
+# --- the combination matrix, end to end ----------------------------------
+# >= 6 (engine x applier) pairs run via --ordering and grade valid with
+# the STOCK checkers (acceptance criterion); tiny configs, see module
+# docstring.
+
+@pytest.mark.parametrize("workload,engine", [
+    ("lin-kv", "raft"),
+    ("lin-kv", "compartment"),
+    ("lin-kv", "batched"),
+    ("kafka", "raft"),
+    ("kafka", "compartment"),
+    ("txn-list-append", "batched"),
+])
+def test_combination_grades_valid(workload, engine):
+    res = run({"workload": workload, "ordering": engine,
+               "name": f"{workload}-over-{engine}"})
+    assert res["valid"] is True, res
+    assert res["workload"]["valid"] is True
+
+
+@pytest.mark.multichip
+def test_ordered_mesh_identity():
+    """A composed program under --mesh 1,2 is byte-identical to plain
+    (the role-partitioned compartment engine exercises the role-aware
+    state_row extraction on the sharded path)."""
+    run({"workload": "lin-kv", "ordering": "compartment",
+         "name": "mesh-plain"})
+    h1 = hist_md5()
+    run({"workload": "lin-kv", "ordering": "compartment",
+         "name": "mesh-sharded", "mesh": "1,2"})
+    assert hist_md5() == h1
+
+
+# --- legacy welded paths: unchanged by the extraction --------------------
+# Digest pins recorded at the extraction PR: the raft, compartment, and
+# batched-broadcast device programs were not touched, so these seeds'
+# histories must stay byte-identical (plain; the mesh-vs-plain identity
+# of the same paths is pinned by test_sharded_runner /
+# test_compartment / test_broadcast_batched).
+
+LEGACY_PINS = [
+    ({"workload": "lin-kv", "node": "tpu:lin-kv", "name": "legacy-raft"},
+     "329c018996ee21daa5eb5f9f901391e5"),
+    ({"workload": "lin-kv", "node": "tpu:compartment",
+      "name": "legacy-compartment"},
+     "0faa6484d6fcd53ae65a040fb60bf7ee"),
+    ({"workload": "broadcast-batched", "node": "tpu:broadcast-batched",
+      "name": "legacy-batched"},
+     "8a5297c46f38c492b8f3525d55ad3af5"),
+]
+
+
+@pytest.mark.parametrize("opts,digest", LEGACY_PINS)
+def test_legacy_history_digest_unchanged(opts, digest):
+    res = run(opts)
+    assert res["valid"] is True
+    assert hist_md5() == digest
+
+
+# --- slow: combined-nemesis soup on a NEW combination --------------------
+
+@pytest.mark.slow
+def test_soup_kafka_over_elected_compartment():
+    """kafka partitions over the ELECTED compartment slot sequence
+    under the combined kill/pause/partition/duplicate soup with
+    sequencer-targeted kills: failovers happen mid-stream and the
+    stock kafka checker still grades the expanded history valid."""
+    res = run({"workload": "kafka", "ordering": "compartment",
+               "roles": "sequencers=2,proxies=2,acceptors=2x2,"
+                        "replicas=2",
+               "rate": 20.0, "time_limit": 4.0, "timeout_ms": 400,
+               "nemesis": {"kill", "pause", "partition", "duplicate"},
+               "nemesis_interval": 0.8,
+               "nemesis_targets": "kill=sequencer",
+               "recovery_s": 2, "name": "soup-kafka-compartment"})
+    assert res["workload"]["valid"] is True, res["workload"]
+    assert res["valid"] is True, res
