@@ -1465,6 +1465,124 @@ def _main_ordering():
         sys.exit(1)
 
 
+def bench_byzantine_record() -> dict:
+    """The conviction contract made a number (doc/faults.md "byzantine
+    is a conviction driver"): the SAME compartment cluster (2-candidate
+    sequencer tier, tight resend) runs once benign and once under the
+    equivocating-sequencer adversary (`--nemesis byzantine`), same
+    seed, and the record reports
+
+      - conviction latency: rounds from the first start-byzantine
+        invoke to the proxy tier's first-conviction round stamp (the
+        device `z_*_rnd` witness field surfaced in the conviction
+        evidence),
+      - injected-vs-convicted ledger straight from the `byzantine`
+        results block,
+      - client-ops/s benign vs under attack (the price of running next
+        to a liar who gets caught).
+
+    Gates: the byzantine block must grade valid (every injected
+    corruption convicted, none spurious) and the benign run must grade
+    valid with NO byzantine block — a conviction bench that convicted
+    nobody, or convicted the innocent, measured nothing."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from maelstrom_tpu import core
+
+    rate = float(os.environ.get("BENCH_BYZ_RATE", 200.0))
+    tl = float(os.environ.get("BENCH_BYZ_TIME_LIMIT", 6.0))
+    interval = float(os.environ.get("BENCH_BYZ_INTERVAL", 1.5))
+    base = dict(
+        seed=3, workload="lin-kv", node="tpu:compartment",
+        roles="sequencers=2,proxies=2,acceptors=1x2,replicas=1",
+        concurrency=16, rate=rate, time_limit=tl,
+        journal_rows=False, audit=False,
+        compartment_retry=3, kv_keys=1024)
+    root = tempfile.mkdtemp(prefix="bench-byzantine-")
+    try:
+        t0 = time.perf_counter()
+        res_b = core.run(dict(base, store_root=root))
+        wall_b = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res_a = core.run(dict(
+            base, store_root=root,
+            nemesis={"byzantine"}, nemesis_interval=interval,
+            nemesis_targets="byzantine=sequencers",
+            byz_attacks="equivocation"))
+        wall_a = time.perf_counter() - t0
+        ns_pr = 1e6                       # 1 round == 1 virtual ms
+        starts = []
+        with open(os.path.join(root, "latest", "history.jsonl")) as f:
+            for ln in f:
+                o = json.loads(ln)
+                if o.get("process") == "nemesis" \
+                        and o.get("type") == "invoke" \
+                        and o.get("f") == "start-byzantine":
+                    starts.append(o["time"] / ns_pr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    blk = res_a.get("byzantine") or {}
+    convs = blk.get("convictions") or []
+    # the round the proxies FIRST convicted vs the round the nemesis
+    # first armed the adversary
+    conv_rounds = [c["evidence"]["round"] for c in convs
+                   if c.get("evidence", {}).get("round", -1) >= 0]
+    latency = (round(min(conv_rounds) - min(starts), 1)
+               if conv_rounds and starts else None)
+    ok_b = res_b["stats"]["ok-count"]
+    ok_a = res_a["stats"]["ok-count"]
+    return {
+        "attack": "equivocation",
+        "attack_windows": len(starts),
+        "conviction_latency_rounds": latency,
+        "injected": blk.get("injected"),
+        "convictions": [
+            {"rule": c["rule"], "culprit": c["culprit"],
+             "count": c["evidence"].get("count"),
+             "witness": c.get("witness")} for c in convs],
+        "byzantine_valid": blk.get("valid") is True,
+        "client_ops_per_vsec": {
+            "benign": round(ok_b / tl, 1),
+            "under_attack": round(ok_a / tl, 1),
+        },
+        "benign_valid": res_b["valid"] is True,
+        "benign_convictions": len(
+            (res_b.get("byzantine") or {}).get("convictions") or ()),
+        "offered_rate": rate, "time_limit_s": tl,
+        "nemesis_interval_s": interval,
+        "wall_s": {"benign": round(wall_b, 3),
+                   "under_attack": round(wall_a, 3)},
+        "host_cpus": os.cpu_count(),
+        "devices": jax.device_count(),
+        "valid": blk.get("valid") is True and res_b["valid"] is True
+        and "byzantine" not in res_b,
+    }
+
+
+def _main_byzantine():
+    """`BENCH_MODE=byzantine`: the conviction record as its own
+    artifact, headline `value` = rounds from injection to the first
+    device conviction (same JSON-line contract as the other modes).
+    Exits nonzero when the byzantine block graded invalid (an injected
+    corruption escaped conviction, or an innocent node was convicted)
+    or the benign twin wasn't clean."""
+    rec = bench_byzantine_record()
+    record = {
+        "metric": "byzantine_conviction_latency_rounds",
+        "value": rec["conviction_latency_rounds"],
+        "unit": "rounds",
+        "vs_baseline": None,
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"]:
+        sys.exit(1)
+
+
 def main():
     from maelstrom_tpu.util import honor_jax_platforms
     honor_jax_platforms()   # JAX_PLATFORMS=cpu smoke runs; no-op unset
@@ -1500,6 +1618,9 @@ def main():
     elif mode == "ordering":
         metric, unit = "ordering_client_ops_per_vsec", "client-ops/vsec"
         fn = _main_ordering
+    elif mode == "byzantine":
+        metric, unit = "byzantine_conviction_latency_rounds", "rounds"
+        fn = _main_byzantine
     else:
         metric = ("raft_cluster_rounds_per_sec_10k_clusters" if raft
                   else "broadcast_sim_msgs_per_sec_100k_nodes")

@@ -68,6 +68,18 @@ WORKLOAD_NODES = {
                           "replicas=2",
                  "nemesis": {"kill", "pause", "partition",
                              "duplicate"}}},
+    # the byzantine adversary (doc/faults.md "byzantine is a conviction
+    # driver") threads a corruption-mask rewrite (`byzantine.corrupt_
+    # pool` and the proxies' detection/NACK lanes) through the compiled
+    # round, so the gate traces the attacked elected compartment as its
+    # own scan variant — the byz_mask machinery must stay free of new
+    # hazards (host transfers, unstable sorts) at zero findings
+    "compartment-byzantine": {
+        "workload": "lin-kv", "node": "tpu:compartment",
+        "opts": {"node_count": None,
+                 "roles": "sequencers=2,proxies=2,acceptors=2x2,"
+                          "replicas=2",
+                 "nemesis": {"byzantine"}}},
     "lin-tso": {"workload": "lin-tso", "node": "tpu:services",
                 "opts": {"node_count": None}},
     # the ordering-layer axis (doc/ordering.md): `--ordering` composes

@@ -1,0 +1,225 @@
+"""The Byzantine adversary layer: seeded wire corruption that checkers
+must CONVICT.
+
+Every other nemesis package is benign — nodes fail by stopping or
+delaying, never by lying. The ``byzantine`` package corrupts *message
+contents* mid-flight, attacking the two audit surfaces the repo already
+built: the batched-broadcast expansion proofs (doc/perf.md,
+`checkers/set_full.py verify_batch_proofs`) and the compartment's
+end-to-end ballot fencing (doc/compartment.md). Three attack kinds:
+
+  - ``equivocation``  — a compromised sequencer assigns the same slot
+    different commands on different emissions (the corruption varies
+    per round, so any two deliveries of one slot/ballot conflict).
+  - ``forged-proof``  — a batched-broadcast node acks a `(lo, n,
+    checksum)` range it never expanded: the count is inflated on odd
+    rounds, the checksum forged on even ones.
+  - ``stale-ballot``  — a sequencer's T_ASSIGN traffic is re-stamped
+    with a ballot outside its own residue class, the wire-side replay
+    of a deposed leader's fenced traffic (ballots are `k*S + me`, so
+    an honest ballot always satisfies `bal % S == src`).
+
+Acceptance is inverted relative to the benign packages: a byzantine run
+is *valid only if every injected corruption kind is convicted* — a
+`(rule, culprit, evidence)` triple in the ``byzantine`` results block —
+and benign runs must stay conviction-free. Injected-but-unconvicted is
+the framework's own test failure, not the adversary "winning".
+
+Determinism: the attack plan (kind, culprit, nonce) comes from the
+``byzantine`` `NemesisDecisions` stream (same contract as kill/pause),
+and the per-round injection gate is a pure integer hash of
+`(round, nonce)` — no PRNG state is consumed, so enabling the adversary
+leaves every benign decision stream byte-identical. On the TPU path the
+corruption is a compiled mask rewrite inside the jitted round
+(`corrupt_pool` / `corrupt_edge` below — scatter one-hots, no host
+transfers); the host path corrupts the delivered copy in `HostNet.send`
+from the same decision stream, so both paths inject the identical
+adversary schedule per seed (doc/faults.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+# attack kinds, in decision-stream draw order; index = the device-side
+# `byz["attack"]` code and the `injected` ledger slot
+ATTACKS = ("equivocation", "forged-proof", "stale-ballot")
+
+# conviction rule -> the attack kind it convicts. Checker rules are
+# finer-grained than attack kinds (the proof auditor distinguishes a
+# forged checksum from an inflated count), so the validity fold maps
+# each rule back to the attack whose injection it proves.
+RULE_ATTACK = {
+    "equivocation": "equivocation",
+    "stale-ballot": "stale-ballot",
+    "forged-proof": "forged-proof",
+    "forged-count": "forged-proof",
+    "truncated-batch": "forged-proof",
+    "malformed-ack": "forged-proof",
+    "duplicate-in-batch": "forged-proof",
+    "replayed-batch": "forged-proof",
+}
+
+# 2654435761 (Knuth's multiplicative hash) as a wrapped int32
+_HASH_MULT = -1640531535
+
+# payload fields a forged-proof host corruption touches; the host wire
+# auditor classifies a send/recv body diff confined to these keys as a
+# proof forgery (checkers/byzantine.py)
+PROOF_FIELDS = ("lo", "n", "count", "proof", "batch_units")
+
+
+def byz_enabled(opts) -> bool:
+    """Whether the byzantine fault is in this run's fault set — the
+    STATIC gate for program-side corruption hooks and evidence state
+    (mirrors `runner.tpu_runner.TpuRunner._fault_set`). Static so that
+    benign runs compile none of the adversary path and their state
+    trees stay byte-identical."""
+    pkg = opts.get("nemesis_pkg") or {}
+    faults = pkg.get("faults")
+    if faults is None:
+        faults = opts.get("nemesis")
+    if not faults:
+        return False
+    if isinstance(faults, str):
+        return faults == "byzantine"
+    if isinstance(faults, (set, frozenset, list, tuple)):
+        return "byzantine" in faults
+    return False
+
+
+# --- device-side adversary state -------------------------------------------
+
+
+def init_state() -> dict:
+    """The zeroed adversary carry. Rides `SimState.byz` (a plain dict —
+    pytree-friendly, donated with the rest of the carry) when the run's
+    fault set includes byzantine; None otherwise, so benign carries are
+    shape-identical to pre-adversary builds."""
+    z = jnp.zeros((), I32)
+    return {"active": z, "attack": z,
+            "culprit": jnp.full((), -1, I32),
+            "delta": jnp.ones((), I32),
+            "rate_q": z,
+            # corruptions applied so far, one slot per ATTACKS entry —
+            # the ledger the conviction contract is audited against
+            "injected": jnp.zeros((len(ATTACKS),), I32)}
+
+
+def start_state(byz: dict, attack: str, culprit: int, delta: int,
+                rate: float) -> dict:
+    """start-byzantine surgery: installs one drawn plan (host-side
+    scalars; the runner reshards the updated carry)."""
+    return {**byz,
+            "active": jnp.ones((), I32),
+            "attack": jnp.full((), ATTACKS.index(attack), I32),
+            "culprit": jnp.full((), int(culprit), I32),
+            "delta": jnp.full((), int(delta), I32),
+            "rate_q": jnp.full((), int(round(float(rate) * 1000)), I32)}
+
+
+def stop_state(byz: dict) -> dict:
+    """stop-byzantine surgery: deactivates injection, keeping the
+    injected ledger (convictions are audited against the whole run)."""
+    return {**byz, "active": jnp.zeros((), I32)}
+
+
+def _gate(byz: dict, rnd):
+    """The per-round injection gate: active AND a pure integer hash of
+    (round, nonce) clears the rate threshold (permille). No PRNG state
+    is consumed, so the benign decision streams never shift."""
+    h = (rnd * I32(_HASH_MULT) + byz["delta"]) & I32(0x7FFFFFFF)
+    return (byz["active"] > 0) & (h % 1000 < byz["rate_q"])
+
+
+def culprit_rows(batch, culprit):
+    """[N, L] mask selecting the culprit's outbox rows (src is the
+    implicit leading row index pre-flatten)."""
+    n = batch.valid.shape[0]
+    return (jnp.arange(n, dtype=I32) == culprit)[:, None]
+
+
+def _apply(wires: dict, byz: dict, batch, rnd):
+    """Shared applier over one [N, L] Msgs batch: for each attack kind
+    the program wires, rewrite the masked rows' payload words and book
+    the injection count. Pure jnp — compiles into the round body."""
+    gate = _gate(byz, rnd)
+    injected = byz["injected"]
+    for idx, name in enumerate(ATTACKS):
+        fn = wires.get(name)
+        if fn is None:
+            continue
+        mask, na, nb, nc = fn(batch, byz["culprit"], byz["delta"], rnd)
+        m = mask & batch.valid & gate & (byz["attack"] == idx)
+        batch = batch.replace(a=jnp.where(m, na, batch.a),
+                              b=jnp.where(m, nb, batch.b),
+                              c=jnp.where(m, nc, batch.c))
+        injected = injected.at[idx].add(jnp.sum(m.astype(I32)))
+    return {**byz, "injected": injected}, batch
+
+
+def corrupt_pool(program, byz, outbox, rnd):
+    """Applies this round's corruption to the pool-path [N, O] outbox,
+    per the program's `byz_wire()` hook: {attack name: fn(outbox,
+    culprit, delta, rnd) -> (mask, a, b, c)}. Programs without the hook
+    (or attack kinds they don't wire) inject nothing — and an attack
+    that injects nothing demands no conviction."""
+    hook = getattr(program, "byz_wire", None)
+    if byz is None or hook is None:
+        return byz, outbox
+    wires = hook()
+    if not wires:
+        return byz, outbox
+    return _apply(wires, byz, outbox, rnd)
+
+
+def corrupt_edge(program, byz, client_out, rnd):
+    """The edge-path analogue over the [N, K] client-reply batch, per
+    `byz_wire_edge()` (the forged-proof surface: batch acks)."""
+    hook = getattr(program, "byz_wire_edge", None)
+    if byz is None or hook is None:
+        return byz, client_out
+    wires = hook()
+    if not wires:
+        return byz, client_out
+    return _apply(wires, byz, client_out, rnd)
+
+
+# --- conviction assembly ---------------------------------------------------
+
+
+def conviction(rule: str, culprit, evidence, witness=None) -> dict:
+    """One conviction triple, as surfaced in the `byzantine` results
+    block: the violated rule, the node it names, and the evidence that
+    proves it. `code` is the definite Byzantine error (errors.py)."""
+    from .errors import BYZANTINE
+    out = {"rule": rule, "culprit": culprit, "evidence": evidence,
+           "code": int(BYZANTINE.code)}
+    if witness is not None:
+        out["witness"] = witness
+    return out
+
+
+def assemble_block(convictions: list, injected: dict) -> dict:
+    """Folds the run's convictions against its injection ledger into
+    the `byzantine` results block. Valid iff every attack kind that
+    injected at least one corruption has >= 1 conviction whose rule
+    maps to it (RULE_ATTACK), and no conviction names an attack that
+    injected nothing (a spurious conviction on a benign run is a
+    checker bug — exactly as failing as a missed one)."""
+    inj = {a: int(injected.get(a, 0)) for a in ATTACKS}
+    convicted: set = set()
+    spurious: list = []
+    for c in convictions:
+        atk = RULE_ATTACK.get(c.get("rule"))
+        if atk is not None and inj.get(atk, 0) > 0:
+            convicted.add(atk)
+        else:
+            spurious.append(c.get("rule"))
+    unconvicted = sorted(a for a, k in inj.items()
+                         if k > 0 and a not in convicted)
+    return {"convictions": list(convictions), "injected": inj,
+            "unconvicted": unconvicted, "spurious": spurious,
+            "valid": not unconvicted and not spurious}

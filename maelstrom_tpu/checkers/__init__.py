@@ -31,6 +31,16 @@ class Checker:
         stay bit-identical to the history-only path."""
         return None
 
+    def convictions(self, test: dict, history, opts: dict | None = None):
+        """Byzantine conviction hook (doc/faults.md): a list of
+        ``{"rule", "culprit", "evidence", ...}`` dicts, one per lying
+        node this checker can PROVE misbehaved (byzantine.conviction()
+        builds them). Compose gathers these from every checker into the
+        ``byzantine`` results block; a run under ``--nemesis byzantine``
+        is valid only if every injected corruption is convicted, and a
+        benign run must stay conviction-free. Default: nothing to say."""
+        return []
+
 
 def merge_valid(vs) -> bool | str:
     """Jepsen semantics for composing validity: false dominates, then
@@ -62,9 +72,33 @@ class Compose(Checker):
                 results[name] = {"valid": "unknown",
                                  "error": repr(e),
                                  "traceback": traceback.format_exc()}
+        self._check_convictions(test, history, opts, results)
         results["valid"] = merge_valid(
             r.get("valid", "unknown") for r in results.values())
         return results
+
+    def _check_convictions(self, test, history, opts, results):
+        """Gather Byzantine convictions from every checker and grade
+        them against the injection ledger (test["byz_injected"], set by
+        the runner). The block only appears when a byzantine nemesis ran
+        or a checker actually convicted someone — benign runs that stay
+        conviction-free produce no block at all."""
+        convictions, cerrs = [], []
+        for c in self.checkers.values():
+            try:
+                convictions.extend(c.convictions(test, history, opts or {}))
+            except Exception as e:  # a crashed auditor can't prove innocence
+                cerrs.append({"checker": c.name, "error": repr(e),
+                              "traceback": traceback.format_exc()})
+        injected = test.get("byz_injected")
+        if injected is None and not convictions and not cerrs:
+            return
+        from ..byzantine import assemble_block
+        block = assemble_block(convictions, injected or {})
+        if cerrs:
+            block["errors"] = cerrs
+            block["valid"] = False
+        results["byzantine"] = block
 
 
 class UnhandledExceptions(Checker):

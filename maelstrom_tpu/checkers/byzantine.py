@@ -1,0 +1,131 @@
+"""Byzantine conviction checkers (doc/faults.md "byzantine is a
+conviction driver").
+
+Two auditors, one per execution path, both surfacing
+``(rule, culprit, evidence)`` triples through the `Checker.convictions`
+hook that `Compose` folds into the ``byzantine`` results block
+(`byzantine.assemble_block`):
+
+  - ``ByzantineChecker`` (host path) audits the network journal: the
+    send event books the HONEST body before `HostNet._corrupt` rewrites
+    the delivered copy, and the recv event books what actually arrived
+    under the same message id — so every wire lie is provable from the
+    record, and the diff's shape classifies the attack kind.
+  - ``TpuByzantine`` (TPU path) reads the device-side evidence counters
+    the node program accumulated inside the compiled round
+    (`NodeProgram.byz_evidence`, e.g. the compartment proxies'
+    equivocation/stale-ballot ledgers): the TPU journal keeps no bodies,
+    so conviction evidence must ride the state tree.
+
+Workload checkers may convict too (`BatchedBroadcastChecker` maps its
+expansion-proof audit errors to forged-proof convictions) — Compose
+gathers from EVERY checker, so whichever audit surface the corruption
+hit does the convicting.
+"""
+
+from __future__ import annotations
+
+from . import Checker
+from ..byzantine import PROOF_FIELDS, conviction
+from ..net.journal import RECV, SEND
+from ..util import is_client
+
+
+def classify_wire_diff(sent: dict, received: dict, prior: list) -> str:
+    """Names the rule a corrupted delivery violates, from the shape of
+    the send/recv body diff:
+
+      - the delivered body is byte-equal to an EARLIER send from the
+        same culprit -> ``stale-ballot`` (old traffic replayed over new)
+      - the diff is confined to the proof vocabulary (`PROOF_FIELDS`)
+        -> ``forged-proof``
+      - anything else -> ``equivocation`` (same send, different story)
+    """
+    if any(p == received for p in prior):
+        return "stale-ballot"
+    keys = {k for k in set(sent) | set(received)
+            if sent.get(k) != received.get(k)}
+    if keys and keys <= set(PROOF_FIELDS):
+        return "forged-proof"
+    return "equivocation"
+
+
+class ByzantineChecker(Checker):
+    """Host-path wire auditor: convicts from the net journal's
+    send-vs-recv body record. Its own `check` block is trivially valid —
+    the verdict that matters is the Compose-assembled ``byzantine``
+    block, graded against the injection ledger."""
+
+    name = "byzantine"
+
+    def __init__(self, net):
+        self.net = net
+
+    def check(self, test, history, opts=None):
+        journal = getattr(self.net, "journal", None)
+        return {"valid": True,
+                "audited-events": len(journal.events)
+                if journal is not None else 0}
+
+    def convictions(self, test, history, opts=None):
+        journal = getattr(self.net, "journal", None)
+        if journal is None:
+            return []
+        with journal.lock:
+            events = list(journal.events)
+        # first pass: per-id honest send body + each sender's prior-send
+        # prefix (the replay evidence pool), inter-server traffic only
+        sends: dict = {}            # id -> (body, prefix_len)
+        prior: dict = {}            # src -> [bodies in send order]
+        for e in events:
+            if e.type != SEND or e.body is None \
+                    or is_client(e.src) or is_client(e.dest):
+                continue
+            log = prior.setdefault(e.src, [])
+            sends[e.id] = (e.body, len(log))
+            log.append(e.body)
+        # second pass: any delivery whose body disagrees with its own
+        # send record is a wire lie by the sender; aggregate per
+        # (rule, culprit) so rate-1.0 windows stay readable
+        agg: dict = {}
+        for e in events:
+            if e.type != RECV or e.body is None or e.id not in sends:
+                continue
+            sent, upto = sends[e.id]
+            if e.body == sent:
+                continue
+            rule = classify_wire_diff(sent, e.body,
+                                      prior.get(e.src, [])[:upto])
+            key = (rule, e.src)
+            if key in agg:
+                agg[key]["evidence"]["count"] += 1
+            else:
+                agg[key] = conviction(rule, e.src, {
+                    "count": 1, "msg_id": e.id,
+                    "sent": dict(sent), "received": dict(e.body)},
+                    witness=e.dest)
+        return list(agg.values())
+
+
+class TpuByzantine(Checker):
+    """TPU-path conviction source: surfaces the device-resident evidence
+    ledgers the node program accumulated in its compiled round
+    (`NodeProgram.byz_evidence(nodes_host) -> [conviction...]`). The
+    run-level injection ledger (`SimState.byz["injected"]`) lands in
+    `test["byz_injected"]` via `run_tpu_test`, so Compose grades these
+    convictions against exactly what the compiled masks rewrote."""
+
+    name = "byzantine"
+
+    def __init__(self, runner):
+        self.runner = runner
+
+    def check(self, test, history, opts=None):
+        return {"valid": True,
+                "injected": dict(test.get("byz_injected") or {})}
+
+    def convictions(self, test, history, opts=None):
+        fn = getattr(self.runner.program, "byz_evidence", None)
+        if fn is None:
+            return []
+        return list(fn(self.runner._nodes_host()))

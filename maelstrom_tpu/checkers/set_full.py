@@ -208,6 +208,7 @@ def verify_batch_proofs(history) -> tuple[list, dict]:
         keys = [repr(v) for v in claimed]
         if len(set(keys)) != len(keys):
             errors.append({"index": invoke.index,
+                           "process": invoke.process,
                            "error": "duplicate-in-batch"})
         if complete is None or not complete.is_ok():
             continue
@@ -216,24 +217,29 @@ def verify_batch_proofs(history) -> tuple[list, dict]:
         if not (isinstance(rec, dict)
                 and {"lo", "n", "proof", "expanded"} <= set(rec)):
             errors.append({"index": invoke.index,
+                           "process": invoke.process,
                            "error": "malformed-ack", "value": rec})
             continue
         lo, n = int(rec["lo"]), int(rec["n"])
         expanded = list(rec["expanded"])
         if n != len(claimed) or n != len(expanded):
-            errors.append({"index": invoke.index, "error": "forged-count",
+            errors.append({"index": invoke.index,
+                           "process": invoke.process, "error": "forged-count",
                            "claimed": len(claimed), "acked": n,
                            "expanded": len(expanded)})
         if expanded != claimed:
             errors.append({"index": invoke.index,
+                           "process": invoke.process,
                            "error": "truncated-batch",
                            "claimed": claimed, "expanded": expanded})
         if int(rec["proof"]) != range_checksum(lo, n):
-            errors.append({"index": invoke.index, "error": "forged-proof",
+            errors.append({"index": invoke.index,
+                           "process": invoke.process, "error": "forged-proof",
                            "proof": int(rec["proof"]),
                            "expected": range_checksum(lo, n)})
         if lo in acked_lo:
             errors.append({"index": invoke.index,
+                           "process": invoke.process,
                            "error": "replayed-batch", "lo": lo,
                            "first": acked_lo[lo]})
         else:
@@ -296,3 +302,27 @@ class BatchedBroadcastChecker(Checker):
         if errors:
             out["valid"] = False
         return out
+
+    def convictions(self, test, history, opts=None):
+        """Byzantine conviction hook (doc/faults.md): every expansion-
+        proof audit error doubles as a conviction of the node that
+        served the batch — the proof vocabulary is exactly the surface
+        the forged-proof attack corrupts, and the audit is a definite
+        fail either way. Culprit: batch acks come from the client's
+        home node (`process % N`, the runner's routing for non-leader
+        programs on both paths)."""
+        from ..byzantine import conviction
+        errors, _stats = verify_batch_proofs(history)
+        nodes = list(test.get("nodes") or ())
+        agg: dict = {}
+        for e in errors:
+            p = e.get("process")
+            culprit = (nodes[p % len(nodes)]
+                       if nodes and isinstance(p, int) else "unknown")
+            key = (e["error"], culprit)
+            if key in agg:
+                agg[key]["evidence"]["count"] += 1
+            else:
+                agg[key] = conviction(e["error"], culprit,
+                                      {"count": 1, **e})
+        return list(agg.values())

@@ -135,7 +135,13 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # (doc/ordering.md) selects the composed
                     # engine x applier program — both shape the op
                     # stream, so a resume must pin them
-                    "leader_lease_ms", "ordering")
+                    "leader_lease_ms", "ordering",
+                    # byzantine adversary (doc/faults.md): the attack
+                    # pool and injection rate shape both the decision
+                    # stream and the per-round corruption masks, so a
+                    # resumed run must replay the identical adversary
+                    # (the package seed rides `seed`/`nemesis_seed`)
+                    "byz_rate", "byz_attacks")
 
 
 class CheckpointError(RuntimeError):

@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Fenced election ballot-counter width in bits "
                         "(<= 6, default 6); overflow stalls failover "
                         "and invalidates the run visibly")
+    t.add_argument("--compartment-retry", type=int, default=None,
+                   help="Sequencer T_ASSIGN resend cadence in rounds "
+                        "(default 10). Byzantine equivocation runs "
+                        "want it tight: a conviction needs a second "
+                        "delivery of the same slot inside the attack "
+                        "window (doc/faults.md)")
     t.add_argument("--timeout-ms", type=float, default=None,
                    help="Client RPC timeout in virtual ms (default "
                         "5000). Failover runs want it tight: ops in "
@@ -120,6 +126,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "come from the node family's fault_groups "
                         "(role names, acceptor grid rows/columns) or "
                         "literal node names; '+' joins several")
+    t.add_argument("--byz-rate", type=float, default=1.0,
+                   help="Byzantine injection probability per round "
+                        "while an attack window is open (--nemesis "
+                        "byzantine; a pure hash gate, so the benign "
+                        "decision streams never shift)")
+    t.add_argument("--byz-attacks", default=None,
+                   help="Restrict the byzantine package's attack pool: "
+                        "comma list from equivocation, forged-proof, "
+                        "stale-ballot (default: all three; "
+                        "doc/faults.md)")
     t.add_argument("--nemesis-seed", type=int, default=None,
                    help="Decouple the fault-schedule RNG from --seed "
                         "(default: follow --seed). This is how a single "
@@ -425,7 +441,8 @@ def opts_from_args(args) -> dict:
               "continuous_window_ms", "batch_max", "max_values",
               "roles", "service_roles", "nemesis_targets",
               "election_timeout_rounds", "ballot_width", "timeout_ms",
-              "ordering", "leader_lease_ms"):
+              "ordering", "leader_lease_ms", "byz_rate", "byz_attacks",
+              "compartment_retry"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

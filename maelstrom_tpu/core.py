@@ -112,6 +112,14 @@ DEFAULTS = dict(
     # `nemesis_targets` scopes fault packages to named role groups
     # ("kill=proxies,partition=acceptor-col-0")
     roles=None, service_roles=None, nemesis_targets=None,
+    # byzantine adversary (doc/faults.md "byzantine is a conviction
+    # driver"): --nemesis byzantine corrupts messages instead of
+    # delivery. byz_rate is the per-round injection probability while a
+    # window is open (a pure hash gate — no PRNG stream is consumed);
+    # byz_attacks restricts the drawn attack kinds (comma list from
+    # byzantine.ATTACKS; None = all). Both fingerprint keys: a resumed
+    # run must replay the identical adversary.
+    byz_rate=1.0, byz_attacks=None,
     # leader election + failover (doc/compartment.md "leader
     # election"): with --roles sequencers=S (S > 1) the compartment's
     # sequencer is ELECTED — ballot-numbered MultiPaxos phase 1 over
@@ -278,6 +286,17 @@ def build_test(opts: dict) -> dict:
 
     nemesis_pkg = nem.package(set(opts["nemesis"]),
                               interval_s=opts["nemesis_interval"])
+    if opts.get("byz_attacks") is not None:
+        from .byzantine import ATTACKS
+        raw = opts["byz_attacks"]
+        atks = tuple(s.strip() for s in str(raw).split(",")
+                     if s.strip()) \
+            if isinstance(raw, str) else tuple(raw)
+        bad = [a for a in atks if a not in ATTACKS]
+        if bad or not atks:
+            raise ValueError(f"--byz-attacks: unknown attack(s) {bad}; "
+                             f"expected any of {list(ATTACKS)}")
+        opts["byz_attacks"] = atks
 
     # Generator composition (reference core.clj:58-71)
     rate = opts["rate"]
@@ -309,6 +328,12 @@ def build_test(opts: dict) -> dict:
         "net": NetStatsChecker(net),
         "workload": workload["checker"],
     })
+    if "byzantine" in set(opts["nemesis"]):
+        # the host-path wire auditor (run_tpu_test swaps in the
+        # device-evidence checker); Compose assembles the `byzantine`
+        # results block from every checker's convictions
+        from .checkers.byzantine import ByzantineChecker
+        checker.checkers["byzantine"] = ByzantineChecker(net)
 
     test = {**opts,
             "name": name,
@@ -360,9 +385,17 @@ def _run(test: dict, net: HostNet, test_dir: str) -> dict:
     # so target groups resolve against literal node names only
     targets = nem.resolve_targets(test.get("nemesis_targets"), {},
                                   test["nodes"])
+    # captured BEFORE test["nemesis"] is rebound to the nemesis object
+    byz_on = "byzantine" in set(test.get("nemesis") or ())
     test["nemesis"] = (nem.CombinedNemesis(net, test["nodes"],
                                            seed=test["seed"], db=db,
-                                           targets=targets)
+                                           targets=targets,
+                                           attacks=test.get("byz_attacks"),
+                                           # NOT `or 1.0`: an explicit
+                                           # rate of 0.0 must stick
+                                           byz_rate=1.0
+                                           if test.get("byz_rate") is None
+                                           else float(test["byz_rate"]))
                        if test["nemesis_pkg"]["generator"] is not None
                        else None)
     log.info("Running test %s with nodes %s", test["name"], test["nodes"])
@@ -376,6 +409,13 @@ def _run(test: dict, net: HostNet, test_dir: str) -> dict:
 
     for e in crashes:
         log.error("node crash: %s", e)
+    if byz_on:
+        # host injection ledger (HostNet._corrupt books every rewrite):
+        # the conviction contract grades against it, same as the TPU
+        # path's device ledger
+        from .byzantine import ATTACKS
+        test["byz_injected"] = {a: int(net.byz_injected.get(a, 0))
+                                for a in ATTACKS}
     results = test["checker"].check(test, history, {})
     if crashes:
         results["node-crashes"] = [str(e) for e in crashes]

@@ -114,6 +114,17 @@ NOT_LEADER = deferror(
     definite=True)
 
 
+BYZANTINE = deferror(
+    32, "byzantine",
+    "The receiver detected Byzantine (lying) behavior in this message — "
+    "an equivocating assignment, a ballot outside the sender's residue "
+    "class, or a forged expansion proof — and definitely did not act on "
+    "it. The rejection is also booked as conviction evidence for the "
+    "`byzantine` results block (doc/faults.md 'byzantine is a "
+    "conviction driver').",
+    definite=True)
+
+
 class RPCError(Exception):
     """An error body returned by a node in response to an RPC
     (reference `client.clj:186-199`)."""

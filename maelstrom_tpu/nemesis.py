@@ -47,7 +47,8 @@ import random
 
 from . import generators as g
 
-FAULTS = ("partition", "kill", "pause", "duplicate", "weather")
+FAULTS = ("partition", "kill", "pause", "duplicate", "weather",
+          "byzantine")
 
 # duplication probabilities the duplicate package cycles through
 DUP_PROBS = (0.1, 0.25, 0.5)
@@ -165,7 +166,7 @@ def isolate_set(nodes, cut):
 # faults whose decisions pick NODES and can therefore be scoped;
 # duplicate/weather are cluster-global knobs, so a target spec for them
 # would be silently meaningless — rejected up front instead
-TARGETABLE_FAULTS = ("kill", "pause", "partition")
+TARGETABLE_FAULTS = ("kill", "pause", "partition", "byzantine")
 
 
 def parse_targets(spec) -> dict:
@@ -269,10 +270,14 @@ class NemesisDecisions:
     the decision sequence of each package does not depend on how the
     packages happen to interleave in real vs virtual time."""
 
-    def __init__(self, nodes, seed: int = 0, targets: dict | None = None):
+    def __init__(self, nodes, seed: int = 0, targets: dict | None = None,
+                 attacks=None):
         self.nodes = list(nodes)
         self.seed = seed
         self.rngs = {f: random.Random(f"{seed}:{f}") for f in FAULTS}
+        # byzantine attack pool (--byz-attacks): restricts which attack
+        # kinds the byzantine package draws; None = all of byzantine.ATTACKS
+        self.byz_attacks = tuple(attacks) if attacks else None
         # legacy alias: pre-combined checkpoints stored a single rng
         self.rng = self.rngs["partition"]
         # role-targeted scoping (resolve_targets): {fault: [node names]}
@@ -339,6 +344,20 @@ class NemesisDecisions:
         """(name, p_loss, latency_scale) for the next weather front."""
         return self.rngs["weather"].choice(WEATHER_FRONTS)
 
+    def next_byz_plan(self) -> tuple:
+        """(attack, culprit, delta) for the next byzantine window: the
+        attack kind, the lying node, and the corruption nonce. Drawn
+        from the byzantine package's own stream so host and TPU inject
+        the identical adversary schedule per seed (doc/faults.md)."""
+        from .byzantine import ATTACKS
+        rng = self.rngs["byzantine"]
+        pool = self._expand_pool(self.targets.get("byzantine")) \
+            or self.nodes
+        culprit = rng.choice(sorted(pool))
+        attack = rng.choice(list(self.byz_attacks or ATTACKS))
+        delta = rng.randint(1, 0x7FFF)
+        return attack, culprit, delta
+
     # checkpoint/resume: the decision streams plus the active-fault
     # bookkeeping must survive together
     def rng_state(self):
@@ -368,10 +387,12 @@ class CombinedNemesis(NemesisDecisions):
     jepsen.nemesis.combined/compose-packages."""
 
     def __init__(self, net, nodes, seed: int = 0, db=None,
-                 targets: dict | None = None):
-        super().__init__(nodes, seed, targets=targets)
+                 targets: dict | None = None, attacks=None,
+                 byz_rate: float = 1.0):
+        super().__init__(nodes, seed, targets=targets, attacks=attacks)
         self.net = net
         self.db = db
+        self.byz_rate = float(byz_rate)
         self.killed: list = []
         self.paused_nodes: list = []
         # weather baseline: the run's CONFIGURED loss/latency-scale (the
@@ -456,6 +477,15 @@ class CombinedNemesis(NemesisDecisions):
             self.net.latency_dist = self.net.latency_dist.unscaled() \
                 .scaled(self._base_lat_scale)
             return {**op, "type": "info", "value": "weather cleared"}
+        if f == "start-byzantine":
+            attack, culprit, delta = self.next_byz_plan()
+            self.net.set_byzantine(attack, culprit, delta,
+                                   rate=self.byz_rate)
+            return {**op, "type": "info",
+                    "value": f"byzantine {attack} culprit={culprit}"}
+        if f == "stop-byzantine":
+            self.net.clear_byzantine()
+            return {**op, "type": "info", "value": "byzantine cleared"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
 

@@ -123,6 +123,14 @@ class HostNet:
         self.dropped_partition = 0
         self.dup_count = 0
         self.partitions: dict[str, set[str]] = {}   # dest -> blocked srcs
+        # byzantine wire corruption (byzantine.py): the active attack
+        # plan, a per-src cache of the last honest body (the stale
+        # replay source), and the injection ledger the conviction
+        # contract is audited against ({attack name: count})
+        self._byz: dict | None = None
+        self._byz_rng = random.Random(0)
+        self._byz_prev: dict[str, dict] = {}
+        self.byz_injected: dict[str, int] = {}
         self.queues: dict[str, _NodeQueue] = {}
         self.next_client_id = itertools.count(0)
         self.next_message_id = itertools.count(0)
@@ -182,6 +190,64 @@ class HostNet:
         draw (same message id — it IS the same message, twice)."""
         self.p_dup = p
 
+    def set_byzantine(self, attack: str, culprit: str, delta: int,
+                      rate: float = 1.0):
+        """Installs one byzantine attack window (byzantine.py): the
+        `culprit`'s inter-server messages are corrupted per `attack`
+        with probability `rate`, on the DELIVERED copy only — the
+        journal keeps the honest body at send, so the wire auditor
+        (checkers/byzantine.py) can prove the lie from the record."""
+        self._byz = {"attack": attack, "culprit": culprit,
+                     "delta": int(delta), "rate": float(rate)}
+        # own stream, keyed off the plan nonce: corruption rolls must
+        # not perturb the shared loss/latency/dup draws
+        self._byz_rng = random.Random(f"byz:{delta}")
+
+    def clear_byzantine(self):
+        self._byz = None
+
+    def _corrupt(self, msg: Message) -> Message:
+        """Applies the active byzantine window to one send, returning
+        the (possibly) corrupted delivery copy and booking the
+        injection. Mirrors the TPU path's attack taxonomy on JSON
+        bodies: equivocation flips a value-carrying int field,
+        forged-proof bumps the proof/count fields, stale-ballot
+        replays the culprit's previous (already-journaled) body."""
+        bz = self._byz
+        if bz is None or involves_client(msg) or msg.src != bz["culprit"]:
+            return msg
+        body = msg.body if isinstance(msg.body, dict) else None
+        if body is None:
+            return msg
+        from ..byzantine import PROOF_FIELDS
+        prev = self._byz_prev.get(msg.src)
+        self._byz_prev[msg.src] = dict(body)
+        if self._byz_rng.random() >= bz["rate"]:
+            return msg
+        attack, new = bz["attack"], None
+        if attack == "stale-ballot":
+            if prev is not None and prev != body:
+                new = dict(prev)
+        elif attack == "forged-proof":
+            forged = {k: body[k] + 1 + (bz["delta"] & 3)
+                      for k in PROOF_FIELDS
+                      if isinstance(body.get(k), int)
+                      and not isinstance(body.get(k), bool)}
+            if forged:
+                new = {**body, **forged}
+        else:   # equivocation
+            skip = set(PROOF_FIELDS) | {"type", "msg_id", "in_reply_to"}
+            for k, v in body.items():
+                if k in skip or isinstance(v, bool) \
+                        or not isinstance(v, int):
+                    continue
+                new = {**body, k: v ^ ((bz["delta"] & 0x3F) | 1)}
+                break
+        if new is None or new == body:
+            return msg
+        self.byz_injected[attack] = self.byz_injected.get(attack, 0) + 1
+        return Message(id=msg.id, src=msg.src, dest=msg.dest, body=new)
+
     # --- send / recv (reference net.clj:188-246) ---
 
     @staticmethod
@@ -226,6 +292,10 @@ class HostNet:
         if self.rng.random() < self.p_loss:
             self.lost_count += 1
             return msg      # whoops, lost ur packet (net.clj:213-214)
+        # byzantine corruption hits the DELIVERED copy, after the send
+        # journal booked the honest body (the lie is provable from the
+        # record) — and a duplicated lie is the same lie twice
+        msg = self._corrupt(msg)
         dest_q.put(deadline_ns, msg)
         if (self.p_dup > 0 and not involves_client(msg)
                 and self.rng.random() < self.p_dup):

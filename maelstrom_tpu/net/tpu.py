@@ -165,6 +165,10 @@ class NetConfig:
     partition_groups: int = 1     # block-matrix side; 1 = component-only
     enable_stall: bool = False    # kill/pause masks honored in the round
     enable_duplication: bool = False  # duplicate fault path compiled in
+    # byzantine wire corruption (byzantine.py): when True the round body
+    # threads the adversary carry (SimState.byz) and applies the
+    # program-wired corruption masks to the outbox before send
+    enable_byz: bool = False
     # batched payload rows (doc/perf.md "batched atomic broadcast"):
     # ((type_code, word), ...) pairs declaring that messages of
     # `type_code` are distilled batches whose logical client-op count

@@ -82,6 +82,35 @@ class BroadcastBatchedProgram(BroadcastProgram):
         # digest protocol rather than a per-record proof.
         self.max_batch = min(int(opts.get("batch_max") or self.V),
                              self.V)
+        # byzantine forged-proof surface (byzantine.py): when the run's
+        # fault set includes the adversary, the culprit's T_BATCH_OK
+        # acks are corrupted on the wire (byz_wire_edge) and the proof
+        # auditor must convict (checkers/set_full.py)
+        from ..byzantine import byz_enabled
+        self.byz = byz_enabled(opts)
+
+    def byz_wire_edge(self):
+        """Compiled corruption of the client-facing batch acks: the
+        culprit node lies about its expansion — the count is inflated
+        on odd rounds, the checksum forged on even ones. Both shapes
+        are definite `verify_batch_proofs` failures (forged-count /
+        truncated-batch / forged-proof), and the corruption leaves the
+        honest `lo` so the record still pairs with its invoke."""
+        if not self.byz:
+            return {}
+        from ..byzantine import culprit_rows
+
+        def forge(client_out, culprit, delta, rnd):
+            m = (culprit_rows(client_out, culprit)
+                 & (client_out.type == T_BATCH_OK))
+            odd = (rnd & 1) > 0
+            nb = jnp.where(odd, client_out.b + 1 + (delta & 3),
+                           client_out.b)
+            nc = jnp.where(odd, client_out.c,
+                           client_out.c ^ ((delta & 0xFFFF) | 1))
+            return m, client_out.a, nb, nc
+
+        return {"forged-proof": forge}
 
     def _select_ranges(self, pending):
         """Per-edge maximal-run extraction: up to `per_nb` runs of

@@ -107,6 +107,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..byzantine import byz_enabled, culprit_rows
 from ..net.tpu import I32, Msgs, cat_lanes as _cat_lanes
 from ..sim import RolePartition
 from . import NodeProgram, register
@@ -139,6 +140,7 @@ T_CMT = 44       # leader -> acceptor:    a = done-frontier watermark
 # protocol error codes on the client surface
 E_UNAVAILABLE = 11   # leader table full: definite backpressure shed
 E_NOT_LEADER = 31    # contacted sequencer does not lead; b = hint or -1
+E_BYZANTINE = 32     # receiver convicted the message of lying (errors.py)
 
 NOOP_CMD = 0         # key 0 / OP_NOOP: fills recovered gaps, applies inert
 
@@ -835,9 +837,15 @@ class ProxyRole(NodeProgram):
     def __init__(self, opts, nodes, lay: Layout):
         super().__init__(opts, nodes)
         self.lay = lay
+        # byzantine conviction duty (byzantine.py): when the run's fault
+        # set includes the adversary, the proxy carries evidence
+        # counters and NACKs convicted messages (K extra outbox lanes).
+        # Static, so benign state trees stay byte-identical.
+        self.byz = byz_enabled(opts)
         self.inbox_cap = lay.K
         self.outbox_cap = lay.QP * lay.AR + lay.QP \
-            + (lay.K if lay.S > 1 else 0)
+            + (lay.K if lay.S > 1 else 0) \
+            + (lay.K if self.byz else 0)
 
     def init_state(self):
         n, Q, AR = self.n_nodes, self.lay.QP, self.lay.AR
@@ -850,6 +858,20 @@ class ProxyRole(NodeProgram):
               "p_acks": jnp.zeros((n, Q, AR), bool)}
         if self.lay.S > 1:
             st["p_bal"] = z(n, Q)
+        if self.byz:
+            # conviction evidence: per-proxy counts of equivocating
+            # re-assignments / residue-class ballot violations, plus
+            # the latest (src, slot-or-ballot) witness pair each.
+            # VOLATILE like the rest of the tier — evidence a kill
+            # wipes is evidence the run must re-collect.
+            st["z_eq"] = z(n)
+            st["z_sb"] = z(n)
+            st["z_eq_src"] = jnp.full((n,), -1, I32)
+            st["z_eq_slot"] = jnp.full((n,), -1, I32)
+            st["z_eq_rnd"] = jnp.full((n,), -1, I32)
+            st["z_sb_src"] = jnp.full((n,), -1, I32)
+            st["z_sb_bal"] = jnp.full((n,), -1, I32)
+            st["z_sb_rnd"] = jnp.full((n,), -1, I32)
         return st
 
     def step(self, state, inbox, ctx):
@@ -923,6 +945,59 @@ class ProxyRole(NodeProgram):
         else:
             bal_in, client_in, slot_in = lay.unpack_assign_a(inbox.a)
         asg = _first_per_key(v & (inbox.type == T_ASSIGN), slot_in)
+        byz_nack = None
+        if self.byz:
+            # Byzantine convictions at the protocol seam (byzantine.py,
+            # doc/faults.md): two invariants honest traffic can never
+            # violate. (1) equivocation — a T_ASSIGN hitting a live row
+            # for the same slot at the SAME ballot with a DIFFERENT
+            # command (an honest leader resends identical payloads, and
+            # two leaders never share a ballot); (2) stale ballot — an
+            # assign whose ballot lies outside the sender's residue
+            # class (honest ballots are k*S + me, so bal % S == src).
+            # Convicted messages are counted, dropped, and NACKed
+            # T_ERR/E_BYZANTINE to the offending source.
+            hit0 = _match_rows(s["p_valid"], s["p_slot"], asg, slot_in)
+            cmd_neq = (s["p_cmd"][:, :, None] != inbox.b[:, None, :])
+            if S > 1:
+                eq_lane = (hit0 & cmd_neq
+                           & (s["p_bal"][:, :, None]
+                              == bal_in[:, None, :])).any(axis=1)
+                sb_lane = asg & (bal_in % S != inbox.src)
+            else:
+                eq_lane = (hit0 & cmd_neq).any(axis=1)
+                sb_lane = jnp.zeros((n, K), bool)
+            asg = asg & ~eq_lane & ~sb_lane
+            # first-conviction round stamp (conviction latency,
+            # BENCH_MODE=byzantine): set once, when the counter leaves 0
+            s["z_eq_rnd"] = jnp.where(
+                (s["z_eq"] == 0) & eq_lane.any(axis=1),
+                rnd, s["z_eq_rnd"])
+            s["z_sb_rnd"] = jnp.where(
+                (s["z_sb"] == 0) & sb_lane.any(axis=1),
+                rnd, s["z_sb_rnd"])
+            s["z_eq"] = s["z_eq"] + jnp.sum(eq_lane.astype(I32), axis=1)
+            s["z_sb"] = s["z_sb"] + jnp.sum(sb_lane.astype(I32), axis=1)
+            wit = lambda lane, f: jnp.max(    # noqa: E731
+                jnp.where(lane, f, -1), axis=1)
+            s["z_eq_src"] = jnp.where(eq_lane.any(axis=1),
+                                      wit(eq_lane, inbox.src),
+                                      s["z_eq_src"])
+            s["z_eq_slot"] = jnp.where(eq_lane.any(axis=1),
+                                       wit(eq_lane, slot_in),
+                                       s["z_eq_slot"])
+            s["z_sb_src"] = jnp.where(sb_lane.any(axis=1),
+                                      wit(sb_lane, inbox.src),
+                                      s["z_sb_src"])
+            s["z_sb_bal"] = jnp.where(sb_lane.any(axis=1),
+                                      wit(sb_lane, bal_in),
+                                      s["z_sb_bal"])
+            convicted = eq_lane | sb_lane
+            byz_nack = _out(
+                (n, K), valid=convicted, dest=inbox.src,
+                type=jnp.full((n, K), T_ERR, I32),
+                a=jnp.full((n, K), E_BYZANTINE, I32),
+                b=slot_in, c=bal_in)
         hitS = _match_rows(s["p_valid"], s["p_slot"], asg, slot_in)
         if S > 1:
             stale_msg = (hitS & (s["p_bal"][:, :, None]
@@ -980,6 +1055,8 @@ class ProxyRole(NodeProgram):
         outs = [fan_out, done_out]
         if nldr_out is not None:
             outs.append(nldr_out)
+        if byz_nack is not None:
+            outs.append(byz_nack)
         return s, _cat_lanes(*outs)
 
     def quiescent(self, state):
@@ -1281,6 +1358,7 @@ class CompartmentProgram(LinKVWire, RolePartition):
     def __init__(self, opts, nodes):
         lay = Layout(opts, len(nodes))
         self.lay = lay
+        self.byz = byz_enabled(opts)
         # host-side leader guess: where new client ops are routed.
         # Updated by redirect hints and probed round-robin on timeouts;
         # checkpointed (host_state) so a resumed run replays the same
@@ -1353,7 +1431,83 @@ class CompartmentProgram(LinKVWire, RolePartition):
         if t == T_ERR and a == E_NOT_LEADER:
             return {"type": "error", "code": E_NOT_LEADER,
                     "text": "not leader", "hint": int(b)}
+        if t == T_ERR and a == E_BYZANTINE:
+            # a convicted-Byzantine NACK (errors.py code 32): proxies
+            # address these to the lying sequencer, but the decode is
+            # total so any path that surfaces one reads it correctly
+            return {"type": "error", "code": E_BYZANTINE,
+                    "text": "byzantine", "slot": int(b), "bal": int(c)}
         return super().decode_body(t, a, b, c, intern)
+
+    # --- byzantine adversary wiring (byzantine.py) ----------------------
+
+    def byz_wire(self):
+        """Compiled corruption masks over the pool-path outbox: the
+        adversary rewrites the culprit sequencer's T_ASSIGN lanes.
+        Equivocation xors the command's value byte with a ROUND-VARYING
+        nonzero pattern, so any two emissions of one (slot, ballot)
+        conflict — a consistent lie would be indistinguishable from an
+        honest assignment. Stale-ballot re-stamps the packed ballot
+        outside the sender's residue class (the wire image of a deposed
+        leader's replayed traffic); S == 1 has no ballot field, so only
+        the equivocation surface exists there."""
+        if not self.byz:
+            return {}
+        lay = self.lay
+
+        def equiv(outbox, culprit, delta, rnd):
+            m = culprit_rows(outbox, culprit) & (outbox.type == T_ASSIGN)
+            x = ((((rnd ^ delta) & 0x3F) | 1) << 8)
+            return m, outbox.a, outbox.b ^ x, outbox.c
+
+        wires = {"equivocation": equiv}
+        if lay.S > 1:
+            def stale(outbox, culprit, delta, rnd):
+                m = (culprit_rows(outbox, culprit)
+                     & (outbox.type == T_ASSIGN))
+                _bal, client, slot = lay.unpack_assign_a(outbox.a)
+                na = lay.pack_assign_a((culprit + 1) % lay.S, client,
+                                       slot)
+                return m, na, outbox.b, outbox.c
+
+            wires["stale-ballot"] = stale
+        return wires
+
+    def byz_evidence(self, nodes_host) -> list:
+        """Converts the proxy tier's device evidence counters into
+        conviction triples (the TPU path's half of the conviction
+        contract; the host path proves the same rules from the wire
+        journal — checkers/byzantine.py)."""
+        if not self.byz:
+            return []
+        import numpy as np
+
+        from ..byzantine import conviction
+        px = nodes_host["proxies"]
+        lay, out = self.lay, []
+        for rule, cnt_key, src_key, ev_key, ev_name in (
+                ("equivocation", "z_eq", "z_eq_src", "z_eq_slot",
+                 "slot"),
+                ("stale-ballot", "z_sb", "z_sb_src", "z_sb_bal",
+                 "ballot")):
+            cnt = np.asarray(px[cnt_key])
+            if int(cnt.sum()) == 0:
+                continue
+            w = int(cnt.argmax())           # the loudest witness proxy
+            src = int(np.asarray(px[src_key])[w])
+            culprit = (self.nodes[src]
+                       if 0 <= src < len(self.nodes) else src)
+            # earliest first-conviction round across witness proxies
+            # (-1 stamps mean "never convicted" and are masked out)
+            rnds = np.asarray(px[cnt_key + "_rnd"])
+            live = rnds[rnds >= 0]
+            out.append(conviction(
+                rule, culprit,
+                {"count": int(cnt.sum()),
+                 ev_name: int(np.asarray(px[ev_key])[w]),
+                 "round": int(live.min()) if live.size else -1},
+                witness=self.nodes[lay.p_base + w]))
+        return out
 
     def redirect_hint(self, body):
         """A leader-redirect error body -> the hinted node id (-1 = no

@@ -918,6 +918,14 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
     artifacts under `cluster-XXXX/`, and write a fleet-level results
     summary. Routed from `run_tpu_test`."""
     from .. import checkpoint as cp
+    from .tpu_runner import TpuRunner
+    if "byzantine" in TpuRunner._fault_set(test):
+        # per-cluster adversary state (SimState.byz) is not threaded
+        # through the vmapped fleet tree yet; reject up front rather
+        # than silently running the fleet benign (doc/faults.md)
+        raise ValueError(
+            "--nemesis byzantine does not compose with --fleet yet: "
+            "run the adversary on a standalone cluster (--fleet 1)")
     test["store_dir"] = test_dir
     # the fleet re-derives each cluster's option set from the ORIGINAL
     # options (FleetSpec.cluster_opts), so the runner is built before

@@ -101,9 +101,11 @@ class TpuCombinedNemesis(NemesisDecisions):
     per-package seeded streams shared with the host path
     (`NemesisDecisions`), so both paths draw identical schedules."""
 
-    def __init__(self, runner, nodes, seed=0, targets=None):
-        super().__init__(nodes, seed, targets=targets)
+    def __init__(self, runner, nodes, seed=0, targets=None, attacks=None,
+                 byz_rate=1.0):
+        super().__init__(nodes, seed, targets=targets, attacks=attacks)
         self.runner = runner
+        self.byz_rate = float(byz_rate)
         self.killed: list = []
         self.paused_nodes: list = []
         self._idx = {n: i for i, n in enumerate(self.nodes)}
@@ -178,6 +180,20 @@ class TpuCombinedNemesis(NemesisDecisions):
             base_s = float(r.test.get("latency_scale") or 1.0)
             r._net_surgery(lambda net: T.set_weather(net, base_p, base_s))
             return {**op, "type": "info", "value": "weather cleared"}
+        if f == "start-byzantine":
+            # same decision stream as the host executor, so the info
+            # op's value string is identical per seed (the parity pin)
+            from .. import byzantine as BZ
+            attack, culprit, delta = self.next_byz_plan()
+            ci, rate = self._idx[culprit], self.byz_rate
+            r._byz_surgery(
+                lambda byz: BZ.start_state(byz, attack, ci, delta, rate))
+            return {**op, "type": "info",
+                    "value": f"byzantine {attack} culprit={culprit}"}
+        if f == "stop-byzantine":
+            from .. import byzantine as BZ
+            r._byz_surgery(BZ.stop_state)
+            return {**op, "type": "info", "value": "byzantine cleared"}
         raise ValueError(f"unknown nemesis op {f!r}")
 
 
@@ -375,6 +391,7 @@ class TpuRunner:
             partition_groups=n if "partition" in faults else 1,
             enable_stall=bool({"kill", "pause"} & faults),
             enable_duplication="duplicate" in faults,
+            enable_byz="byzantine" in faults,
             # batched payload rows (doc/perf.md): programs whose wire
             # records carry multiple client ops per message declare the
             # (type, count-word) mapping; the net books units next to
@@ -586,6 +603,18 @@ class TpuRunner:
         target its own row of the batched fleet tree
         (runner/fleet_runner.py)."""
         self.sim = self.sim.replace(net=fn(self.sim.net))
+
+    def _byz_surgery(self, fn):
+        """Applies a host-side adversary update `byz -> byz'` (the
+        start-/stop-byzantine plan installs) to the simulation's
+        adversary carry. Eager host scalars land off-mesh, so the
+        updated tree is re-placed like a resume's (`_reshard`)."""
+        if self.sim.byz is None:
+            raise ValueError(
+                "byzantine nemesis op without enable_byz: the fault set "
+                "is static compile capability (TpuRunner._fault_set)")
+        self.sim = self.sim.replace(byz=fn(self.sim.byz))
+        self._reshard()
 
     def _init_next_mid(self):
         """Primes the host mirror of the device message-id counter
@@ -974,8 +1003,14 @@ class TpuRunner:
                       lambda: ())()
         targets = nem.resolve_targets(test.get("nemesis_targets"),
                                       groups, self.nodes, dynamic=dyn)
+        # NOT `or 1.0`: an explicit --byz-rate 0 must stick (the
+        # armed-detectors-on-honest-traffic configuration)
+        byz_rate = test.get("byz_rate")
         nemesis = (TpuCombinedNemesis(self, self.nodes, nem_seed,
-                                      targets=targets)
+                                      targets=targets,
+                                      attacks=test.get("byz_attacks"),
+                                      byz_rate=1.0 if byz_rate is None
+                                      else float(byz_rate))
                    if test.get("nemesis_pkg", {}).get("generator") is not None
                    or test.get("nemesis") else None)
         if nemesis is not None:
@@ -2032,6 +2067,12 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
     from ..checkers.availability import AvailabilityChecker
     test["checker"].checkers["net"] = TpuNetStats(runner)
     test["checker"].checkers["availability"] = AvailabilityChecker(runner)
+    if "byzantine" in runner.faults:
+        # swap the host wire auditor for the device-evidence one: the
+        # TPU journal keeps no bodies, so convictions come from the
+        # program's compiled evidence ledgers (checkers/byzantine.py)
+        from ..checkers.byzantine import TpuByzantine
+        test["checker"].checkers["byzantine"] = TpuByzantine(runner)
     test["nemesis"] = True if test["nemesis_pkg"]["generator"] is not None \
         else None
 
@@ -2070,6 +2111,15 @@ def run_tpu_test(test: dict, test_dir: str) -> dict:
         # run's TransferStats so results show that work leaving
         # host-blocked time
         test["transfer"] = runner.transfer
+        if runner.cfg.enable_byz and runner.sim.byz is not None:
+            # the run's injection ledger, straight off the device: the
+            # conviction contract is graded against exactly what the
+            # compiled masks rewrote (byzantine.assemble_block)
+            from .. import byzantine as BZ
+            inj = np.asarray(
+                runner.transfer.fetch(runner.sim.byz["injected"]))
+            test["byz_injected"] = {a: int(inj[i])
+                                    for i, a in enumerate(BZ.ATTACKS)}
         results = test["checker"].check(test, history, {})
     finally:
         # a flight recorder must land its trace ESPECIALLY when the run

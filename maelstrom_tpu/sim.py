@@ -48,6 +48,14 @@ class SimState:
     # byte-identical per seed. Rides checkpoints like the rest of the
     # carry.
     telemetry: object = None
+    # Byzantine adversary carry (byzantine.py): the active attack plan
+    # plus the injection ledger, threaded through the round when
+    # cfg.enable_byz so the compiled corruption masks and their
+    # bookkeeping live inside the jitted body (no host transfers). The
+    # injection gate is a pure integer hash — no PRNG consumption — so
+    # the field is None (and everything compiles out) on benign runs.
+    # Rides checkpoints: a resume mid-attack-window keeps the plan.
+    byz: object = None
 
 
 def dealias(tree):
@@ -73,9 +81,14 @@ def make_sim(program, cfg: NetConfig, seed: int = 0,
     if cfg.telemetry:
         from . import telemetry as TM
         tel = TM.make_ring(cfg)
+    byz = None
+    if cfg.enable_byz:
+        from . import byzantine as BZ
+        byz = BZ.init_state()
     return SimState(net=T.make_net(cfg), nodes=nodes,
                     key=jax.random.PRNGKey(seed), channels=channels,
-                    durable=program.durable_view(nodes), telemetry=tel)
+                    durable=program.durable_view(nodes), telemetry=tel,
+                    byz=byz)
 
 
 def _freeze(stall, old, new):
@@ -419,6 +432,13 @@ def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
         stall = sim.net.down | sim.net.paused
         nodes = _freeze_nodes(program, stall, sim.nodes, nodes)
         outbox = outbox.replace(valid=outbox.valid & ~stall[:, None])
+    byz = sim.byz
+    if cfg.enable_byz:
+        # byzantine wire corruption (byzantine.py): rewrite the active
+        # culprit's selected outbox rows before send — the lie travels
+        # the same pool path, loss/partition/latency and all
+        from . import byzantine as BZ
+        byz, outbox = BZ.corrupt_pool(program, byz, outbox, net.round)
     flat = jax.tree.map(lambda f: f.reshape((N * O,) + f.shape[2:]), outbox)
     flat = flat.replace(src=jnp.repeat(jnp.arange(N, dtype=I32), O))
     net, outbox_sent = T._send(cfg, net, flat, k3)
@@ -434,7 +454,8 @@ def _round(program, cfg: NetConfig, sim: SimState, inject: Msgs):
                              sim.net.round, node_sent, inject_sent,
                              client_msgs)
     return (SimState(net=net, nodes=nodes, key=key,
-                     durable=program.durable_view(nodes), telemetry=tel),
+                     durable=program.durable_view(nodes), telemetry=tel,
+                     byz=byz),
             client_msgs, (inject_sent, outbox_sent, inbox))
 
 
@@ -464,6 +485,13 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
             valid=edge_out.valid & ~stall[:, None, None])
         client_out = client_out.replace(
             valid=client_out.valid & ~stall[:, None])
+    byz = sim.byz
+    if cfg.enable_byz:
+        # byzantine wire corruption on the edge path: the forged-proof
+        # surface is the client-facing batch ack (byzantine.py)
+        from . import byzantine as BZ
+        byz, client_out = BZ.corrupt_edge(program, byz, client_out,
+                                          net.round)
 
     # Client replies bypass the pool: clients have zero latency
     # (net.clj:177-186), so valid reply rows are compacted straight into
@@ -628,7 +656,8 @@ def _round_edge(program, cfg: NetConfig, sim: SimState, inject: Msgs):
                              sim.net.round, node_sent, inject_sent,
                              flat)
     return (SimState(net=net, nodes=nodes, key=key, channels=ch,
-                     durable=program.durable_view(nodes), telemetry=tel),
+                     durable=program.durable_view(nodes), telemetry=tel,
+                     byz=byz),
             client_msgs,
             (inject_sent, outbox_sent, client_inbox, edge_out, edge_in))
 

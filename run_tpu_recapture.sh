@@ -116,6 +116,18 @@ step artifacts/bench-failover-r14.json 2400 \
 step artifacts/bench-ordering-r15.json 2400 \
     env BENCH_MODE=ordering python bench.py
 
+# 1k. byzantine convictions (BENCH_MODE=byzantine, ISSUE 16): the SAME
+#     compartment cluster benign and under the equivocating-sequencer
+#     adversary (`--nemesis byzantine`), headline `value` = rounds from
+#     injection to the proxy tier's first device conviction
+#     (doc/faults.md "byzantine is a conviction driver"; CPU r01 in
+#     artifacts/bench-byzantine-cpu-r01.json: 5 rounds to conviction,
+#     1174/1174 injected corruptions convicted, 157.5 -> 153.2
+#     client-ops/vsec under attack). Gates: byzantine block valid
+#     (nothing unconvicted, nothing spurious) and the benign twin clean
+step artifacts/bench-byzantine-r16.json 2400 \
+    env BENCH_MODE=byzantine python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

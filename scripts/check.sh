@@ -294,4 +294,44 @@ PY
     echo "== ordering smoke valid =="
 fi
 
+# Byzantine-conviction smoke (ISSUE 16, doc/faults.md "byzantine is a
+# conviction driver"): one AUDITED run with the equivocating-sequencer
+# adversary live on the elected compartment — the `byzantine` results
+# block must CONVICT (>= 1 conviction naming a rule and a culprit,
+# every injected corruption accounted for, none spurious), and the
+# static audit must trace the byz-enabled step fns at zero new
+# findings. BYZANTINE_SMOKE=0 skips.
+if [ "${BYZANTINE_SMOKE:-1}" = "1" ]; then
+    echo "== byzantine-conviction smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    python -m maelstrom_tpu test -w lin-kv --node tpu:compartment \
+        --roles sequencers=2,proxies=2,acceptors=1x2,replicas=1 \
+        --rate 20 --time-limit 4 --seed 3 --compartment-retry 3 \
+        --nemesis byzantine --nemesis-targets byzantine=sequencers \
+        --byz-attacks equivocation --nemesis-interval 0.8 \
+        --store "$SMOKE_STORE" > /dev/null || true
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+with open(os.path.join(root, "latest", "results.json")) as f:
+    res = json.load(f)
+blk = res["byzantine"]
+assert blk["valid"] is True, blk
+convs = blk["convictions"]
+assert convs, "adversary ran but nobody was convicted"
+for c in convs:
+    assert c["rule"] and c["culprit"], c
+assert not blk["unconvicted"], blk["unconvicted"]
+assert not blk["spurious"], blk["spurious"]
+audit = res["net"]["static-audit"]
+assert audit["ok"] is True, audit
+inj = {k: v for k, v in blk["injected"].items() if v}
+print(f"byzantine smoke: injected {inj}, convicted "
+      + ", ".join(f"{c['rule']}={c['culprit']}" for c in convs)
+      + ", audited")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== byzantine smoke valid =="
+fi
+
 echo "== static gate clean =="

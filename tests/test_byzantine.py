@@ -1,0 +1,310 @@
+"""Byzantine adversary + conviction contract (ISSUE 16, doc/faults.md
+"byzantine is a conviction driver").
+
+The acceptance bar: a byzantine run is valid only if EVERY injected
+corruption is convicted with a named rule and culprit, on both
+execution paths identically per seed — and benign runs stay
+conviction-free (detectors armed, zero false positives).
+"""
+
+import json
+import os
+
+import pytest
+
+from maelstrom_tpu import checkpoint as cp
+from maelstrom_tpu import core
+from maelstrom_tpu.byzantine import ATTACKS, RULE_ATTACK, assemble_block
+from maelstrom_tpu.checkers.byzantine import (ByzantineChecker,
+                                              classify_wire_diff)
+from maelstrom_tpu.nemesis import NemesisDecisions
+
+from conftest import ops_projection as _ops
+
+STORE = "/tmp/maelstrom-byzantine-store"
+
+
+def run(opts):
+    base = dict(store_root=STORE, seed=3, rate=20.0, time_limit=3.0,
+                journal_rows=False, audit=False,
+                node="tpu:compartment", workload="lin-kv",
+                roles="sequencers=2,proxies=2,acceptors=1x2,replicas=1",
+                compartment_retry=3,
+                nemesis={"byzantine"}, nemesis_interval=0.8)
+    return core.run({**base, **opts})
+
+
+def _history():
+    with open(os.path.join(STORE, "latest", "history.jsonl")) as f:
+        return [json.loads(ln) for ln in f]
+
+
+# --- the ledger/block contract (pure) --------------------------------------
+
+def test_assemble_block_grades_the_ledger():
+    inj = {"equivocation": 5, "forged-proof": 0, "stale-ballot": 0}
+    conv = [{"rule": "equivocation", "culprit": "n0",
+             "evidence": {"count": 5}, "witness": "n2"}]
+    blk = assemble_block(conv, inj)
+    assert blk["valid"] is True
+    assert blk["unconvicted"] == [] and blk["spurious"] == []
+    # an injected attack nobody convicted invalidates the block
+    blk2 = assemble_block([], inj)
+    assert blk2["valid"] is False
+    assert blk2["unconvicted"] == ["equivocation"]
+    # a conviction for an attack that never ran is spurious
+    blk3 = assemble_block(conv, {a: 0 for a in ATTACKS})
+    assert blk3["valid"] is False
+    assert blk3["spurious"] == ["equivocation"]
+
+
+def test_classify_wire_diff_names_the_rule():
+    sent = {"type": "assign", "slot": 7, "ballot": 2}
+    # replayed old traffic beats field classification
+    assert classify_wire_diff(sent, {"type": "assign", "slot": 3},
+                              [{"type": "assign", "slot": 3}]) \
+        == "stale-ballot"
+    # diff confined to the proof vocabulary
+    assert classify_wire_diff({"lo": 4, "n": 3}, {"lo": 5, "n": 4},
+                              []) == "forged-proof"
+    # anything else is an equivocation
+    assert classify_wire_diff(sent, {**sent, "slot": 9}, []) \
+        == "equivocation"
+
+
+# --- per-attack convictions, TPU path --------------------------------------
+
+def test_equivocation_convicted_on_device():
+    res = run(dict(nemesis_targets="byzantine=n0",
+                   byz_attacks="equivocation"))
+    blk = res["byzantine"]
+    assert blk["injected"]["equivocation"] > 0
+    assert blk["injected"]["stale-ballot"] == 0
+    assert blk["injected"]["forged-proof"] == 0
+    assert blk["valid"] is True, blk
+    assert blk["unconvicted"] == [] and blk["spurious"] == []
+    rules = {(c["rule"], c["culprit"]) for c in blk["convictions"]}
+    assert rules == {("equivocation", "n0")}
+    for c in blk["convictions"]:
+        assert c["evidence"]["count"] > 0
+        assert c["witness"].startswith("n")     # a proxy testified
+    # the workload verdict stays INDEPENDENT of the conviction block:
+    # a first corrupted assign can land before the round-varying retry
+    # exposes the lie, so lin-kv may legitimately fail — conviction is
+    # about naming the liar, not absolving the run
+    assert res["workload"]["valid"] in (True, False)
+    # and the nemesis op stream names the plan both paths share
+    vals = [o["value"] for o in _history()
+            if o.get("process") == "nemesis" and o.get("type") == "info"
+            and str(o.get("value", "")).startswith("byzantine ")]
+    assert "byzantine equivocation culprit=n0" in vals
+
+
+def test_stale_ballot_convicted_on_device():
+    res = run(dict(nemesis_targets="byzantine=sequencers",
+                   byz_attacks="stale-ballot"))
+    blk = res["byzantine"]
+    assert blk["injected"]["stale-ballot"] > 0
+    assert blk["valid"] is True, blk
+    rules = {c["rule"] for c in blk["convictions"]}
+    assert rules == {"stale-ballot"}
+    for c in blk["convictions"]:
+        assert RULE_ATTACK[c["rule"]] == "stale-ballot"
+        assert c["culprit"] in ("n0", "n1")     # a sequencer lied
+        assert "ballot" in c["evidence"]
+
+
+def test_forged_proof_convicted_by_expansion_audit():
+    """The forged-proof attack hits the batched-broadcast proof
+    vocabulary; the conviction comes from the workload checker's OWN
+    expansion-proof audit (BatchedBroadcastChecker.convictions) — the
+    corruption surface picks the convicting auditor."""
+    res = core.run(dict(
+        store_root=STORE, seed=7, workload="broadcast-batched",
+        node="tpu:broadcast-batched", node_count=5, rate=10.0,
+        time_limit=6.0, journal_rows=False, audit=False,
+        nemesis={"byzantine"}, nemesis_interval=1.5,
+        byz_attacks="forged-proof"))
+    blk = res["byzantine"]
+    assert blk["injected"]["forged-proof"] > 0
+    assert blk["valid"] is True, blk
+    assert blk["convictions"]
+    for c in blk["convictions"]:
+        assert RULE_ATTACK[c["rule"]] == "forged-proof"
+        assert c["culprit"].startswith("n")
+        assert c["evidence"]["count"] > 0
+    # forged proofs DID reach the graded record: the run itself fails
+    # even though the byzantine block is satisfied — conviction is not
+    # absolution
+    assert res["valid"] is False
+
+
+# --- benign runs stay conviction-free --------------------------------------
+
+def test_benign_soup_has_no_byzantine_block():
+    res = core.run(dict(
+        store_root=STORE, seed=7, workload="lin-kv", node="tpu:lin-kv",
+        node_count=5, rate=20.0, time_limit=2.0, journal_rows=False,
+        audit=False, recovery_s=1.0,
+        nemesis={"kill", "pause", "partition", "duplicate", "weather"},
+        nemesis_interval=0.7))
+    assert "byzantine" not in res
+
+
+def test_armed_detectors_never_convict_honest_traffic():
+    """byz_rate=0 arms every conviction lane (enable_byz compiles the
+    detectors in, the nemesis schedules windows) while the corruption
+    gate never fires: honest traffic must produce zero convictions,
+    an all-zero ledger, and a valid block."""
+    res = run(dict(nemesis_targets="byzantine=n0",
+                   byz_attacks="equivocation", byz_rate=0.0,
+                   time_limit=2.0))
+    blk = res["byzantine"]
+    assert blk["injected"] == {a: 0 for a in ATTACKS}
+    assert blk["convictions"] == []
+    assert blk["valid"] is True
+    assert res["valid"] is True, res.get("valid")
+
+
+# --- host/TPU parity per seed ----------------------------------------------
+
+def test_plan_stream_identical_per_seed():
+    """Host and TPU nemeses draw the adversary schedule from the same
+    NemesisDecisions byzantine stream: same seed, same plans."""
+    nodes = [f"n{i}" for i in range(6)]
+    mk = lambda: NemesisDecisions(nodes, seed=13,   # noqa: E731
+                                  attacks=("equivocation",
+                                           "stale-ballot"))
+    a, b = mk(), mk()
+    plans = [a.next_byz_plan() for _ in range(10)]
+    assert plans == [b.next_byz_plan() for _ in range(10)]
+    for attack, culprit, delta in plans:
+        assert attack in ("equivocation", "stale-ballot")
+        assert culprit in nodes and 1 <= delta <= 0x7FFF
+
+
+def _host_audit(attack, bodies, seed=13):
+    """Drives one NemesisDecisions-planned attack window through a real
+    HostNet + journal and returns (plan, injected ledger, convictions
+    from the wire auditor)."""
+    from maelstrom_tpu.net.host import HostNet
+    from maelstrom_tpu.net.journal import Journal
+
+    net = HostNet()
+    net.journal = Journal()
+    for nid in ("n0", "n1"):
+        net.add_node(nid)
+    plan = NemesisDecisions(["n0", "n1"], seed=seed,
+                            attacks=(attack,)).next_byz_plan()
+    attack_p, culprit, delta = plan
+    assert attack_p == attack
+    other = "n1" if culprit == "n0" else "n0"
+    net.set_byzantine(attack_p, culprit, delta, rate=1.0)
+    for body in bodies:
+        net.send({"src": culprit, "dest": other, "body": body})
+        assert net.recv(other, 1000) is not None
+    net.clear_byzantine()
+    convs = ByzantineChecker(net).convictions(
+        {"nodes": ["n0", "n1"]}, [], {})
+    return plan, dict(net.byz_injected), convs
+
+
+@pytest.mark.parametrize("attack,bodies", [
+    # slots > 63 apart: the equivocation xor mask is <= 0x3F, so a
+    # corrupted delivery can never collide with the OTHER honest body
+    # (which would legitimately classify as a replay instead)
+    ("equivocation", [{"type": "assign", "slot": 2, "ballot": 0},
+                      {"type": "assign", "slot": 200, "ballot": 0}]),
+    ("stale-ballot", [{"type": "assign", "slot": 1},
+                      {"type": "assign", "slot": 2}]),
+    ("forged-proof", [{"type": "batch_ok", "lo": 4, "n": 3,
+                       "proof": 9}]),
+])
+def test_host_wire_auditor_convicts_the_planned_culprit(attack, bodies):
+    """The host path's half of per-seed conviction identity: the SAME
+    seeded plan the TPU nemesis would draw drives HostNet's delivered-
+    copy corruption, and the journal auditor convicts exactly that
+    (attack, culprit) — so both paths' blocks name the same liar for
+    the same seed."""
+    (attack_p, culprit, _delta), injected, convs = \
+        _host_audit(attack, bodies)
+    assert injected.get(attack, 0) > 0
+    assert len(convs) == 1
+    c = convs[0]
+    assert RULE_ATTACK[c["rule"]] == attack
+    assert c["culprit"] == culprit
+    assert c["evidence"]["count"] == injected[attack]
+    assert c["evidence"]["sent"] != c["evidence"]["received"]
+    # the block assembled from these convictions grades valid
+    inj = {a: injected.get(a, 0) for a in ATTACKS}
+    assert assemble_block(convs, inj)["valid"] is True
+
+
+# --- resume fingerprint + byte-identity (satellite: checkpoint) ------------
+
+def _build_byz(tmp_path, **over):
+    opts = {"workload": "lin-kv", "node": "tpu:compartment",
+            "roles": "sequencers=2,proxies=2,acceptors=1x2,replicas=1",
+            "compartment_retry": 3, "rate": 20.0, "time_limit": 4.0,
+            "nemesis": {"byzantine"}, "nemesis_interval": 0.8,
+            "nemesis_targets": "byzantine=n0",
+            "byz_attacks": "equivocation",
+            "recovery_s": 1.0, "seed": 3, "store_root": str(tmp_path)}
+    opts.update(over)
+    test = core.build_test(opts)
+    test["store_dir"] = str(tmp_path)
+    return test
+
+
+def test_fingerprint_pins_byz_knobs(tmp_path):
+    t1 = _build_byz(tmp_path)
+    t2 = _build_byz(tmp_path, byz_attacks="stale-ballot")
+    fp1, fp2 = cp.fingerprint(t1), cp.fingerprint(t2)
+    assert fp1["byz_attacks"] != fp2["byz_attacks"]
+    with pytest.raises(ValueError, match="byz_attacks"):
+        cp.check_fingerprint({"fingerprint": fp1}, t2)
+    t3 = _build_byz(tmp_path, byz_rate=0.25)
+    with pytest.raises(ValueError, match="byz_rate"):
+        cp.check_fingerprint({"fingerprint": fp1}, t3)
+
+
+@pytest.mark.slow
+def test_resume_mid_attack_byte_identical(tmp_path):
+    """A run killed INSIDE a byzantine window and resumed from its
+    checkpoint replays the identical history: the adversary plan
+    stream, the compiled corruption masks' state (SimState.byz), and
+    the injection ledger all live in the checkpoint."""
+    from maelstrom_tpu.runner.tpu_runner import TpuRunner
+
+    test_a = _build_byz(tmp_path / "a")
+    hist_a = TpuRunner(test_a).run()
+    assert len(hist_a) > 20
+
+    test_b = _build_byz(tmp_path / "b", checkpoint_every=1.0)
+    test_b["max_rounds"] = 1500     # die mid-run, past the first window
+    TpuRunner(test_b).run()
+    ck = cp.load(str(tmp_path / "b"))
+    assert ck["r"] <= 1500
+
+    test_c = _build_byz(tmp_path / "b")
+    runner_c = TpuRunner(test_c)
+    resume = cp.load(str(tmp_path / "b"))
+    cp.check_fingerprint(resume, test_c)
+    hist_c = runner_c.run(resume=resume)
+    assert _ops(hist_c) == _ops(hist_a)
+
+
+# --- sharded conviction identity -------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_mesh_conviction_identity():
+    """--mesh 1,2 runs the same adversary over the sharded round: the
+    assembled byzantine block — ledger, convictions, verdict — is
+    IDENTICAL to the single-device run for the same seed."""
+    plain = run(dict(nemesis_targets="byzantine=n0",
+                     byz_attacks="equivocation"))
+    sharded = run(dict(nemesis_targets="byzantine=n0",
+                       byz_attacks="equivocation", mesh="1,2"))
+    assert plain["byzantine"] == sharded["byzantine"]
+    assert plain["byzantine"]["valid"] is True

@@ -412,6 +412,26 @@ def test_gate_traces_device_checker_kernels():
     assert checker_hits == [], [f.as_dict() for f in checker_hits]
 
 
+def test_gate_traces_byzantine_scan_variant():
+    """ISSUE 16: the gate traces the byz-enabled compartment variant —
+    the compiled corruption masks (byzantine.corrupt_pool) and the
+    proxy tier's conviction lanes run INSIDE the audited round — at
+    zero non-baselined findings, and no byzantine-attributed finding
+    needed baselining at all."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["compartment-byzantine"], mesh=None, fleet=False)
+    assert any(e.startswith("round_fn[compartment-byzantine")
+               for e in entries), entries
+    assert any(e.startswith("scan_fn[compartment-byzantine")
+               for e in entries), entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+    byz_hits = [f for f in findings
+                if "byzantine" in f.key or "byzantine" in f.where]
+    assert byz_hits == [], [f.as_dict() for f in byz_hits]
+
+
 def test_fixture_violation_in_continuous_scan_path_fires():
     """A seeded hazard INSIDE the continuous scan body is caught through
     the cscan trace: an unstable argsort planted in a program step
