@@ -957,6 +957,56 @@ def _main_stream():
         sys.exit(1)
 
 
+def _session_pass_micro(F, conc, waves=200):
+    """The per-wave session pass in isolation (ISSUE 17): F shells,
+    each holding `conc` in-flight RPCs, answering the per-wave queries
+    every dispatch loop asks — scan bound (min_deadline), timeout
+    expiry (take_expired, nothing due), requeue check — for `waves`
+    waves. The coroutine backend pays F Python scans over its pending
+    dicts per wave; the columnar table pays ONE vectorized
+    `encode_wave` reduction and F O(1) cache reads. This is exactly
+    the code the PR moved, measured with the production backends; the
+    end-to-end `host_wall_per_wave` column dilutes it with the (shared,
+    unchanged) generator-feed pass, so the micro row is where the
+    table's win is read directly."""
+    from maelstrom_tpu.runner.sessions import (ColumnarSessions,
+                                               CoroutineSessions)
+
+    def populate(register):
+        mid = 0
+        for i in range(F):
+            for c in range(conc):
+                register(i, mid, c, {"f": "w", "m": mid}, c % 5,
+                         10 ** 6 + (mid % 97))
+                mid += 1
+
+    cor = [CoroutineSessions() for _ in range(F)]
+    populate(lambda i, *a: cor[i].register(*a))
+    t0 = time.perf_counter()
+    for w in range(waves):
+        for s in cor:
+            s.min_deadline()
+            s.take_expired(w)
+            s.has_requeue()
+    cor_s = time.perf_counter() - t0
+
+    tab = ColumnarSessions(F, conc)
+    views = [tab.view(i) for i in range(F)]
+    populate(lambda i, *a: views[i].register(*a))
+    t1 = time.perf_counter()
+    for w in range(waves):
+        tab.encode_wave()
+        for v in views:
+            v.min_deadline()
+            v.take_expired(w)
+            v.has_requeue()
+    col_s = time.perf_counter() - t1
+    return {"fleet": F, "concurrency": conc, "waves": waves,
+            "coroutine_us_per_wave": round(1e6 * cor_s / waves, 2),
+            "columnar_us_per_wave": round(1e6 * col_s / waves, 2),
+            "speedup": round(cor_s / col_s, 2) if col_s else None}
+
+
 def bench_fleet_stream_record(sizes=None, mults=None) -> dict:
     """Million-session open-world fleets (ISSUE 12, doc/perf.md
     "vectorized host driver"): `--fleet N --continuous` driven END TO
@@ -982,6 +1032,14 @@ def bench_fleet_stream_record(sizes=None, mults=None) -> dict:
         cluster's windowed grader): bounded lag = the per-cluster
         stream graders keep up while the whole fleet runs.
 
+    Plus, per ISSUE 17: `host_wall_per_wave` (mean host seconds per
+    poll pass) per point, with every point run under `--sessions
+    columnar` and fleets >= BENCH_FLEET_STREAM_COMPARE_MIN (default
+    64) also under the legacy coroutine path — `host_wall_flatness`
+    is the columnar max/min ratio over fleets >= 8 (acceptance: <= 2x)
+    and `session_speedup` the per-point coroutine/columnar wall
+    ratio.
+
     Every point must grade valid. CPU fallback honest: `host_cpus` /
     `devices` ride the record so a fallback aggregate is never read as
     the TPU figure (the throughput column needs real parallel
@@ -1003,78 +1061,120 @@ def bench_fleet_stream_record(sizes=None, mults=None) -> dict:
     base = float(os.environ.get("BENCH_FLEET_STREAM_RATE", 16.0))
     tl = float(os.environ.get("BENCH_FLEET_STREAM_TIME_LIMIT", 1.5))
     conc = int(os.environ.get("BENCH_FLEET_STREAM_CONC", 8))
+    # the columnar-vs-coroutine session comparison (ISSUE 17): every
+    # point runs columnar; fleets >= this floor ALSO run the legacy
+    # coroutine path so host_wall_per_wave shows the measured win
+    cmp_min = int(os.environ.get("BENCH_FLEET_STREAM_COMPARE_MIN", 64))
     rows = []
     root = tempfile.mkdtemp(prefix="bench-fleet-stream-")
     try:
         for F in sizes:
             for m in mults:
-                rate = base * m
-                t0 = time.perf_counter()
-                res = core.run(dict(
-                    store_root=root, seed=11, workload="kafka",
-                    node="tpu:kafka", node_count=5, concurrency=conc,
-                    rate=rate, time_limit=tl, journal_rows=False,
-                    kafka_groups=2, continuous=True, timeout_ms=1000,
-                    recovery_s=0.5, fleet=F,
-                    # keep the per-cluster windowed graders on at every
-                    # fleet size (cluster_opts defaults them off past
-                    # 16 clusters to bound the thread pool)
-                    check_workers=1, audit=False))
-                dt = time.perf_counter() - t0
-                # the gate is the kafka stream verdict + the net
-                # invariants per cluster; the generic stats smell rule
-                # (every op class needs >= 1 ok) legitimately trips on
-                # short windows when a cluster's only commit landed
-                # during group formation and was correctly fenced
-                # ("rebalanced" is a definite fail) — recorded as
-                # strict_valid, not gated
-                if F > 1:
-                    ops = sum(c["stats"]["count"]
-                              for c in res["clusters"])
-                    polls = res.get("host-polls", 0)
-                    lag = res.get("max-checker-lag-rounds")
-                    rounds = max(res["final-rounds"])
-                    ok = all(c["workload"]["valid"] is True
-                             and c["net"]["valid"] is True
-                             for c in res["clusters"])
-                else:
-                    ops = res["stats"]["count"]
-                    polls = res["net"].get("host-polls", 0)
-                    lag = (res["workload"].get("checker-lag")
-                           or {}).get("max-lag-rounds")
-                    rounds = None
-                    ok = (res["workload"]["valid"] is True
-                          and res["net"]["valid"] is True)
-                rows.append({
-                    "fleet": F, "rate_mult": m, "offered_rate": rate,
-                    "wall_s": round(dt, 3),
-                    "agg_ops": ops,
-                    "agg_ops_per_sec": round(ops / dt, 1),
-                    "host_polls": polls,
-                    "polls_per_cluster": round(polls / F, 2),
-                    "max_lag_rounds": lag,
-                    "max_rounds": rounds,
-                    "valid": ok,
-                    "strict_valid": res["valid"] is True,
-                })
-                print(f"bench[fleet_stream F={F} x{m}]: "
-                      f"{rows[-1]['agg_ops_per_sec']:.0f} agg ops/s, "
-                      f"{polls} polls ({rows[-1]['polls_per_cluster']} "
-                      f"/cluster), max lag {lag}", file=sys.stderr)
+                modes = ["columnar"]
+                if F > 1 and F >= cmp_min:
+                    modes.append("coroutine")
+                for mode in modes:
+                    rate = base * m
+                    t0 = time.perf_counter()
+                    res = core.run(dict(
+                        store_root=root, seed=11, workload="kafka",
+                        node="tpu:kafka", node_count=5,
+                        concurrency=conc,
+                        rate=rate, time_limit=tl, journal_rows=False,
+                        kafka_groups=2, continuous=True,
+                        timeout_ms=1000,
+                        recovery_s=0.5, fleet=F, sessions=mode,
+                        # keep the per-cluster windowed graders on at
+                        # every fleet size (cluster_opts defaults them
+                        # off past 16 clusters to bound the thread
+                        # pool)
+                        check_workers=1, audit=False))
+                    dt = time.perf_counter() - t0
+                    # the gate is the kafka stream verdict + the net
+                    # invariants per cluster; the generic stats smell
+                    # rule (every op class needs >= 1 ok) legitimately
+                    # trips on short windows when a cluster's only
+                    # commit landed during group formation and was
+                    # correctly fenced ("rebalanced" is a definite
+                    # fail) — recorded as strict_valid, not gated
+                    if F > 1:
+                        ops = sum(c["stats"]["count"]
+                                  for c in res["clusters"])
+                        polls = res.get("host-polls", 0)
+                        wall_wave = res.get("host-wall-per-wave")
+                        lag = res.get("max-checker-lag-rounds")
+                        rounds = max(res["final-rounds"])
+                        ok = all(c["workload"]["valid"] is True
+                                 and c["net"]["valid"] is True
+                                 for c in res["clusters"])
+                    else:
+                        ops = res["stats"]["count"]
+                        polls = res["net"].get("host-polls", 0)
+                        wall_wave = res["net"].get("host-wall-per-wave")
+                        lag = (res["workload"].get("checker-lag")
+                               or {}).get("max-lag-rounds")
+                        rounds = None
+                        ok = (res["workload"]["valid"] is True
+                              and res["net"]["valid"] is True)
+                    rows.append({
+                        "fleet": F, "rate_mult": m,
+                        "offered_rate": rate,
+                        "sessions": mode,
+                        "wall_s": round(dt, 3),
+                        "agg_ops": ops,
+                        "agg_ops_per_sec": round(ops / dt, 1),
+                        "host_polls": polls,
+                        "polls_per_cluster": round(polls / F, 2),
+                        "host_wall_per_wave": wall_wave,
+                        "max_lag_rounds": lag,
+                        "max_rounds": rounds,
+                        "valid": ok,
+                        "strict_valid": res["valid"] is True,
+                    })
+                    print(
+                        f"bench[fleet_stream F={F} x{m} {mode}]: "
+                        f"{rows[-1]['agg_ops_per_sec']:.0f} agg ops/s, "
+                        f"{polls} polls "
+                        f"({rows[-1]['polls_per_cluster']}/cluster, "
+                        f"{wall_wave}s/wave), max lag {lag}",
+                        file=sys.stderr)
     finally:
         shutil.rmtree(root, ignore_errors=True)
     # poll amortization per (size, rate): fleet-1 polls-per-cluster at
-    # the same offered rate over this point's polls-per-cluster
+    # the same offered rate over this point's polls-per-cluster —
+    # columnar rows only (the coroutine comparison rows measure wall,
+    # not the amortization claim)
+    col = [r for r in rows if r["sessions"] == "columnar"]
     base_polls = {r["rate_mult"]: r["polls_per_cluster"]
-                  for r in rows if r["fleet"] == 1}
+                  for r in col if r["fleet"] == 1}
     for r in rows:
         b = base_polls.get(r["rate_mult"])
         r["poll_amortization"] = (
             round(b / r["polls_per_cluster"], 2)
-            if b and r["polls_per_cluster"] else None)
+            if b and r["polls_per_cluster"]
+            and r["sessions"] == "columnar" else None)
     top_f = max(r["fleet"] for r in rows)
-    top_amort = [r["poll_amortization"] for r in rows
+    top_amort = [r["poll_amortization"] for r in col
                  if r["fleet"] == top_f and r["poll_amortization"]]
+    # host-wall-per-wave flatness on the columnar path (the ISSUE 17
+    # acceptance: flat within 2x from fleet 8 up) and the measured
+    # columnar-over-coroutine win at the compared fleet sizes
+    flat_walls = [r["host_wall_per_wave"] for r in col
+                  if r["fleet"] >= 8 and r["host_wall_per_wave"]]
+    wall_flatness = (round(max(flat_walls) / min(flat_walls), 2)
+                     if flat_walls else None)
+    speedups = {}
+    for r in rows:
+        if r["sessions"] != "coroutine" or not r["host_wall_per_wave"]:
+            continue
+        twin = next((c for c in col
+                     if c["fleet"] == r["fleet"]
+                     and c["rate_mult"] == r["rate_mult"]
+                     and c["host_wall_per_wave"]), None)
+        if twin is not None:
+            speedups[f"F{r['fleet']}x{r['rate_mult']}"] = round(
+                r["host_wall_per_wave"] / twin["host_wall_per_wave"],
+                2)
     # "bounded" means the grader keeps up to within a few stream
     # strides of the scan head — comparing against the run's total
     # rounds would be vacuous (lag can never exceed it). The bench
@@ -1088,12 +1188,27 @@ def bench_fleet_stream_record(sizes=None, mults=None) -> dict:
         r["max_lag_rounds"] is not None
         and r["max_lag_rounds"] <= lag_bound
         for r in rows)
+    # the isolated session-pass micro at each recorded fleet size:
+    # the direct coroutine-scan vs columnar-table comparison the
+    # end-to-end wall column dilutes with the shared feed pass
+    micro = [_session_pass_micro(F, c)
+             for F in sizes if F > 1
+             for c in (conc, 8 * conc)]
     return {
         "points": rows,
         "base_rate": base, "time_limit_s": tl, "concurrency": conc,
         "top_fleet": top_f,
+        "session_pass": micro or None,
+        "session_pass_speedup_top": (micro[-1]["speedup"]
+                                     if micro else None),
         "poll_amortization_top": (min(top_amort) if top_amort
                                   else None),
+        # max/min columnar host_wall_per_wave over fleets >= 8 (the
+        # flatness acceptance is <= 2.0) and per-point coroutine-wall /
+        # columnar-wall ratios at the compared fleet sizes (> 1 means
+        # the columnar table pass beat the coroutine dict scans)
+        "host_wall_flatness": wall_flatness,
+        "session_speedup": speedups or None,
         "lag_bound_rounds": lag_bound,
         "lag_bounded": lag_bounded,
         "host_cpus": os.cpu_count(),
@@ -1111,7 +1226,8 @@ def _main_fleet_stream():
     recorded). Exits nonzero when a point graded invalid, checker lag
     was unbounded, or the amortization missed the floor."""
     rec = bench_fleet_stream_record()
-    top = max(rec["points"],
+    top = max((r for r in rec["points"]
+               if r["sessions"] == "columnar"),
               key=lambda r: (r["fleet"], r["rate_mult"]))
     record = {
         "metric": "fleet_stream_agg_client_ops_per_sec",

@@ -78,12 +78,26 @@ class RegisterModel(Model):
 
 class MutexModel(Model):
     """acquire / release lock — knossos.model/mutex, holder-aware.
-    State: None (free) or the holder id (`value`; an anonymous op is
-    its own holder). A release by a non-holder cannot linearize."""
+    State: None (free) or the holder id (`value`).
+
+    Anonymous ops (value None) all share the sentinel holder True: in an
+    ALL-anonymous history that reduces to knossos's holder-blind mutex
+    (held/free), a documented degradation — any anonymous release can
+    linearize against any anonymous acquire. What it must NOT do is let
+    an anonymous release match a NAMED holder's acquire (that would
+    "verify" a lock-stealing history), so mixing the two styles in one
+    history raises instead of silently degrading."""
 
     initial = None
 
     def apply(self, state, f, value, ok):
+        if value is None and state not in (None, True):
+            raise ValueError(
+                f"mutex history mixes anonymous ops (value None) with "
+                f"named holders (current holder {state!r}): anonymous "
+                f"identity cannot be checked against named acquires — "
+                f"stamp every op's value with its holder (lin_mutex "
+                f"does) or none of them")
         h = value if value is not None else True
         if f == "acquire":
             if ok:

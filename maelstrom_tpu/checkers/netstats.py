@@ -109,6 +109,12 @@ class TransferStats:
         if self.host_polls:
             out["host-polls"] = self.host_polls
             out["host-poll-s"] = round(self.host_poll_s, 6)
+            # mean host wall per poll pass (per wave, on the fleet
+            # driver): the fleet_stream bench's flatness column — an
+            # O(1)-in-fleet-size host loop keeps this constant as F
+            # grows, an O(F) one grows it linearly
+            out["host-wall-per-wave"] = round(
+                self.host_poll_s / self.host_polls, 9)
         return out
 
 

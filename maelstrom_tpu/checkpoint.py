@@ -107,6 +107,11 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # open-world streams (doc/streams.md): injection
                     # mode and the consumer-group protocol shape both
                     # change the op stream, so a resume must match
+                    # `sessions` is deliberately ABSENT: the coroutine
+                    # and columnar backends are byte-identical and emit
+                    # the same checkpoint-meta shapes, so a checkpoint
+                    # written under one resumes under the other
+                    # (pinned by tests/test_sessions.py)
                     "continuous", "continuous_window_ms",
                     "latency_scale", "kafka_groups",
                     "session_timeout_ms", "poll_batch",

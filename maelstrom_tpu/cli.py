@@ -199,6 +199,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "'vectorized host driver'). Every cluster's "
                         "history is bit-identical to its standalone "
                         "run (doc/perf.md). TPU path only")
+    t.add_argument("--sessions", choices=["coroutine", "columnar"],
+                   help="Client-session bookkeeping backend (default: "
+                        "columnar under --fleet, coroutine standalone): "
+                        "'columnar' keeps pending/timeout/backoff/"
+                        "redirect state in ONE shared numpy column "
+                        "table advanced one vectorized pass per wave; "
+                        "'coroutine' keeps the per-shell dict/list "
+                        "path. Histories are byte-identical either way "
+                        "(doc/perf.md 'columnar client sessions')")
     t.add_argument("--fleet-sweep", choices=["seed", "nemesis",
                                              "capacity"],
                    help="What the fleet varies per cluster (default "
@@ -442,7 +451,7 @@ def opts_from_args(args) -> dict:
               "roles", "service_roles", "nemesis_targets",
               "election_timeout_rounds", "ballot_width", "timeout_ms",
               "ordering", "leader_lease_ms", "byz_rate", "byz_attacks",
-              "compartment_retry"):
+              "compartment_retry", "sessions"):
         v = getattr(args, k, None)
         if v is not None:
             opts[k] = v

@@ -126,7 +126,10 @@ class RetryPolicy:
                    seed=f"{test.get('seed') or 0}:{salt}")
 
     def sleep(self, attempt: int):
-        bound = min(self.cap_ms, self.base_ms * (2 ** attempt))
+        # shared truncated-exponential bound (runner/sessions.py): the
+        # same curve the device-path redirect backoff draws from
+        from .runner.sessions import trunc_exp_bound
+        bound = trunc_exp_bound(self.base_ms, self.cap_ms, attempt)
         _time.sleep(self.rng.uniform(0, bound) / 1000.0)
 
 

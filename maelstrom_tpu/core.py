@@ -142,6 +142,13 @@ DEFAULTS = dict(
     # (`maelstrom_tpu/ordering/`), graded by the workload's stock
     # checker. None = the workload's welded default program.
     ordering=None,
+    # client-session bookkeeping backend (doc/perf.md "columnar client
+    # sessions"): "columnar" holds pending/timeout/backoff/redirect
+    # state in shared numpy columns advanced one vectorized pass per
+    # wave; "coroutine" keeps the per-shell dict/list path. None =
+    # columnar under --fleet, coroutine standalone. Byte-identical
+    # histories either way (pinned by tests).
+    sessions=None,
 )
 
 # Keys build_test ADDS to a test dict (derived objects, not user

@@ -337,6 +337,33 @@ def edge_capacity(opts: dict, program) -> tuple[bool, int, bool]:
     return spill, lanes, dist == "constant"
 
 
+def wire_name_table(program_module) -> dict[int, str]:
+    """Explicit wire-code -> name table for the send-count-by-type
+    netstats breakdown.
+
+    A module may pin names outright with a ``WIRE_NAMES = {code: name}``
+    dict; otherwise names derive from its ``T_*`` int constants. Aliased
+    codes (two constants sharing a value) resolve to the
+    alphabetically-first constant name — a deterministic winner, where
+    raw ``vars(module)`` iteration made the report depend on definition
+    order. The program's own names shadow the shared reply vocabulary
+    (``T_ERROR`` etc.) defined here."""
+    import sys
+    names: dict[int, str] = {}
+    shared = sys.modules[__name__]
+    for source in (program_module, shared):
+        if source is None:
+            continue
+        for code, name in (getattr(source, "WIRE_NAMES", None)
+                           or {}).items():
+            names.setdefault(int(code), str(name))
+        for k in sorted(vars(source)):
+            v = vars(source)[k]
+            if k.startswith("T_") and isinstance(v, int):
+                names.setdefault(v, k[2:].lower())
+    return names
+
+
 PROGRAMS: dict[str, Callable] = {}
 
 

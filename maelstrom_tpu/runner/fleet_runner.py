@@ -104,12 +104,12 @@ class _FleetClusterShell(TpuRunner):
     def _init_next_mid(self):
         self._next_mid = self.fleet.shell_next_mid(self.idx)
 
-    def _save_checkpoint(self, gen, history, pending, free, r,
+    def _save_checkpoint(self, gen, history, sessions, free, r,
                          sync: bool = False):
         # stretch-boundary snapshot: the fleet coalesces these into one
         # checkpoint file per wave (the shell's own cadence fields drive
         # WHEN this is called — same sites as the standalone runner)
-        self.fleet.snapshot_cluster(self.idx, gen, history, pending,
+        self.fleet.snapshot_cluster(self.idx, gen, history, sessions,
                                     free, r)
 
     def _build_sim(self):
@@ -283,6 +283,23 @@ class FleetRunner:
         self._setup_mids = None
         self._states: list[dict | None] = [None] * F
         self.final_rounds = [0] * F
+        # columnar client sessions (doc/perf.md): ONE shared [F, Q]
+        # session table across all shells, refreshed by a single
+        # vectorized pass per wave (`encode_wave`) instead of F
+        # per-shell dict scans. `--sessions coroutine` keeps the legacy
+        # per-shell bookkeeping alive for the byte-identity pins.
+        from .sessions import SESSION_MODES, ColumnarSessions
+        mode = test.get("sessions")
+        mode = "columnar" if mode is None else str(mode)
+        if mode not in SESSION_MODES:
+            raise ValueError(f"--sessions {mode!r}: pick one of "
+                             f"{'|'.join(SESSION_MODES)}")
+        self.sessions_mode = mode
+        self._session_table = None
+        if mode == "columnar":
+            self._session_table = ColumnarSessions(F, self.concurrency)
+            for i, sh in enumerate(self.shells):
+                sh._fleet_sessions = (self._session_table, i)
 
     # --- device plumbing -------------------------------------------------
 
@@ -577,7 +594,7 @@ class FleetRunner:
             self._sim_cache = self.transfer.fetch(self.sim)
         return self._sim_cache
 
-    def snapshot_cluster(self, i, gen, history, pending, free, r):
+    def snapshot_cluster(self, i, gen, history, sessions, free, r):
         """A stretch-boundary snapshot of ONE cluster: its sim row
         (device-sliced first, so the host pull is O(row) — not the
         whole fleet tree per snapshot) and its mutable host state,
@@ -586,11 +603,12 @@ class FleetRunner:
         t0 = time.perf_counter()
         row = jax.tree.map(np.array, self.transfer.fetch(
             jax.tree.map(lambda a, i=i: a[i], self.sim)))
+        sess_meta = sessions.to_meta()
         meta = {
             "r": r,
             "dispatches": sh._dispatches,
             "gen": gen,
-            "pending": dict(pending),
+            "pending": sess_meta["pending"],
             "free": set(free),
             "intern": sh.intern,
             "nemesis_rng": (sh.nemesis.rng_state()
@@ -603,10 +621,10 @@ class FleetRunner:
             # standalone checkpoint's meta
             "carry": getattr(sh, "_carry_live", None),
             # leader-redirect requeue (open retried invokes) rides the
-            # coalesced checkpoint like the standalone meta
-            "requeue": {"rows": list(sh._requeue),
-                        "attempt": dict(sh._retry_attempt),
-                        "open": sorted(sh._retry_open)},
+            # coalesced checkpoint like the standalone meta — the
+            # session backends emit the same legacy shapes, so
+            # fingerprints don't move
+            "requeue": sess_meta["requeue"],
             "program_host": sh.program.host_state(),
             "history_columns": history.snapshot_columns(),
         }
@@ -822,12 +840,16 @@ class FleetRunner:
                         self.shells[i]._preempt.set()
             scan_reqs: dict = {}
             cscan_reqs: dict = {}
-            # one host poll pass per wave: advancing every ready
-            # cluster's coroutine (their generator scheduling runs in
-            # here) — booked ONCE for the whole fleet, the O(waves)
-            # counter the fleet_stream bench compares against
-            # per-cluster standalone polls
+            # one host poll pass per wave: ONE vectorized refresh of the
+            # shared columnar session table (per-shell deadline/requeue
+            # aggregates become O(1) cache reads for the whole wave),
+            # then advancing every ready cluster's coroutine (their
+            # generator scheduling runs in here) — booked ONCE for the
+            # whole fleet, the O(waves) counter the fleet_stream bench
+            # compares against per-cluster standalone polls
             _poll_t0 = time.perf_counter()
+            if self._session_table is not None:
+                self._session_table.encode_wave()
             while ready:
                 quiet_wait, bump_wait = [], {}
                 for i, resp in ready:
@@ -842,7 +864,7 @@ class FleetRunner:
                             st = self._states[i]
                             self.snapshot_cluster(
                                 i, self.shells[i]._gen_live,
-                                st["history"], st["pending"],
+                                st["history"], st["sessions"],
                                 st["free"], e.value)
                         continue
                     except cp.Preempted:
@@ -894,11 +916,11 @@ class FleetRunner:
                     if not self.checkpoint_every:
                         for i in range(F):
                             if finished[i] and self._states[i] and \
-                                    "pending" in (self._states[i] or {}):
+                                    "sessions" in (self._states[i] or {}):
                                 st = self._states[i]
                                 self.snapshot_cluster(
                                     i, self.shells[i]._gen_live,
-                                    st["history"], st["pending"],
+                                    st["history"], st["sessions"],
                                     st["free"], self.final_rounds[i])
                         self._seed_initial_snaps()
                     self._write_checkpoint(finished, sync=True)
@@ -1001,6 +1023,7 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
         "fleet-sweep": runner.spec.sweep,
         "mesh": str(test.get("mesh")) if test.get("mesh") else None,
         "continuous": bool(test.get("continuous")),
+        "sessions": runner.sessions_mode,
         "valid": all_valid,
         "clusters": cluster_results,
         "final-rounds": list(runner.final_rounds),

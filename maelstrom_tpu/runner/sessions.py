@@ -1,0 +1,585 @@
+"""Client-session bookkeeping (doc/perf.md "columnar client sessions").
+
+Every in-flight client RPC is a *session row*: its pending message id,
+timeout deadline, owning worker process, contacted node — and, when a
+leader redirect re-issued it, the retry-attempt counter and the
+backoff-delayed requeue row. The dispatch loops
+(`runner.tpu_runner._loop_steps` / `_loop_steps_continuous`) used to
+keep this state in per-runner Python dict/list/set structures; at
+`--fleet 512` the per-shell Python scans over them (min-deadline
+bounds, timeout expiry, due-retry merges) were the last O(F) host cost
+per wave.
+
+Two interchangeable backends, selected by `--sessions`:
+
+  - ``CoroutineSessions`` — the original dict/list/set bookkeeping,
+    one instance per runner. Default for standalone runs.
+  - ``ColumnarSessions`` — ONE shared table for the whole fleet:
+    ``[F, S]`` numpy deadline/validity columns refreshed by a single
+    vectorized pass per wave (`encode_wave`), consumed through
+    per-shell `SessionView` facades that give the loops the same
+    operations. Default under ``--fleet``.
+
+The columnar table is deliberately hybrid: numpy holds exactly the
+columns the wave pass reduces over (pending validity + deadline,
+requeue validity + due round, retry counters), while the per-EVENT
+bookkeeping — mid -> slot lookup, free-slot recycling, the op payload
+— lives in per-shell dict/stack mirrors, because a numpy point op
+costs microseconds of call overhead where a dict op costs nanoseconds.
+The win is the per-WAVE term: `encode_wave` refreshes every shell's
+min-deadline / min-due bound in one masked reduction, so a shell that
+saw no events answers its scan-bound and expiry queries in O(1)
+instead of re-scanning its pending set, and a shell that did see
+events falls back to exactly the coroutine backend's Python scan —
+never worse, O(1) when quiet.
+
+The contract between the backends is BYTE-IDENTITY: same seed => same
+histories, same results, and checkpoint meta in the exact legacy
+shapes (`to_meta`), so a checkpoint written by one backend resumes
+under the other and the test fingerprint does not change (`sessions`
+is deliberately NOT a checkpoint fingerprint key). The
+ordering-sensitive operations — timeout-expiry order (dict insertion
+order) and due-retry merge order (append order, stable-sorted by due
+round) — are reproduced exactly: the mid -> slot dict IS
+insertion-ordered, and requeue rows carry an append ``seq``. Pinned
+by tests/test_sessions.py and the columnar variants of the PR 12
+fleet byte-identity pins.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_I64MAX = np.iinfo(np.int64).max
+
+
+def trunc_exp_bound(base, cap, attempt: int):
+    """The truncated-exponential backoff bound shared by every retry
+    path: min(cap, base * 2^attempt), with the shift clamped so a long
+    redirect chain cannot overflow. `client.RetryPolicy` draws wall
+    milliseconds under this bound (full jitter); the runner's
+    leader-redirect requeue draws virtual ROUNDS under it from a
+    seeded hash (`tpu_runner._backoff_rounds`)."""
+    return min(cap, base * (1 << min(int(attempt), 16)))
+
+
+class CoroutineSessions:
+    """The original per-runner session bookkeeping: a pending dict
+    (insertion-ordered, mid -> (process, op, node, deadline)), the
+    redirect-requeue list, and the retry attempt/open structures —
+    wrapped behind the Sessions interface the loops consume so the
+    columnar backend can slot in without touching loop code."""
+
+    def __init__(self):
+        self._pending: dict[int, tuple] = {}
+        self._requeue: list[tuple] = []
+        self._attempt: dict[int, int] = {}
+        self._open: set[int] = set()
+
+    # --- pending RPCs ---------------------------------------------------
+
+    def register(self, mid: int, process, op, node: int, deadline: int):
+        self._pending[mid] = (process, op, node, deadline)
+
+    def absorb_results(self, mids) -> list:
+        """Folds a batch of drained reply ids into the table: pops and
+        returns the (process, op, node, deadline) entry per mid, None
+        for a stale reply (already completed/timed out)."""
+        pop = self._pending.pop
+        return [pop(m, None) for m in mids]
+
+    def take_expired(self, r: int) -> list:
+        """Pops every pending row whose timeout deadline has passed, in
+        REGISTRATION order (the dict-insertion order the timeout
+        completions have always used). Returns (process, op, node)."""
+        expired = [m for m, (_, _, _, dl) in self._pending.items()
+                   if dl <= r]
+        return [self._pending.pop(m)[:3] for m in expired]
+
+    def min_deadline(self):
+        if not self._pending:
+            return None
+        return min(v[3] for v in self._pending.values())
+
+    def __len__(self):
+        return len(self._pending)
+
+    def __bool__(self):
+        return bool(self._pending)
+
+    # --- leader-redirect requeue ----------------------------------------
+
+    def requeue(self, due, process, op, node, t, a, b, c):
+        self._requeue.append((due, process, op, node, t, a, b, c))
+
+    def has_requeue(self) -> bool:
+        return bool(self._requeue)
+
+    def requeue_min_due(self):
+        if not self._requeue:
+            return None
+        return min(rw[0] for rw in self._requeue)
+
+    def take_due_requeues(self, r: int) -> list:
+        """Pops rows whose backoff elapsed (due <= r), stable-sorted by
+        due round (append order preserved within a round). Returns
+        (process, op, node, t, a, b, c) rows ready to inject."""
+        due_rows = sorted((rw for rw in self._requeue if rw[0] <= r),
+                          key=lambda rw: rw[0])
+        if due_rows:
+            self._requeue = [rw for rw in self._requeue if rw[0] > r]
+        return [rw[1:] for rw in due_rows]
+
+    def drain_requeues(self, r: int) -> list:
+        """Pops EVERY row (continuous mode: retries join the scheduled
+        stream), due rounds clamped to the current window start, append
+        order preserved. Returns (due, process, op, node, t, a, b, c)."""
+        rows = [(max(int(rw[0]), r),) + tuple(rw[1:])
+                for rw in self._requeue]
+        self._requeue = []
+        return rows
+
+    # --- retry / redirect chains ----------------------------------------
+
+    def attempt(self, process) -> int:
+        return self._attempt.get(process, 0)
+
+    def open_retry(self, process, attempt: int):
+        self._attempt[process] = attempt
+        self._open.add(process)
+
+    def retry_is_open(self, process) -> bool:
+        return process in self._open
+
+    def close_retry(self, process):
+        self._attempt.pop(process, None)
+        self._open.discard(process)
+
+    # --- checkpoint meta (the legacy shapes, byte-compatible) -----------
+
+    def to_meta(self) -> dict:
+        return {"pending": dict(self._pending),
+                "requeue": {"rows": list(self._requeue),
+                            "attempt": dict(self._attempt),
+                            "open": sorted(self._open)}}
+
+    def load_meta(self, pending, requeue):
+        self._pending = dict(pending or {})
+        rq = requeue or {}
+        self._requeue = [tuple(rw) for rw in (rq.get("rows") or [])]
+        self._attempt = dict(rq.get("attempt") or {})
+        self._open = set(rq.get("open") or ())
+
+
+class ColumnarSessions:
+    """One shared client-session table for a whole fleet: pending-RPC,
+    timeout-deadline, retry/backoff, and redirect-requeue state beside
+    ``[F, S]`` numpy validity/deadline columns. `encode_wave()` is the
+    single vectorized pass per wave — it refreshes every shell's
+    min-deadline / min-due aggregates in ONE masked reduction over the
+    whole table, so the per-shell scan bounds the loops read each wave
+    are cached O(1) lookups for every shell the wave left untouched.
+    Shells mutate through `SessionView` facades (`view(i)`); per-event
+    point ops (register / absorb / pop) go through per-shell
+    insertion-ordered mid -> slot dicts and free-slot stacks — O(1)
+    each, matching the coroutine backend op-for-op — while the numpy
+    columns shadow just the fields the wave reduction needs. A
+    mutation that can lower a cached bound updates it in place; one
+    that can raise it (popping the current min) marks only that
+    shell's cache row dirty, and a dirty shell recomputes its bound
+    with the same Python scan the coroutine backend always pays.
+
+    Capacity starts at 2x concurrency (a worker holds at most one RPC
+    in flight) and doubles on demand. Slot payload tuples are
+    ``(process, op, node, deadline, mid)`` for pending rows and the
+    legacy ``(due, process, op, node, t, a, b, c)`` row plus an append
+    ``seq`` for requeues — see the module docstring's byte-identity
+    contract."""
+
+    def __init__(self, fleet: int, concurrency: int, cap: int = 0):
+        F = max(int(fleet), 1)
+        C = max(int(concurrency), 1)
+        S = int(cap) or max(2 * C, 8)
+        R = max(C, 8)
+        self.F, self.C = F, C
+        # wave-pass columns [F, S]: mid < 0 marks a free slot; ONLY
+        # what encode_wave reduces over lives in numpy
+        self.p_mid = np.full((F, S), -1, np.int64)
+        self.p_dl = np.zeros((F, S), np.int64)
+        # requeue columns [F, R]
+        self.r_valid = np.zeros((F, R), bool)
+        self.r_due = np.zeros((F, R), np.int64)
+        # retry columns [F, C]: attempt counter + open-chain flag per
+        # worker process (only client processes redirect)
+        self.attempt_col = np.zeros((F, C), np.int32)
+        self.open_col = np.zeros((F, C), bool)
+        # per-event mirrors: _slots[i] is the insertion-ordered
+        # mid -> slot dict (it IS the coroutine pending-dict ordering);
+        # _pmeta[i][s] the slot payload; _pfree[i] the free-slot stack
+        self._slots = [dict() for _ in range(F)]
+        self._pmeta = [[None] * S for _ in range(F)]
+        self._pfree = [list(range(S - 1, -1, -1)) for _ in range(F)]
+        self._rqmeta = [[None] * R for _ in range(F)]
+        self._rqfree = [list(range(R - 1, -1, -1)) for _ in range(F)]
+        self._rqn = [0] * F
+        self._rqseq = [0] * F
+        # per-wave aggregate cache (refreshed by encode_wave, consumed
+        # by the views' min_deadline/requeue_min_due; exact whenever
+        # _cache_ok — lowering mutations update it in place, raising
+        # ones dirty only their own shell row)
+        self._cache_ok = np.zeros(F, bool)
+        self._min_dl = np.full(F, _I64MAX, np.int64)
+        self._min_due = np.full(F, _I64MAX, np.int64)
+
+    def view(self, i: int) -> "SessionView":
+        return SessionView(self, i)
+
+    # --- the per-wave table pass ----------------------------------------
+
+    def encode_wave(self):
+        """THE single vectorized pass per wave: one masked reduction
+        over the whole [F, S] table refreshes every shell's
+        min-deadline / min-due-retry aggregates at once. The fleet
+        driver calls it at each wave start (inside the
+        `record_poll`/schedule-encode span, so the win is visible in
+        the flight recorder); shells the wave leaves untouched then
+        answer their scan bounds from the cache instead of scanning
+        their pending sets."""
+        pvalid = self.p_mid >= 0
+        self._min_dl = np.where(pvalid, self.p_dl, _I64MAX).min(axis=1)
+        self._min_due = np.where(self.r_valid, self.r_due,
+                                 _I64MAX).min(axis=1)
+        self._cache_ok[:] = True
+
+    def _refresh_shell(self, i: int):
+        # the dirty-shell fallback: the same Python scans the
+        # coroutine backend pays every wave, here only after a
+        # mutation raised a bound
+        meta = self._pmeta[i]
+        self._min_dl[i] = min(
+            (meta[s][3] for s in self._slots[i].values()),
+            default=_I64MAX)
+        if self._rqn[i]:
+            self._min_due[i] = min(m[0] for m in self._rqmeta[i]
+                                   if m is not None)
+        else:
+            self._min_due[i] = _I64MAX
+        self._cache_ok[i] = True
+
+    # --- pending RPCs ---------------------------------------------------
+
+    def _grow_pending(self):
+        F, S = self.p_mid.shape
+        self.p_mid = np.concatenate(
+            [self.p_mid, np.full((F, S), -1, np.int64)], axis=1)
+        self.p_dl = np.concatenate(
+            [self.p_dl, np.zeros((F, S), np.int64)], axis=1)
+        grown = range(2 * S - 1, S - 1, -1)
+        for i in range(F):
+            self._pmeta[i].extend([None] * S)
+            self._pfree[i].extend(grown)
+
+    def register(self, i, mid, process, op, node, deadline):
+        free = self._pfree[i]
+        if not free:
+            self._grow_pending()
+            free = self._pfree[i]
+        s = free.pop()
+        mid = int(mid)
+        deadline = int(deadline)
+        self.p_mid[i, s] = mid
+        self.p_dl[i, s] = deadline
+        self._pmeta[i][s] = (process, op, node, deadline, mid)
+        self._slots[i][mid] = s
+        if self._cache_ok[i] and deadline < self._min_dl[i]:
+            self._min_dl[i] = deadline
+
+    def absorb_results(self, i, mids) -> list:
+        """Batch-pop of a wave's drained reply ids for shell i: each
+        pop is one dict op + a column clear. None per stale reply."""
+        slots = self._slots[i]
+        meta = self._pmeta[i]
+        free = self._pfree[i]
+        out = []
+        for m in mids:
+            s = slots.pop(int(m), -1)
+            if s < 0:
+                out.append(None)
+                continue
+            mt = meta[s]
+            meta[s] = None
+            self.p_mid[i, s] = -1
+            free.append(s)
+            if self._cache_ok[i] and mt[3] <= self._min_dl[i]:
+                self._cache_ok[i] = False
+            out.append(mt[:4])
+        return out
+
+    def take_expired(self, i, r) -> list:
+        slots = self._slots[i]
+        if not slots:
+            return []
+        if self._cache_ok[i] and r < self._min_dl[i]:
+            # the wave-pass bound says nothing expired: O(1), no scan
+            return []
+        meta = self._pmeta[i]
+        expired = [s for s in slots.values() if meta[s][3] <= r]
+        if not expired:
+            # the bound was stale-low; rebuild it so the following
+            # waves are O(1) again
+            self._refresh_shell(i)
+            return []
+        out = []
+        free = self._pfree[i]
+        for s in expired:          # dict order == registration order
+            mt = meta[s]
+            out.append(mt[:3])
+            del slots[mt[4]]
+            meta[s] = None
+            self.p_mid[i, s] = -1
+            free.append(s)
+        self._cache_ok[i] = False
+        return out
+
+    def min_deadline(self, i):
+        if not self._slots[i]:
+            return None
+        if not self._cache_ok[i]:
+            self._refresh_shell(i)
+        return int(self._min_dl[i])
+
+    # --- leader-redirect requeue ----------------------------------------
+
+    def _grow_requeue(self):
+        F, R = self.r_valid.shape
+        self.r_valid = np.concatenate(
+            [self.r_valid, np.zeros((F, R), bool)], axis=1)
+        self.r_due = np.concatenate(
+            [self.r_due, np.zeros((F, R), np.int64)], axis=1)
+        grown = range(2 * R - 1, R - 1, -1)
+        for i in range(F):
+            self._rqmeta[i].extend([None] * R)
+            self._rqfree[i].extend(grown)
+
+    def requeue(self, i, due, process, op, node, t, a, b, c):
+        free = self._rqfree[i]
+        if not free:
+            self._grow_requeue()
+            free = self._rqfree[i]
+        s = free.pop()
+        due = int(due)
+        self.r_valid[i, s] = True
+        self.r_due[i, s] = due
+        self._rqmeta[i][s] = (due, process, op, node, t, a, b, c,
+                              self._rqseq[i])
+        self._rqseq[i] += 1
+        self._rqn[i] += 1
+        if self._cache_ok[i] and due < self._min_due[i]:
+            self._min_due[i] = due
+
+    def has_requeue(self, i) -> bool:
+        return self._rqn[i] > 0
+
+    def requeue_min_due(self, i):
+        if not self._rqn[i]:
+            return None
+        if not self._cache_ok[i]:
+            self._refresh_shell(i)
+        return int(self._min_due[i])
+
+    def _rq_pop(self, i, s):
+        self.r_valid[i, s] = False
+        self._rqmeta[i][s] = None
+        self._rqfree[i].append(s)
+        self._rqn[i] -= 1
+
+    def take_due_requeues(self, i, r) -> list:
+        if not self._rqn[i]:
+            return []
+        if self._cache_ok[i] and r < self._min_due[i]:
+            return []
+        live = [(s, m) for s, m in enumerate(self._rqmeta[i])
+                if m is not None and m[0] <= r]
+        if not live:
+            self._refresh_shell(i)
+            return []
+        # stable by due round, append (seq) order within a round —
+        # exactly `sorted(rows, key=due)` over the legacy list
+        live.sort(key=lambda sm: (sm[1][0], sm[1][8]))
+        for s, _ in live:
+            self._rq_pop(i, s)
+        self._cache_ok[i] = False
+        return [m[1:8] for _, m in live]
+
+    def drain_requeues(self, i, r) -> list:
+        if not self._rqn[i]:
+            return []
+        live = [(s, m) for s, m in enumerate(self._rqmeta[i])
+                if m is not None]
+        live.sort(key=lambda sm: sm[1][8])      # append order
+        for s, _ in live:
+            self._rq_pop(i, s)
+        self._cache_ok[i] = False
+        return [(max(m[0], r),) + m[1:8] for _, m in live]
+
+    # --- retry / redirect chains ----------------------------------------
+
+    def _retry_slot(self, process) -> bool:
+        # retry state only ever attaches to client processes (int
+        # worker ids < C); the nemesis completes through the same
+        # `_complete` path with a string id — no column for it, and
+        # the coroutine backend's dict silently holds nothing either
+        return isinstance(process, int) and 0 <= process < self.C
+
+    def attempt(self, i, process) -> int:
+        if not self._retry_slot(process):
+            return 0
+        return int(self.attempt_col[i, process])
+
+    def open_retry(self, i, process, attempt):
+        self.attempt_col[i, process] = attempt
+        self.open_col[i, process] = True
+
+    def retry_is_open(self, i, process) -> bool:
+        return self._retry_slot(process) \
+            and bool(self.open_col[i, process])
+
+    def close_retry(self, i, process):
+        if self._retry_slot(process) and self.open_col[i, process]:
+            self.attempt_col[i, process] = 0
+            self.open_col[i, process] = False
+
+    # --- checkpoint meta (the legacy shapes, byte-compatible) -----------
+
+    def to_meta(self, i) -> dict:
+        meta = self._pmeta[i]
+        pending = {mid: meta[s][:4]
+                   for mid, s in self._slots[i].items()}
+        live = sorted((m for m in self._rqmeta[i] if m is not None),
+                      key=lambda m: m[8])
+        open_ = [int(p) for p in np.nonzero(self.open_col[i])[0]]
+        return {"pending": pending,
+                "requeue": {"rows": [m[:8] for m in live],
+                            "attempt": {p: int(self.attempt_col[i, p])
+                                        for p in open_},
+                            "open": open_}}
+
+    def load_meta(self, i, pending, requeue):
+        # clear shell i, then replay the legacy meta in its recorded
+        # order so the dict/seq mirrors reproduce the original
+        # insertion order
+        meta = self._pmeta[i]
+        free = self._pfree[i]
+        for s in self._slots[i].values():
+            self.p_mid[i, s] = -1
+            meta[s] = None
+            free.append(s)
+        self._slots[i].clear()
+        for s, m in enumerate(self._rqmeta[i]):
+            if m is not None:
+                self._rq_pop(i, s)
+        self.attempt_col[i] = 0
+        self.open_col[i] = False
+        for mid, (process, op, node, dl) in (pending or {}).items():
+            self.register(i, mid, process, op, node, dl)
+        rq = requeue or {}
+        for rw in (rq.get("rows") or []):
+            due, process, op, node, t, a, b, c = rw
+            self.requeue(i, due, process, op, node, t, a, b, c)
+        att = dict(rq.get("attempt") or {})
+        for p in (rq.get("open") or ()):
+            self.open_col[i, p] = True
+        for p, n in att.items():
+            self.attempt_col[i, p] = n
+        self._cache_ok[i] = False
+
+
+class SessionView:
+    """One shell's facade over the shared `ColumnarSessions` table:
+    the same operations `CoroutineSessions` exposes, delegated to the
+    table with this shell's row index. The dispatch loops hold one of
+    these (or a CoroutineSessions) and never know which."""
+
+    __slots__ = ("table", "i")
+
+    def __init__(self, table: ColumnarSessions, i: int):
+        self.table, self.i = table, i
+
+    def register(self, mid, process, op, node, deadline):
+        self.table.register(self.i, mid, process, op, node, deadline)
+
+    def absorb_results(self, mids):
+        return self.table.absorb_results(self.i, mids)
+
+    def take_expired(self, r):
+        return self.table.take_expired(self.i, r)
+
+    def min_deadline(self):
+        return self.table.min_deadline(self.i)
+
+    def __len__(self):
+        return len(self.table._slots[self.i])
+
+    def __bool__(self):
+        return bool(self.table._slots[self.i])
+
+    def requeue(self, due, process, op, node, t, a, b, c):
+        self.table.requeue(self.i, due, process, op, node, t, a, b, c)
+
+    def has_requeue(self):
+        return self.table.has_requeue(self.i)
+
+    def requeue_min_due(self):
+        return self.table.requeue_min_due(self.i)
+
+    def take_due_requeues(self, r):
+        return self.table.take_due_requeues(self.i, r)
+
+    def drain_requeues(self, r):
+        return self.table.drain_requeues(self.i, r)
+
+    def attempt(self, process):
+        return self.table.attempt(self.i, process)
+
+    def open_retry(self, process, attempt):
+        self.table.open_retry(self.i, process, attempt)
+
+    def retry_is_open(self, process):
+        return self.table.retry_is_open(self.i, process)
+
+    def close_retry(self, process):
+        self.table.close_retry(self.i, process)
+
+    def to_meta(self):
+        return self.table.to_meta(self.i)
+
+    def load_meta(self, pending, requeue):
+        self.table.load_meta(self.i, pending, requeue)
+
+
+SESSION_MODES = ("coroutine", "columnar")
+
+
+def resolve_mode(test: dict) -> str:
+    """The effective --sessions mode for this test: an explicit choice
+    sticks; None = auto (columnar for a fleet, coroutine standalone —
+    the backends are byte-identical, so the default just picks the
+    cheaper host path per topology)."""
+    mode = test.get("sessions")
+    if mode is None:
+        return ("columnar" if int(test.get("fleet") or 1) > 1
+                else "coroutine")
+    mode = str(mode)
+    if mode not in SESSION_MODES:
+        raise ValueError(f"--sessions {mode!r}: expected one of "
+                         f"{SESSION_MODES}")
+    return mode
+
+
+def make_sessions(test: dict, concurrency: int):
+    """Builds a standalone runner's session backend (the fleet driver
+    instead shares ONE ColumnarSessions table across its shells and
+    hands each a view — see FleetRunner)."""
+    if resolve_mode(test) == "columnar":
+        return ColumnarSessions(1, concurrency).view(0)
+    return CoroutineSessions()

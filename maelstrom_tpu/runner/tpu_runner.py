@@ -258,13 +258,7 @@ class TpuNetStats(Checker):
 
             from .. import nodes as _nodes_mod
             mod = sys.modules.get(type(self.runner.program).__module__)
-            names = {}
-            # the program's own codes win; the shared reply vocabulary
-            # (nodes/__init__: T_ERROR etc.) names the rest
-            for source in (mod, _nodes_mod):
-                for k, v in (vars(source) if source else {}).items():
-                    if k.startswith("T_") and isinstance(v, int):
-                        names.setdefault(v, k[2:].lower())
+            names = _nodes_mod.wire_name_table(mod)
             out["send-count-by-type"] = {
                 names.get(t, f"type-{t}"): n
                 for t, n in sorted(by_type.items())}
@@ -559,10 +553,11 @@ class TpuRunner:
         # Backoff pacing from --client-backoff-ms /
         # --client-backoff-cap-ms. Rows are (due_round, process, op,
         # node_idx, t, a, b, c) — the continuous carry_sched shape —
-        # and ride checkpoints.
-        self._requeue: list = []
-        self._retry_attempt: dict = {}
-        self._retry_open: set = set()
+        # and ride checkpoints. All of this state lives in the
+        # session table (runner/sessions.py, built per run in
+        # _setup_run): --sessions picks the dict/list bookkeeping or
+        # the fleet-shared columnar table, byte-identical either way.
+        self._sessions = None
         self._redirect_budget = int(test.get("client_retries") or 0) or 16
         # donated carry: the bump is pure round-counter surgery on the
         # full state tree, so buffer reuse saves a whole-tree copy per
@@ -774,13 +769,15 @@ class TpuRunner:
         checkpoint/SIGKILL-resume replays the identical schedule
         without carrying RNG state."""
         import hashlib
+
+        from .sessions import trunc_exp_bound
         bo_ms = self.test.get("client_backoff_ms")
         cap_ms = self.test.get("client_backoff_cap_ms")
         base = max(1, int(float(50.0 if bo_ms is None else bo_ms)
                           / self.ms_per_round))
         cap = max(base, int(float(2000.0 if cap_ms is None else cap_ms)
                             / self.ms_per_round))
-        bound = min(cap, base << min(int(attempt), 16))
+        bound = trunc_exp_bound(base, cap, attempt)
         h = int.from_bytes(hashlib.md5(
             f"{self.test.get('seed', 0)}:{process}:{attempt}"
             .encode()).digest()[:4], "big")
@@ -795,8 +792,7 @@ class TpuRunner:
                            completed.get("final", False))
         free.add(process)
         # the op's redirect-retry chain (if any) ends with its window
-        self._retry_attempt.pop(process, None)
-        self._retry_open.discard(process)
+        self._sessions.close_retry(process)
         return gen.update(ctx, completed)
 
 
@@ -834,7 +830,7 @@ class TpuRunner:
             return jax.tree.unflatten(treedef, out)
         return pack, unpack
 
-    def _stop_on_reply(self, gen, ctx, pending, free) -> bool:
+    def _stop_on_reply(self, gen, ctx, sessions, free) -> bool:
         """True = the scan must EXIT at the first client reply; False =
         it may cross whole reply-bearing stretches. Crossing is safe iff
         a completion cannot move the generator's next emission earlier
@@ -848,14 +844,14 @@ class TpuRunner:
         report the finite branch's time."""
         if not self.collect_replies:
             return True
-        if not pending:
+        if not sessions:
             return False            # nothing in flight: no replies at all
         if not (set(ctx["free"]) - {g.NEMESIS}):
             return True             # starved: a completion enables emission
         import math
         return gen.next_interesting_time(ctx) == math.inf
 
-    def _scan_bound(self, gen, ctx, pending, r, next_ckpt,
+    def _scan_bound(self, gen, ctx, sessions, r, next_ckpt,
                     max_rounds) -> int:
         """How many injection-free rounds may run in one compiled dispatch
         without the host needing to look: bounded by the generator's next
@@ -867,11 +863,13 @@ class TpuRunner:
         nt = gen.next_interesting_time(ctx)
         if nt != math.inf:
             bound = min(bound, int(math.ceil(nt / ns_pr)))
-        if pending:
-            bound = min(bound, min(v[3] for v in pending.values()))
-        if self._requeue:
+        dl = sessions.min_deadline()
+        if dl is not None:
+            bound = min(bound, dl)
+        due = sessions.requeue_min_due()
+        if due is not None:
             # a redirect retry becomes injectable at its due round
-            bound = min(bound, min(rw[0] for rw in self._requeue))
+            bound = min(bound, due)
         if next_ckpt is not None:
             bound = min(bound, next_ckpt)
         bound = min(bound, max_rounds)
@@ -879,7 +877,7 @@ class TpuRunner:
 
     # --- checkpoint/resume (SURVEY.md section 5.4: the reference can't) ---
 
-    def _save_checkpoint(self, gen, history, pending, free, r,
+    def _save_checkpoint(self, gen, history, sessions, free, r,
                          sync: bool = False):
         """Snapshots the run. Main-thread work is only what MUST happen
         before the next dispatch mutates state: the sim device pull
@@ -900,11 +898,12 @@ class TpuRunner:
             # buffers; a later donated dispatch may recycle them while
             # the writer is still pickling (same hazard as _read_state)
             sim_host = jax.tree.map(np.array, sim_host)
+        sess_meta = sessions.to_meta()
         meta = {
             "r": r,
             "dispatches": self._dispatches,
             "gen": gen,
-            "pending": dict(pending),
+            "pending": sess_meta["pending"],
             "free": set(free),
             "intern": self.intern,
             "nemesis_rng": (self.nemesis.rng_state()
@@ -913,9 +912,8 @@ class TpuRunner:
             "carry": getattr(self, "_carry_live", None),
             # leader-redirect requeue: retried ops whose invoke windows
             # are still open must re-issue identically after a resume
-            "requeue": {"rows": list(self._requeue),
-                        "attempt": dict(self._retry_attempt),
-                        "open": sorted(self._retry_open)},
+            # (both session backends emit the same legacy meta shape)
+            "requeue": sess_meta["requeue"],
             # program host-side session state (kafka consumer sessions,
             # polled-offset tracking, the compartment's leader guess):
             # the op stream depends on it
@@ -956,7 +954,7 @@ class TpuRunner:
             self._ckpt_writer.wait()
             self.transfer.ckpt_write_s = self._ckpt_writer.write_s
 
-    def _check_preempted(self, gen, history, pending, free, r):
+    def _check_preempted(self, gen, history, sessions, free, r):
         """The graceful-preemption point, called at stretch boundaries:
         the in-flight compiled stretch has completed and its replies are
         folded into the history, so the state is checkpointable. Writes
@@ -966,11 +964,11 @@ class TpuRunner:
         from .. import checkpoint as cp
         store_dir = self.test.get("store_dir")
         if store_dir:
-            self._save_checkpoint(gen, history, pending, free, r,
+            self._save_checkpoint(gen, history, sessions, free, r,
                                   sync=True)
         log.warning("preempted at virtual round %d (%d history ops, "
                     "%d in flight): exiting %d for supervised relaunch",
-                    r, len(history), len(pending), cp.EXIT_PREEMPTED)
+                    r, len(history), len(sessions), cp.EXIT_PREEMPTED)
         raise cp.Preempted(r, store_dir or None)
 
     # --- main loop ---
@@ -1018,7 +1016,18 @@ class TpuRunner:
         self.nemesis = nemesis
         processes = list(range(C)) + ([g.NEMESIS] if nemesis else [])
         free = set(processes)
-        pending: dict[int, tuple] = {}   # mid -> (process, op, node_idx, deadline_round)
+        # client-session table (doc/perf.md "columnar client sessions"):
+        # pending RPCs, timeout deadlines, retry/backoff and redirect
+        # state. A fleet shell gets a view of the fleet's ONE shared
+        # columnar table; standalone runs build their own backend per
+        # --sessions (byte-identical either way).
+        shared = getattr(self, "_fleet_sessions", None)
+        if shared is not None:
+            sessions = shared[0].view(shared[1])
+        else:
+            from .sessions import make_sessions
+            sessions = make_sessions(test, C)
+        self._sessions = sessions
         history = History()
         max_rounds = int(test.get("max_rounds", 2_000_000))
 
@@ -1034,14 +1043,18 @@ class TpuRunner:
             gen = resume["gen"]
             rh = resume["history"]
             history = rh if isinstance(rh, History) else History(rh)
-            pending = dict(resume["pending"])
+            # session state restores through the same legacy meta
+            # shapes both backends emit, so a checkpoint written under
+            # --sessions coroutine resumes under columnar (and back)
+            sessions.load_meta(resume["pending"],
+                               resume.get("requeue"))
             free = set(resume["free"])
             self.intern = resume["intern"]
             if nemesis and resume.get("nemesis_rng") is not None:
                 nemesis.set_rng_state(resume["nemesis_rng"])
             self.program.set_host_state(resume.get("program_host"))
             log.info("resumed at virtual round %d (%d history ops, "
-                     "%d in flight)", r, len(history), len(pending))
+                     "%d in flight)", r, len(history), len(sessions))
             if self.journal is not None:
                 log.warning(
                     "resume with journaling: net-journal rows and the "
@@ -1091,17 +1104,12 @@ class TpuRunner:
         # but not yet injected at checkpoint time (the schedule cannot
         # be re-drawn — generators share mutable RNGs across states)
         self._resume_carry = resume.get("carry") if resume else None
-        # leader-redirect requeue state rides the checkpoint with it
-        rq = (resume.get("requeue") or {}) if resume else {}
-        self._requeue = [tuple(rw) for rw in (rq.get("rows") or [])]
-        self._retry_attempt = dict(rq.get("attempt") or {})
-        self._retry_open = set(rq.get("open") or ())
         # host mirror of the device message-id counter (refreshed by
         # every dispatch's combined fetch)
         self._init_next_mid()
         return dict(test=test, cfg=self.cfg, program=self.program,
                     gen=gen, nemesis=nemesis, processes=processes,
-                    free=free, pending=pending, history=history,
+                    free=free, sessions=sessions, history=history,
                     max_rounds=max_rounds, next_ckpt=next_ckpt, r=r)
 
     def run(self, resume: dict | None = None) -> History:
@@ -1214,7 +1222,7 @@ class TpuRunner:
                 resp = self._quiet()
 
     def _loop_steps(self, test, cfg, program, gen, nemesis, processes,
-                    free, pending, history, max_rounds, next_ckpt, r):
+                    free, sessions, history, max_rounds, next_ckpt, r):
         """The host-side dispatch loop as a device-agnostic coroutine.
 
         All device interaction happens through three yielded request
@@ -1229,7 +1237,8 @@ class TpuRunner:
         `self._gen_live`/`self._r_live` expose the (rebound) generator
         tree and round at every stretch boundary: the fleet's coalesced
         checkpointing snapshots them — everything else it needs
-        (pending/free/history/intern/nemesis) is shared mutable state."""
+        (sessions/free/history/intern/nemesis) is shared mutable
+        state."""
         N, C = cfg.n_nodes, self.concurrency
         exhausted = False
         observe_round = getattr(self.program, "observe_round", None)
@@ -1238,7 +1247,7 @@ class TpuRunner:
             # stretch boundary: the previous dispatch has landed and its
             # replies are in the history, so this is the graceful spot
             # to honor a pending SIGTERM/SIGINT
-            self._check_preempted(gen, history, pending, free, r)
+            self._check_preempted(gen, history, sessions, free, r)
             if observe_round is not None:
                 # programs with host-side routing leases (the
                 # compartment's client-side leader lease) read the
@@ -1324,17 +1333,9 @@ class TpuRunner:
             # leader-redirect retries whose backoff elapsed re-inject
             # now (their invoke windows are already open — no new
             # history rows, just fresh pending registrations)
-            if self._requeue:
-                due_rows = sorted((rw for rw in self._requeue
-                                   if rw[0] <= r),
-                                  key=lambda rw: rw[0])
-                if due_rows:
-                    self._requeue = [rw for rw in self._requeue
-                                     if rw[0] > r]
-                    inject_rows += [(rw[1], rw[2], rw[3], rw[4], rw[5],
-                                     rw[6], rw[7]) for rw in due_rows]
+            inject_rows += sessions.take_due_requeues(r)
 
-            if exhausted and not pending and not self._requeue \
+            if exhausted and not sessions and not sessions.has_requeue() \
                     and free == set(processes):
                 break
 
@@ -1346,13 +1347,14 @@ class TpuRunner:
             # side-effect-free, so skipping them is equivalent). Jumping
             # the full bound matters on remote devices, where every bump
             # is a host<->device round trip.
-            if not inject_rows and not pending and (yield ("quiet",)):
-                k = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+            if not inject_rows and not sessions and (yield ("quiet",)):
+                k = self._scan_bound(gen, ctx, sessions, r, next_ckpt,
                                      max_rounds)
                 yield ("bump", k)
                 r += k
                 if next_ckpt is not None and r >= next_ckpt:
-                    self._save_checkpoint(gen, history, pending, free, r)
+                    self._save_checkpoint(gen, history, sessions, free,
+                                          r)
                     next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
@@ -1362,8 +1364,8 @@ class TpuRunner:
                 # device here would cost a round trip per injection
                 base_mid = self._next_mid
                 for j, (p, o, ni, *_rest) in enumerate(inject_rows):
-                    pending[base_mid + j] = (p, o, ni,
-                                             r + self.timeout_rounds)
+                    sessions.register(base_mid + j, p, o, ni,
+                                      r + self.timeout_rounds)
 
             # one fused dispatch: this round's injections (possibly none)
             # plus the scan to the next host-relevant round, with every
@@ -1372,28 +1374,29 @@ class TpuRunner:
             # the whole performance story. The bound is computed with the
             # just-injected ops already pending, so their timeout
             # deadlines cap the stretch.
-            k_max = self._scan_bound(gen, ctx, pending, r, next_ckpt,
+            k_max = self._scan_bound(gen, ctx, sessions, r, next_ckpt,
                                      max_rounds)
-            stop = self._stop_on_reply(gen, ctx, pending, free)
+            stop = self._stop_on_reply(gen, ctx, sessions, free)
             k, replies = yield ("scan", inject_rows, k_max, stop,
                                 history, r)
             r += k
             ctx = {"time": self._time_ns(r), "free": self._free_rotated(free, history),
                    "processes": processes}
 
-            for rep in replies:
-                gen = self._apply_reply(program, gen, history, pending,
-                                        free, processes, rep)
+            # one batched table pass pops this wave's reply sessions
+            # (None = stale), then each completion folds in
+            entries = sessions.absorb_results([rep[5] for rep in replies])
+            for rep, entry in zip(replies, entries):
+                gen = self._apply_reply(program, gen, history, sessions,
+                                        free, processes, rep, entry)
 
             # timeouts -> indefinite :info (client.clj:214-233); a
             # timed-out node may be a dead leader — let the program
             # rotate its routing guess so new ops probe elsewhere
             nt = getattr(self.program, "note_timeout", None)
-            expired = [m for m, (_, _, _, dl) in pending.items() if dl <= r]
-            for m in expired:
-                process, op, _ni, _dl = pending.pop(m)
+            for process, op, ni in sessions.take_expired(r):
                 if nt is not None:
-                    nt(_ni)
+                    nt(ni)
                 completed = {**op, "type": "info", "error": "net-timeout"}
                 gen = self._complete(history, gen, ctx, process, completed,
                                      free)
@@ -1403,20 +1406,21 @@ class TpuRunner:
             self._tel_wave(history, r)
 
             if next_ckpt is not None and r >= next_ckpt:
-                self._save_checkpoint(gen, history, pending, free, r)
+                self._save_checkpoint(gen, history, sessions, free, r)
                 next_ckpt = r + self.checkpoint_every_rounds
 
         self._gen_live, self._r_live = gen, r
         return r
 
-    def _apply_reply(self, program, gen, history, pending, free,
-                     processes, rep):
+    def _apply_reply(self, program, gen, history, sessions, free,
+                     processes, rep, entry):
         """Decodes one drained reply row — (round_stamp, type, a, b, c,
         reply_to, payload-or-None) — and folds its completion into the
-        history and generator state. Returns the rebound generator.
-        Shared by the round-synchronous and continuous loops."""
+        history and generator state. `entry` is the session row the
+        caller absorbed for it (`sessions.absorb_results`). Returns the
+        rebound generator. Shared by the round-synchronous and
+        continuous loops."""
         stamp, t_, a_, b_, c_, rt, payload = rep
-        entry = pending.pop(rt, None)
         if entry is None:
             return gen              # stale reply (client.clj:167-168)
         process, op, node_idx, _dl = entry
@@ -1436,7 +1440,7 @@ class TpuRunner:
             if hint_fn is not None:
                 h = hint_fn(body)
                 if h is not None:
-                    attempt = self._retry_attempt.get(process, 0)
+                    attempt = sessions.attempt(process)
                     if attempt < self._redirect_budget:
                         target = int(h)
                         if not 0 <= target < self.cfg.n_nodes:
@@ -1447,12 +1451,11 @@ class TpuRunner:
                             note(target)
                         t2, a2, b2, c2 = program.encode_body(
                             program.request_for_op(op), self.intern)
-                        self._retry_attempt[process] = attempt + 1
-                        self._retry_open.add(process)
+                        sessions.open_retry(process, attempt + 1)
                         due = int(stamp) + self._backoff_rounds(process,
                                                                 attempt)
-                        self._requeue.append(
-                            (due, process, op, target, t2, a2, b2, c2))
+                        sessions.requeue(due, process, op, target,
+                                         t2, a2, b2, c2)
                         return gen
             err = ERROR_REGISTRY.get(body.get("code"))
             definite = err.definite if err else False
@@ -1544,7 +1547,7 @@ class TpuRunner:
         return gen
 
     def _loop_steps_continuous(self, test, cfg, program, gen, nemesis,
-                               processes, free, pending, history,
+                               processes, free, sessions, history,
                                max_rounds, next_ckpt, r):
         """The continuous-mode dispatch loop (doc/streams.md).
 
@@ -1581,7 +1584,7 @@ class TpuRunner:
                                 "host": carry_host}
             # stretch boundary: the previous window has landed and its
             # replies are folded in — the graceful SIGTERM spot
-            self._check_preempted(gen, history, pending, free, r)
+            self._check_preempted(gen, history, sessions, free, r)
             if observe_round is not None:
                 # host-side routing leases see the window-boundary round
                 observe_round(r)
@@ -1645,10 +1648,7 @@ class TpuRunner:
             # leader-redirect retries join the scheduled rows (their
             # due rounds clamp to this window's start; rd gates the
             # in-window injection like any scheduled op)
-            if self._requeue:
-                carry_sched += [(max(int(rw[0]), r),) + tuple(rw[1:])
-                                for rw in self._requeue]
-                self._requeue = []
+            carry_sched += sessions.drain_requeues(r)
             # stable by round: carried rows precede same-round new ones
             carry_sched.sort(key=lambda rw: rw[0])
             _poll_t1 = time.perf_counter()
@@ -1657,7 +1657,7 @@ class TpuRunner:
             self._carry_live = {"sched": carry_sched, "nem": carry_nem,
                                 "host": carry_host}
 
-            if exhausted and not pending and not carry_sched \
+            if exhausted and not sessions and not carry_sched \
                     and carry_nem is None and not carry_host \
                     and free == set(processes):
                 break
@@ -1666,14 +1666,14 @@ class TpuRunner:
             # discipline as the round-synchronous loop)
             first_due = carry_sched[0][0] if carry_sched else None
             h = horizon()
-            if not pending and (first_due is None or first_due > r) \
+            if not sessions and (first_due is None or first_due > r) \
                     and (yield ("quiet",)):
                 target = h if first_due is None else min(first_due, h)
                 k = max(target - r, 1)
                 yield ("bump", k)
                 r += k
                 if next_ckpt is not None and r >= next_ckpt:
-                    self._save_checkpoint(gen, history, pending, free, r)
+                    self._save_checkpoint(gen, history, sessions, free, r)
                     next_ckpt = r + self.checkpoint_every_rounds
                 continue
 
@@ -1685,8 +1685,9 @@ class TpuRunner:
             # stretch — the stride bounds how stale a freed worker can
             # get before the generator is polled again.
             k_abs = min(h, r + self.continuous_stride)
-            if pending:
-                k_abs = min(k_abs, min(v[3] for v in pending.values()))
+            dl = sessions.min_deadline()
+            if dl is not None:
+                k_abs = min(k_abs, dl)
             for rw in carry_sched:
                 k_abs = min(k_abs, rw[0] + self.timeout_rounds)
             k_max = max(k_abs - r, 1)
@@ -1715,29 +1716,27 @@ class TpuRunner:
                             f"continuous scan executed {k} rounds but "
                             f"reported no mid for row {seq} at round "
                             f"{rd}")
-                    if process not in self._retry_open:
+                    if not sessions.retry_is_open(process):
                         # a leader-redirect retry keeps its original
                         # open invoke window — no second invoke row
                         history.append_row("invoke", op.get("f"),
                                            op.get("value"), process,
                                            self._time_ns(rd),
                                            final=op.get("final", False))
-                    pending[mid] = (process, op, node_idx,
-                                    rd + self.timeout_rounds)
+                    sessions.register(mid, process, op, node_idx,
+                                      rd + self.timeout_rounds)
                 else:
+                    entry = sessions.absorb_results([int(item[5])])[0]
                     gen = self._apply_reply(program, gen, history,
-                                            pending, free, processes,
-                                            item)
+                                            sessions, free, processes,
+                                            item, entry)
 
             # timeouts -> indefinite :info (client.clj:214-233)
             ctx = {"time": self._time_ns(r),
                    "free": self._free_rotated(free, history),
                    "processes": processes}
             nt = getattr(self.program, "note_timeout", None)
-            expired = [m for m, (_, _, _, dl) in pending.items()
-                       if dl <= r]
-            for m in expired:
-                process, op, _ni, _dl = pending.pop(m)
+            for process, op, _ni in sessions.take_expired(r):
                 if nt is not None:
                     nt(_ni)
                 completed = {**op, "type": "info",
@@ -1752,7 +1751,7 @@ class TpuRunner:
                 self._carry_live = {"sched": carry_sched,
                                     "nem": carry_nem,
                                     "host": carry_host}
-                self._save_checkpoint(gen, history, pending, free, r)
+                self._save_checkpoint(gen, history, sessions, free, r)
                 next_ckpt = r + self.checkpoint_every_rounds
 
         self._gen_live, self._r_live = gen, r

@@ -4,6 +4,7 @@ runs (the counterpart of jepsen's serve-cmd, reference `core.clj:230`,
 
 from __future__ import annotations
 
+import html
 import http.server
 import json
 import os
@@ -12,9 +13,10 @@ from functools import partial
 
 
 def _badge(valid):
+    # valid comes from results.json — attacker-shaped on a shared store
     color = {"True": "#2ca02c", "False": "#d62728"}.get(
         str(valid), "#ff7f0e")
-    return f'<span style="color:{color}">{valid}</span>'
+    return f'<span style="color:{color}">{html.escape(str(valid))}</span>'
 
 
 def _scan_runs(root):
@@ -62,24 +64,32 @@ class StoreHandler(http.server.SimpleHTTPRequestHandler):
         return self._listing(path)
 
     def _index(self, path):
+        # directory names and results.json fields are untrusted text:
+        # html.escape every interpolation (quote=True in href contexts)
         rows = []
         for wl, ts, valid, ops, rel in _scan_runs(path):
             links = " ".join(
-                f'<a href="{rel}{name}">{label}</a>'
+                f'<a href="{html.escape(rel + name, quote=True)}">'
+                f'{label}</a>'
                 for name, label in [("results.json", "results"),
                                     ("history.jsonl", "history"),
                                     ("node-logs/", "logs"),
                                     ("", "files")]
                 if name == "" or os.path.exists(os.path.join(path, rel,
                                                              name)))
-            rows.append(f"<tr><td><a href='{rel}'>{ts}</a></td>"
-                        f"<td>{wl}</td><td>{_badge(valid)}</td>"
-                        f"<td style='text-align:right'>{ops}</td>"
+            rows.append(f"<tr><td><a href='"
+                        f"{html.escape(rel, quote=True)}'>"
+                        f"{html.escape(ts)}</a></td>"
+                        f"<td>{html.escape(wl)}</td>"
+                        f"<td>{_badge(valid)}</td>"
+                        f"<td style='text-align:right'>"
+                        f"{html.escape(str(ops))}</td>"
                         f"<td>{links}</td></tr>")
         # raw listing escape hatch: in-progress runs (no results.json
         # yet) and loose store entries stay reachable per-workload
         dirs = " ".join(
-            f'<a href="{d}/">{d}/</a>'
+            f'<a href="{html.escape(d, quote=True)}/">'
+            f'{html.escape(d)}/</a>'
             for d in sorted(os.listdir(path))
             if os.path.isdir(os.path.join(path, d)))
         body = (
@@ -136,15 +146,20 @@ class StoreHandler(http.server.SimpleHTTPRequestHandler):
                     color = {"True": "#2ca02c", "False": "#d62728"}.get(
                         str(valid), "#ff7f0e")
                     badge = (f' <span style="color:{color}">'
-                             f'[valid: {valid}]</span>')
+                             f'[valid: {html.escape(str(valid))}]'
+                             f'</span>')
                 except Exception:
                     pass
             slash = "/" if os.path.isdir(full) else ""
-            rows.append(f'<li><a href="{name}{slash}">{name}{slash}</a>'
+            rows.append(f'<li><a href='
+                        f'"{html.escape(name + slash, quote=True)}">'
+                        f'{html.escape(name)}{slash}</a>'
                         f'{badge}</li>')
-        body = (f"<html><head><title>store: {rel}</title></head><body>"
+        body = (f"<html><head>"
+                f"<title>store: {html.escape(rel)}</title></head><body>"
                 f'<p><a href="/">run index</a></p>'
-                f"<h2>{rel}</h2><ul>{''.join(rows)}</ul></body></html>")
+                f"<h2>{html.escape(rel)}</h2>"
+                f"<ul>{''.join(rows)}</ul></body></html>")
         return self._send_html(body)
 
 

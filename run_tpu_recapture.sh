@@ -84,6 +84,18 @@ step artifacts/bench-checker-r11.json 2400 \
 step artifacts/bench-fleet-stream-r12.json 3600 \
     env BENCH_MODE=fleet_stream python bench.py
 
+# 1g2. columnar client sessions (BENCH_MODE=fleet_stream at scale,
+#     ISSUE 17): fleet 8/64/512 columnar with the coroutine comparison
+#     rows at >= 64 — `host_wall_per_wave` must stay flat (within 2x)
+#     from fleet 8 to 512 on the columnar path and `session_speedup`
+#     shows the coroutine/columnar host-wall ratio at the compared
+#     sizes (CPU r01 in
+#     artifacts/bench-fleet-stream-sessions-cpu-r01.json; doc/perf.md
+#     "columnar client sessions")
+step artifacts/bench-fleet-stream-sessions-r17.json 7200 \
+    env BENCH_MODE=fleet_stream BENCH_FLEET_STREAM_SIZES=1,8,64,512 \
+    BENCH_FLEET_STREAM_COMPARE_MIN=64 python bench.py
+
 # 1h. flight-recorder overhead (BENCH_MODE=telemetry, ISSUE 13): the
 #     same chunked broadcast scan with the device metric rings compiled
 #     out vs in — headline `value` = overhead percent (< 5% acceptance;

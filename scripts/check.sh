@@ -86,11 +86,42 @@ for i in range(2):
 with open(os.path.join(root, "win", "latest", "results.json")) as f:
     res = json.load(f)
 assert res["continuous"] is True and res["host-polls"] > 0, res
+# columnar client sessions (ISSUE 17): the fleet defaults to the
+# shared column table and reports the per-wave host wall
+assert res["sessions"] == "columnar", res
+assert res["host-wall-per-wave"] > 0, res
 assert res["static-audit"]["ok"] is True, res["static-audit"]
 print("fleet-continuous smoke: verdicts bit-equal, audited, valid")
 PY
     rm -rf "$SMOKE_STORE"
     echo "== fleet-continuous smoke valid =="
+fi
+
+# fleet_stream bench smoke (ISSUE 17, doc/perf.md "columnar client
+# sessions"): a tiny BENCH_MODE=fleet_stream sweep must record the
+# host_wall_per_wave column on every point (the flatness/speedup
+# evidence the committed r01 artifacts carry at full scale).
+# FLEET_SESSIONS_SMOKE=0 skips.
+if [ "${FLEET_SESSIONS_SMOKE:-1}" = "1" ]; then
+    echo "== fleet_stream sessions smoke =="
+    BENCH_MODE=fleet_stream BENCH_FLEET_STREAM_SIZES=1,2 \
+        BENCH_FLEET_STREAM_MULTS=1 BENCH_FLEET_STREAM_TIME_LIMIT=1.0 \
+        BENCH_FLEET_STREAM_COMPARE_MIN=2 \
+        python bench.py > /tmp/fleet-sessions-smoke.json
+    python - /tmp/fleet-sessions-smoke.json <<'PY'
+import json, sys
+rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+pts = rec["points"]
+assert all(p["host_wall_per_wave"] is not None
+           and p["host_wall_per_wave"] > 0 for p in pts), pts
+modes = {(p["fleet"], p["sessions"]) for p in pts}
+assert (2, "columnar") in modes and (2, "coroutine") in modes, modes
+assert rec["session_speedup"], rec
+print("fleet_stream sessions smoke: host_wall_per_wave recorded "
+      "for", sorted(modes))
+PY
+    rm -f /tmp/fleet-sessions-smoke.json
+    echo "== fleet_stream sessions smoke valid =="
 fi
 
 # Batched-broadcast smoke (ISSUE 9, doc/perf.md): the distilled-batch

@@ -5,6 +5,8 @@ neighbor of that history. This is the cross-validation discipline the
 reference outsources to Elle/Knossos's own suites
 (`workload/txn_list_append.clj:112-124`)."""
 
+import pytest
+
 from maelstrom_tpu.checkers.elle import ElleListAppendChecker, analyze
 from maelstrom_tpu.checkers.linearizable import check_register_history
 
@@ -500,6 +502,28 @@ def test_mutex_indeterminate_release_allows_reacquire():
     assert check_history(h, MutexModel())["valid"] is True
     h.append(_mop("acquire", None, 5, 6))
     assert check_history(h, MutexModel())["valid"] is False
+
+
+def test_mutex_mixed_anonymous_and_named_raises():
+    from maelstrom_tpu.checkers.linearizable import (MutexModel,
+                                                     check_history)
+    # an anonymous release against a NAMED holder's acquire is the
+    # lock-stealing shape anonymous identity cannot check — the model
+    # refuses to "verify" it instead of silently degrading (all-
+    # anonymous histories remain the documented holder-blind mode)
+    h = [_mop("acquire", "w0", 0, 1), _mop("release", None, 2, 3)]
+    with pytest.raises(ValueError, match="anonymous"):
+        check_history(h, MutexModel())
+
+
+def test_mutex_named_foreign_release_fires():
+    from maelstrom_tpu.checkers.linearizable import (MutexModel,
+                                                     check_history)
+    # holder-aware identity: w1 cannot release w0's lock
+    h = [_mop("acquire", "w0", 0, 1), _mop("release", "w1", 2, 3)]
+    assert check_history(h, MutexModel())["valid"] is False
+    h2 = [_mop("acquire", "w0", 0, 1), _mop("release", "w0", 2, 3)]
+    assert check_history(h2, MutexModel())["valid"] is True
 
 
 def test_set_read_missing_add_fires():

@@ -132,6 +132,61 @@ def test_fleet_continuous_capacity_sweep():
 
 
 # ---------------------------------------------------------------------------
+# Columnar vs coroutine sessions (ISSUE 17): same fleet, both backends
+# ---------------------------------------------------------------------------
+# The bit-identity tests above already pin the columnar path (the fleet
+# default) against COROUTINE standalone baselines; these pin the
+# `--sessions coroutine` fleet path directly against the columnar one,
+# so the legacy backend stays alive and byte-equal.
+
+def test_fleet_sessions_coroutine_vs_columnar_soup_bit_identical():
+    opts = {**LIN_KV, **SOUP, "time_limit": 1.2}
+    run_col, hs_col = _fleet(opts, fleet=2)
+    run_cor, hs_cor = _fleet(opts, fleet=2, sessions="coroutine")
+    assert run_col.sessions_mode == "columnar"
+    assert run_cor.sessions_mode == "coroutine"
+    assert run_col._session_table is not None
+    assert run_cor._session_table is None
+    for i in range(2):
+        assert _ops(hs_col[i]) == _ops(hs_cor[i]), \
+            f"cluster {i}: session backends diverged"
+
+
+def test_fleet_sessions_cross_backend_resume_bit_identical(tmp_path):
+    """A coalesced fleet checkpoint written under COLUMNAR sessions
+    resumes under COROUTINE sessions (and lands the uninterrupted
+    histories): the meta shapes are the legacy ones and `sessions` is
+    not a fingerprint key."""
+    opts = {**KAFKA, "time_limit": 1.5, "checkpoint_every": 0.25}
+    t = core.build_test({**opts, "fleet": 2})
+    t["store_dir"] = str(tmp_path)
+    fr = FleetRunner(t)
+
+    def preempt_after_first_checkpoint():
+        deadline = time.time() + 300
+        while time.time() < deadline and not fr._preempt.is_set():
+            if fr.transfer.ckpt_saves >= 1:
+                fr._preempt.set()
+                return
+            time.sleep(0.01)
+    threading.Thread(target=preempt_after_first_checkpoint,
+                     daemon=True).start()
+    try:
+        hs = fr.run()
+    except cp.Preempted:
+        ck = cp.load(str(tmp_path))
+        t2 = core.build_test({**opts, "fleet": 2,
+                              "sessions": "coroutine"})
+        t2["store_dir"] = str(tmp_path)
+        cp.check_fingerprint(ck, t2)
+        hs = FleetRunner(t2).run(resume=ck)
+    full = _fleet({**opts, "checkpoint_every": None}, fleet=2)[1]
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(full[i]), \
+            f"cluster {i} diverged across the cross-backend seam"
+
+
+# ---------------------------------------------------------------------------
 # Windowed grading + the host-poll counters (run_fleet_test end to end)
 # ---------------------------------------------------------------------------
 

@@ -171,7 +171,8 @@ def _comparable(res):
     exist only on the overlapped path — the FINAL verdict fields are
     compared and must match bit-for-bit)."""
     drop = {"host-blocked-s", "host-overlapped-s", "host-poll-s",
-            "static-audit", "windows", "checker-lag", "check-wall-s"}
+            "host-wall-per-wave", "static-audit", "windows",
+            "checker-lag", "check-wall-s"}
     return {name: ({k: v for k, v in r.items() if k not in drop}
                    if isinstance(r, dict) else r)
             for name, r in res.items()

@@ -1,0 +1,225 @@
+"""Columnar client sessions (ISSUE 17, doc/perf.md "columnar client
+sessions"): the two session backends — `CoroutineSessions` (the legacy
+dict/list/set bookkeeping) and `ColumnarSessions` (one shared [F, S]
+numpy table, refreshed by a single vectorized `encode_wave` pass) —
+must be operation-for-operation interchangeable: same registration /
+absorb / timeout-expiry / backoff-requeue / redirect-retry semantics,
+same ORDERING of everything order-sensitive (expiry in insertion order,
+due-requeues stable-sorted by due round), and the exact legacy
+checkpoint-meta shapes, so a checkpoint written by one backend resumes
+under the other and fingerprints don't move."""
+
+from __future__ import annotations
+
+import pytest
+
+from maelstrom_tpu.runner.sessions import (ColumnarSessions,
+                                           CoroutineSessions,
+                                           make_sessions, resolve_mode,
+                                           trunc_exp_bound)
+
+
+def _both():
+    return CoroutineSessions(), ColumnarSessions(1, 4).view(0)
+
+
+OP = {"f": "read", "value": None}
+
+
+# ---------------------------------------------------------------------------
+# Pending-RPC columns: register / absorb / timeout transitions
+# ---------------------------------------------------------------------------
+
+def test_register_absorb_parity():
+    for s in _both():
+        s.register(100, 0, {**OP, "k": 0}, 2, 50)
+        s.register(101, 1, {**OP, "k": 1}, 0, 60)
+        assert len(s) == 2 and bool(s)
+        assert s.min_deadline() == 50
+        got = s.absorb_results([101, 999, 100])
+        assert got[0] == (1, {**OP, "k": 1}, 0, 60)
+        assert got[1] is None          # stale reply
+        assert got[2] == (0, {**OP, "k": 0}, 2, 50)
+        assert len(s) == 0 and not s
+        assert s.min_deadline() is None
+
+
+def test_take_expired_registration_order():
+    # expiry completes in REGISTRATION order even when deadlines are
+    # non-monotone — the dict-insertion order timeout completions have
+    # always used (byte-identity depends on it)
+    for s in _both():
+        s.register(1, 0, {"k": "a"}, 0, 30)
+        s.register(2, 1, {"k": "b"}, 1, 10)
+        s.register(3, 2, {"k": "c"}, 2, 20)
+        s.register(4, 3, {"k": "d"}, 3, 99)
+        assert s.take_expired(5) == []
+        assert s.take_expired(25) == [(1, {"k": "b"}, 1),
+                                      (2, {"k": "c"}, 2)]
+        assert len(s) == 2
+        assert s.min_deadline() == 30
+        assert s.take_expired(100) == [(0, {"k": "a"}, 0),
+                                       (3, {"k": "d"}, 3)]
+        assert not s
+
+
+def test_columnar_capacity_growth():
+    v = ColumnarSessions(1, 2, cap=2).view(0)
+    for m in range(9):
+        v.register(m, m % 2, {"m": m}, 0, 10 + m)
+    assert len(v) == 9
+    assert v.min_deadline() == 10
+    got = v.absorb_results(list(range(9)))
+    assert [e[1]["m"] for e in got] == list(range(9))
+
+
+def test_single_mid_absorb_fast_path():
+    # the continuous loop absorbs one mid per merged event
+    v = ColumnarSessions(1, 4).view(0)
+    v.register(7, 2, {"x": 1}, 1, 40)
+    assert v.absorb_results([8]) == [None]
+    assert v.absorb_results([7]) == [(2, {"x": 1}, 1, 40)]
+    assert v.absorb_results([7]) == [None]
+
+
+# ---------------------------------------------------------------------------
+# Backoff-requeue columns
+# ---------------------------------------------------------------------------
+
+def test_requeue_due_order_stable():
+    # due-retry merge order: stable sort by due round, append order
+    # preserved within a round — `sorted(rows, key=due)` exactly
+    for s in _both():
+        s.requeue(20, 0, {"r": 0}, 1, 10, 0, 0, 0)
+        s.requeue(10, 1, {"r": 1}, 2, 11, 0, 0, 0)
+        s.requeue(10, 2, {"r": 2}, 0, 12, 0, 0, 0)
+        s.requeue(30, 3, {"r": 3}, 1, 13, 0, 0, 0)
+        assert s.has_requeue()
+        assert s.requeue_min_due() == 10
+        rows = s.take_due_requeues(20)
+        assert [(rw[0], rw[1]["r"]) for rw in rows] == \
+            [(1, 1), (2, 2), (0, 0)]
+        assert rows[0] == (1, {"r": 1}, 2, 11, 0, 0, 0)
+        assert s.has_requeue() and s.requeue_min_due() == 30
+        assert s.take_due_requeues(29) == []
+        assert s.take_due_requeues(30) == [(3, {"r": 3}, 1, 13, 0, 0, 0)]
+        assert not s.has_requeue() and s.requeue_min_due() is None
+
+
+def test_drain_requeues_clamps_and_keeps_append_order():
+    # continuous mode: ALL rows drain in append order with due clamped
+    # up to the window start
+    for s in _both():
+        s.requeue(50, 0, {"r": 0}, 1, 10, 0, 0, 0)
+        s.requeue(5, 1, {"r": 1}, 2, 11, 0, 0, 0)
+        rows = s.drain_requeues(20)
+        assert rows == [(50, 0, {"r": 0}, 1, 10, 0, 0, 0),
+                        (20, 1, {"r": 1}, 2, 11, 0, 0, 0)]
+        assert not s.has_requeue()
+
+
+# ---------------------------------------------------------------------------
+# Redirect-retry chain columns
+# ---------------------------------------------------------------------------
+
+def test_retry_chain_transitions():
+    for s in _both():
+        assert s.attempt(2) == 0 and not s.retry_is_open(2)
+        s.open_retry(2, 1)
+        assert s.attempt(2) == 1 and s.retry_is_open(2)
+        s.open_retry(2, 2)
+        assert s.attempt(2) == 2
+        assert not s.retry_is_open(3)
+        s.close_retry(2)
+        assert s.attempt(2) == 0 and not s.retry_is_open(2)
+        # the nemesis completes through the same path with a string id
+        s.close_retry("nemesis")
+        assert not s.retry_is_open("nemesis")
+        assert s.attempt("nemesis") == 0
+
+
+def test_backoff_bound_shared_curve():
+    assert trunc_exp_bound(50.0, 2000.0, 0) == 50.0
+    assert trunc_exp_bound(50.0, 2000.0, 3) == 400.0
+    assert trunc_exp_bound(50.0, 2000.0, 10) == 2000.0
+    # the shift clamps: a pathological redirect chain cannot overflow
+    assert trunc_exp_bound(50.0, 2000.0, 10 ** 6) == 2000.0
+    assert trunc_exp_bound(4, 1 << 40, 20) == 4 * (1 << 16)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint meta: legacy shapes, cross-backend round-trip
+# ---------------------------------------------------------------------------
+
+def _populate(s):
+    s.register(5, 0, {"k": "a"}, 1, 40)
+    s.register(3, 1, {"k": "b"}, 2, 30)
+    s.requeue(12, 2, {"r": 2}, 0, 9, 8, 7, 6)
+    s.open_retry(2, 3)
+
+
+def test_to_meta_legacy_shapes_identical():
+    metas = []
+    for s in _both():
+        _populate(s)
+        metas.append(s.to_meta())
+    assert metas[0] == metas[1]
+    m = metas[0]
+    assert list(m["pending"]) == [5, 3]    # insertion order
+    assert m["pending"][3] == (1, {"k": "b"}, 2, 30)
+    assert m["requeue"]["rows"] == [(12, 2, {"r": 2}, 0, 9, 8, 7, 6)]
+    assert m["requeue"]["attempt"] == {2: 3}
+    assert m["requeue"]["open"] == [2]
+
+
+@pytest.mark.parametrize("src,dst", [(0, 1), (1, 0), (0, 0), (1, 1)])
+def test_meta_round_trip_cross_backend(src, dst):
+    # a checkpoint written by either backend resumes under either:
+    # the behavioral state (ordering included) survives the round trip
+    pair = _both()
+    _populate(pair[src])
+    meta = pair[src].to_meta()
+    d = pair[dst]
+    d.register(99, 3, {"stale": True}, 0, 1)   # overwritten by load
+    d.load_meta(meta["pending"], meta["requeue"])
+    assert d.to_meta() == meta
+    assert len(d) == 2 and d.min_deadline() == 30
+    assert d.attempt(2) == 3 and d.retry_is_open(2)
+    # expiry order replays the recorded insertion order
+    assert d.take_expired(100) == [(0, {"k": "a"}, 1), (1, {"k": "b"}, 2)]
+
+
+# ---------------------------------------------------------------------------
+# The shared fleet table + mode resolution
+# ---------------------------------------------------------------------------
+
+def test_shared_table_shell_isolation_and_encode_wave():
+    t = ColumnarSessions(3, 4)
+    a, b = t.view(0), t.view(2)
+    a.register(1, 0, {"s": 0}, 1, 25)
+    b.register(1, 1, {"s": 2}, 0, 15)
+    b.requeue(8, 2, {"r": 1}, 1, 0, 0, 0, 0)
+    t.encode_wave()     # ONE vectorized pass refreshes every shell
+    assert bool(t._cache_ok.all())
+    assert a.min_deadline() == 25 and b.min_deadline() == 15
+    assert t.view(1).min_deadline() is None
+    assert not a.has_requeue() and b.requeue_min_due() == 8
+    # same mid in two shells resolves per-shell
+    assert a.absorb_results([1]) == [(0, {"s": 0}, 1, 25)]
+    assert b.absorb_results([1]) == [(1, {"s": 2}, 0, 15)]
+    # a mutation dirties only the touched rows; the per-shell refresh
+    # fallback still answers correctly before the next wave pass
+    assert b.min_deadline() is None
+
+
+def test_resolve_mode_defaults_and_validation():
+    assert resolve_mode({}) == "coroutine"
+    assert resolve_mode({"fleet": 8}) == "columnar"
+    assert resolve_mode({"fleet": 8, "sessions": "coroutine"}) \
+        == "coroutine"
+    assert resolve_mode({"sessions": "columnar"}) == "columnar"
+    with pytest.raises(ValueError, match="sessions"):
+        resolve_mode({"sessions": "hybrid"})
+    assert isinstance(make_sessions({}, 4), CoroutineSessions)
+    cols = make_sessions({"sessions": "columnar"}, 4)
+    assert cols.table.F == 1 and cols.table.C == 4
