@@ -577,13 +577,9 @@ def bench_fleet_record(sizes=None) -> dict:
     chunk = int(os.environ.get("BENCH_FLEET_CHUNK", 64))  # rounds each
     pool_cap = int(os.environ.get("BENCH_FLEET_POOL", 64))
     mesh_spec = os.environ.get("BENCH_FLEET_MESH")
+    # dp>1 x sp>1 meshes are fine: make_fleet_scan_fn runs the body
+    # manual under shard_map on mixed meshes (sim.fleet_shard_map)
     mesh = parallel.mesh_from_spec(mesh_spec) if mesh_spec else None
-    if mesh is not None and mesh.shape["dp"] > 1 and \
-            mesh.shape["sp"] > 1:
-        raise ValueError(f"BENCH_FLEET_MESH={mesh_spec}: dp and sp "
-                         f"cannot both exceed 1 (see runner/"
-                         f"fleet_runner.py — GSPMD scatter-set is not "
-                         f"value-safe over the replicated axis)")
     donate = (os.environ.get("BENCH_DONATE", "1") == "1"
               and donation_enabled())
 
@@ -685,6 +681,128 @@ def bench_fleet_record(sizes=None) -> dict:
         "valid": all(r["converged"] and not r["dropped_overflow"]
                      for r in rows),
     }
+
+
+def bench_podmesh_record(fleets=None, meshes=None) -> dict:
+    """Pod-scale mixed-mesh grid (ISSUE 18, doc/perf.md "pod-scale
+    mixed mesh"): the END-TO-END `--fleet N --mesh dp,sp` production
+    path (`core.run` -> fleet runner -> shard_map scan body on mixed
+    meshes) swept over fleet {2, 8} x mesh {1,1 / 2,1 / 1,2 / 2,2}.
+    Two metrics per cell:
+
+      - agg_ops_per_vsec: completed ok client ops summed over every
+        cluster per simulated second — virtual throughput, the number
+        that scales with the mesh regardless of host speed;
+      - agg_msgs_per_sec: messages delivered across the fleet per wall
+        second (wall includes compile + per-cluster checking — an
+        end-to-end figure, not a kernel figure).
+
+    The 2,2 cells are the ones PR 2 had to reject: dp>1 x sp>1 runs
+    the scan body manual under shard_map (`sim.fleet_shard_map`), and
+    at fleet=8 the 8 % 4 == 0 fully-sharded `P(("dp","sp"))` fleet
+    axis engages (fleet=2 exercises the dp-only replicated mode).
+    Cells whose mesh needs more devices than are visible are recorded
+    under `skipped`, never dropped silently — on CPU, force a 4-device
+    mesh with XLA_FLAGS=--xla_force_host_platform_device_count=4. A
+    2-core host splits the same two cores across every mesh shape, so
+    CPU r01 wall numbers are an honesty baseline for the TPU recapture
+    (run_tpu_recapture.sh step 1l), not a scaling claim."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from maelstrom_tpu import core
+
+    if fleets is None:
+        fleets = [int(x) for x in os.environ.get(
+            "BENCH_PODMESH_FLEETS", "2,8").split(",") if x.strip()]
+    if meshes is None:
+        meshes = [m.strip() for m in os.environ.get(
+            "BENCH_PODMESH_MESHES", "1,1;2,1;1,2;2,2").split(";")
+            if m.strip()]
+    # rate 25 (not 10): the jepsen stats rule wants >= 1 ok per op type
+    # in EVERY cluster, and at 10 ops/s x 2 vsec some fleet-8 seeds
+    # never complete a cas
+    rate = float(os.environ.get("BENCH_PODMESH_RATE", 25.0))
+    tl = float(os.environ.get("BENCH_PODMESH_TIME_LIMIT", 2.0))
+    seed = int(os.environ.get("BENCH_PODMESH_SEED", 16))
+    rows, skipped = [], []
+    root = tempfile.mkdtemp(prefix="bench-podmesh-")
+    try:
+        for spec in meshes:
+            dp, sp = (int(x) for x in spec.split(","))
+            if dp * sp > jax.device_count():
+                skipped.append({"mesh": spec, "reason":
+                                f"needs {dp * sp} devices, "
+                                f"{jax.device_count()} visible"})
+                print(f"bench[podmesh mesh={spec}]: SKIPPED "
+                      f"({skipped[-1]['reason']})", file=sys.stderr)
+                continue
+            for F in fleets:
+                if F % dp:
+                    skipped.append({"mesh": spec, "fleet": F, "reason":
+                                    f"fleet {F} % dp={dp} != 0"})
+                    continue
+                t0 = time.perf_counter()
+                res = core.run(dict(
+                    store_root=root, seed=seed, workload="lin-kv",
+                    node="tpu:lin-kv", node_count=3, rate=rate,
+                    time_limit=tl, recovery_s=0.5, fleet=F,
+                    mesh=None if spec == "1,1" else spec,
+                    audit=False, journal_rows=False))
+                dt = time.perf_counter() - t0
+                ok = sum(c["stats"]["ok-count"] for c in res["clusters"])
+                msgs = sum(c["net"]["all"]["recv-count"]
+                           for c in res["clusters"])
+                rows.append({
+                    "fleet": F, "mesh": spec, "dp": dp, "sp": sp,
+                    "ok_ops": ok,
+                    "agg_ops_per_vsec": round(ok / tl, 1),
+                    "messages_delivered": msgs,
+                    "agg_msgs_per_sec": round(msgs / dt, 1),
+                    "wall_s": round(dt, 3),
+                    "valid": res["valid"] is True,
+                })
+                print(f"bench[podmesh fleet={F} mesh={spec}]: "
+                      f"{rows[-1]['agg_msgs_per_sec']:.0f} agg msgs/s, "
+                      f"{rows[-1]['agg_ops_per_vsec']:.0f} ops/vsec "
+                      f"({dt:.1f}s wall), valid={rows[-1]['valid']}",
+                      file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "cells": rows,
+        "skipped": skipped,
+        "offered_rate": rate, "time_limit_s": tl, "seed": seed,
+        "host_cpus": os.cpu_count(),
+        "devices": jax.device_count(),
+        "valid": bool(rows) and all(r["valid"] for r in rows),
+    }
+
+
+def _main_podmesh():
+    """`BENCH_MODE=podmesh`: the fleet x mesh grid as its own artifact,
+    headline `value` = aggregate msgs/sec on the biggest mixed (2,2)
+    cell (falling back to the biggest cell run when no mixed mesh fit
+    the visible devices)."""
+    rec = bench_podmesh_record()
+    cells = rec["cells"]
+    mixed = [r for r in cells if r["dp"] > 1 and r["sp"] > 1]
+    top = max(mixed or cells or [{}],
+              key=lambda r: (r.get("fleet", 0), r.get("sp", 0)))
+    record = {
+        "metric": "podmesh_agg_msgs_per_sec",
+        "value": top.get("agg_msgs_per_sec"),
+        "unit": "msgs/sec",
+        "fleet": top.get("fleet"), "top_mesh": top.get("mesh"),
+        "agg_ops_per_vsec": top.get("agg_ops_per_vsec"),
+        **rec,
+        **_fallback_meta(),
+    }
+    print(json.dumps(record))
+    if not rec["valid"]:
+        sys.exit(1)
 
 
 def bench_broadcast_batched_record() -> dict:
@@ -1724,6 +1842,9 @@ def main():
         metric = "fleet_stream_agg_client_ops_per_sec"
         unit = "client-ops/sec"
         fn = _main_fleet_stream
+    elif mode == "podmesh":
+        metric, unit = "podmesh_agg_msgs_per_sec", "msgs/sec"
+        fn = _main_podmesh
     elif mode == "broadcast_batched":
         metric = "broadcast_batched_client_ops_per_sec"
         unit = "client-ops/sec"

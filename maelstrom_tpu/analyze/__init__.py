@@ -80,6 +80,16 @@ RULES: dict[str, dict] = {
         "incident": "same hazard class as the PR 2 sort ties: GSPMD may "
                     "reorder per-shard updates",
     },
+    "replicated-scatter": {
+        "severity": "error",
+        "summary": "scatter-set reached with >= 2 mesh axes of size > 1 "
+                    "visible to GSPMD (outside any shard_map manual "
+                    "region): some operand is replicated over a >1 axis "
+                    "and per-replica contributions combine additively",
+        "incident": "PR 2/18: corrupted reply rows at --fleet 2 --mesh "
+                    "2,2 — mixed-mesh scan bodies must run manual under "
+                    "shard_map (sim.fleet_shard_map)",
+    },
     "donation-alias": {
         "severity": "error",
         "summary": "donated argument tree contains the same buffer "

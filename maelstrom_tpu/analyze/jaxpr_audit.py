@@ -166,15 +166,52 @@ def _iter_subjaxprs(params: dict):
                 yield item
 
 
-def audit_jaxpr(jaxpr, entry: str = "") -> list[Finding]:
+def _mesh_axis_sizes(shardings) -> dict:
+    """{axis name: size} of the first NamedSharding mesh found in a
+    sharding pytree (empty when unsharded) — the GSPMD axis context the
+    `replicated-scatter` rule starts a walk with."""
+    import jax
+    from jax.sharding import NamedSharding
+    for leaf in jax.tree.leaves(shardings):
+        if isinstance(leaf, NamedSharding):
+            return dict(leaf.mesh.shape)
+    return {}
+
+
+def _is_mixed_axes(axes: dict) -> bool:
+    """>= 2 mesh axes of size > 1 visible to GSPMD: the regime where a
+    scatter-SET necessarily has some operand replicated over a >1 axis
+    (PR 2's corrupted-reply-row class — per-replica scatter
+    contributions combine additively)."""
+    return sum(1 for s in axes.values() if s > 1) >= 2
+
+
+def audit_jaxpr(jaxpr, entry: str = "",
+                mesh_axes: dict | None = None) -> list[Finding]:
     """Walks one (open) jaxpr recursively and returns raw findings
-    (per-equation; `analyze.dedupe_sites` collapses duplicates)."""
+    (per-equation; `analyze.dedupe_sites` collapses duplicates).
+
+    `mesh_axes` ({axis: size}, from the entry's sharding pins) arms the
+    `replicated-scatter` rule: a plain scatter-SET reached while >= 2
+    visible mesh axes exceed size 1 is flagged — GSPMD must replicate
+    some scatter operand over one of them, which is not value-safe.
+    Entering a `shard_map` region shrinks the visible axes to the
+    region's `auto` (unmanual) set: inside a full-manual body the
+    scatter is local per shard and the rule cannot fire."""
     import numpy as np
     out: list[Finding] = []
 
-    def visit(jx):
+    def visit(jx, axes):
         for eqn in jx.eqns:
             p = eqn.primitive.name
+            if p == "shard_map":
+                m = eqn.params.get("mesh")
+                auto = eqn.params.get("auto") or frozenset()
+                sub_axes = {k: v for k, v in dict(
+                    getattr(m, "shape", {}) or {}).items() if k in auto}
+                for sub in _iter_subjaxprs(eqn.params):
+                    visit(sub, sub_axes)
+                continue
             if p == "sort":
                 if not eqn.params.get("is_stable") and \
                         int(eqn.params.get("num_keys", 1)) < 2:
@@ -210,10 +247,18 @@ def audit_jaxpr(jaxpr, entry: str = "") -> list[Finding]:
                         rule="scatter-nonunique", entry=entry,
                         where=where, key=key,
                         detail=f"mode={eqn.params.get('mode')}"))
+                if _is_mixed_axes(axes):
+                    where, key = _site(eqn)
+                    out.append(Finding(
+                        rule="replicated-scatter", entry=entry,
+                        where=where, key=key,
+                        detail=f"scatter-SET under mixed mesh axes "
+                               f"{axes} outside a shard_map manual "
+                               f"region"))
             for sub in _iter_subjaxprs(eqn.params):
-                visit(sub)
+                visit(sub, axes)
 
-    visit(jaxpr)
+    visit(jaxpr, dict(mesh_axes or {}))
     return out
 
 
@@ -339,7 +384,8 @@ def audit_step(spec: StepSpec) -> list[Finding]:
     if reshard is None:
         reshard = check_donation_reshard(spec)
     findings += reshard
-    findings += audit_jaxpr(closed.jaxpr, entry=spec.name)
+    findings += audit_jaxpr(closed.jaxpr, entry=spec.name,
+                            mesh_axes=_mesh_axis_sizes(spec.in_shardings))
     return findings
 
 
@@ -514,6 +560,8 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
                                            donate=donate, shardings=sh,
                                            sched_inject=True),
                      args=(sim, inject, at, kv, flags, flags), **common),
+            # in_shardings here only arms the replicated-scatter rule's
+            # mesh context (no donation contract on the round fn)
             StepSpec(name=f"fleet_round_fn[{tag}]",
                      fn=parallel.make_cluster_round_fn(
                          runner.program, runner.cfg,
@@ -521,7 +569,7 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
                                if mesh else None),
                          example=sim, example_inject=inject),
                      args=(sim, inject),
-                     donate_argnums=(), in_shardings=None,
+                     donate_argnums=(), in_shardings=sim_sh,
                      out_shardings=None),
         ]
     return specs
@@ -612,6 +660,17 @@ def audit_production(programs=None, mesh: str | None = "auto",
             else:
                 notes.append("fleet mesh variants skipped: < 2 visible "
                              "devices")
+            if jax.device_count() >= 4:
+                # the pod-scale MIXED mesh (dp>1 x sp>1): the shard_map
+                # manual scan body, traced so the replicated-scatter
+                # rule proves every scatter sits inside the manual
+                # region (AUDIT_FLEET=4 divides the mesh -> the
+                # fully-sharded P(("dp","sp")) fleet-axis mode)
+                fleet_jobs += [(p, "2,2") for p in DEFAULT_FLEET_PROGRAMS
+                               if p in programs]
+            else:
+                notes.append("fleet mixed-mesh variants skipped: < 4 "
+                             "visible devices")
         elif mesh:
             from .. import parallel
             dp = parallel.mesh_from_spec(mesh).shape["dp"]

@@ -192,7 +192,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "ONE compiled scan (the fleet runner): a "
                         "seed/nemesis/capacity campaign becomes one "
                         "device program, sharded ('dp','sp') under "
-                        "--mesh dp,sp with N %% dp == 0. Composes "
+                        "--mesh dp,sp with N %% dp == 0 — mixed "
+                        "meshes (dp>1 AND sp>1, e.g. --mesh 2,2) run "
+                        "the scan body manual under shard_map "
+                        "(doc/perf.md 'pod-scale mixed mesh'). "
+                        "Composes "
                         "with --continuous: N open-world clusters in "
                         "one vmapped sched-inject scan, host polls "
                         "amortized to one pass per wave (doc/perf.md "

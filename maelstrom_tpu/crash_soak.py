@@ -59,7 +59,8 @@ VOLATILE_FLEET_KEYS = VOLATILE_RESULT_KEYS + (
     "ckpt-saves", "ckpt-blocked-s", "ckpt-write-s", "static-audit",
     # host-driver poll accounting (doc/perf.md "vectorized host
     # driver"): a resumed launch only counts polls since its resume
-    "host-polls", "host-poll-s", "max-checker-lag-rounds")
+    "host-polls", "host-poll-s", "host-wall-per-wave",
+    "max-checker-lag-rounds")
 
 # A small but honest default config: raft-backed lin-kv (durable store,
 # so the kill nemesis is recoverable), the full combined fault soup, and

@@ -182,13 +182,59 @@ def make_fleet_sims(program, cfg: T.NetConfig, seeds,
     return batched.replace(key=keys)
 
 
+def mesh_is_mixed(mesh) -> bool:
+    """True for a dp>1 x sp>1 ("pod-scale mixed") mesh — the shape whose
+    fleet entry points run MANUAL over the mesh under `shard_map` (see
+    `fleet_axis_spec`): GSPMD scatter-set is not value-safe over a mesh
+    axis the operands are replicated on (per-replica contributions
+    combine additively — corrupted reply rows were observed at `--fleet
+    2 --mesh 2,2` before the shard_map rewrite), so a mixed mesh never
+    lets the compiler partition the scan body."""
+    if mesh is None:
+        return False
+    shape = getattr(mesh, "shape", None) or {}
+    return shape.get("dp", 1) > 1 and shape.get("sp", 1) > 1
+
+
+def fleet_axis_spec(mesh: Mesh, fleet: int) -> P:
+    """The MIXED-mesh partition spec for the fleet (cluster) axis: when
+    the fleet divides the whole device grid, the cluster axis shards
+    over BOTH mesh axes (`P(("dp", "sp"))` — every device owns
+    fleet/(dp*sp) whole clusters, full utilization); otherwise it shards
+    over dp only and the sp rows replicate (each sp replica computes
+    its dp shard's clusters identically — value-safe because the
+    shard_map'd body is manual over the mesh, so no partial per-replica
+    scatter contributions exist to combine)."""
+    if fleet % mesh.size == 0:
+        return P(("dp", "sp"))
+    return P("dp")
+
+
 def fleet_scan_shardings(mesh: Mesh, sim: SimState, inject) -> tuple:
-    """The `(sim, inject, scalar)` sharding triple for the FLEET entry
+    """The `(sim, inject, aux)` sharding triple for the FLEET entry
     points (`sim.make_fleet_scan_fn` and the fleet runner's batched
-    bump/restart): the cluster-batched SimState tree sharded dp over its
-    leading fleet axis and sp over the first big per-cluster axis, the
-    [F, C] inject batch likewise, per-cluster [F] vectors and scalars
-    replicated (they are tiny and about to leave for the host)."""
+    bump/restart).
+
+    Single-axis meshes (dp,1 / 1,sp — the legacy GSPMD regime): the
+    cluster-batched SimState tree sharded dp over its leading fleet
+    axis and sp over the first big per-cluster axis, the [F, C] inject
+    batch likewise, per-cluster [F] vectors and scalars replicated
+    (they are tiny and about to leave for the host).
+
+    MIXED meshes (dp>1 x sp>1): every leaf — state, inject, and the
+    per-cluster [F] vectors — carries the SAME leading-axis fleet spec
+    (`fleet_axis_spec`), nothing shards the per-cluster axes and no
+    operand is replicated over a >1 mesh axis with sharded peers. The
+    fleet scan then runs manual over the mesh under `shard_map`
+    (`sim.make_fleet_scan_fn`): inside each shard the cluster's
+    scatters into flight-pool/edge-channel/reply/journal rings are
+    plain local scatters with no GSPMD value-safety question."""
+    if mesh_is_mixed(mesh):
+        fleet = jax.tree.leaves(sim)[0].shape[0]
+        fl = NamedSharding(mesh, fleet_axis_spec(mesh, fleet))
+        return (jax.tree.map(lambda a: fl, sim),
+                jax.tree.map(lambda a: fl, inject),
+                fl)
     scalar = NamedSharding(mesh, P())
     return (sim_shardings(mesh, sim, batched=True),
             sim_shardings(mesh, inject, batched=True),
@@ -205,6 +251,19 @@ def make_cluster_round_fn(program, cfg: T.NetConfig, mesh: Mesh | None = None,
     if mesh is None:
         return jax.jit(f)
     assert example is not None and example_inject is not None
+    if mesh_is_mixed(mesh):
+        # mixed mesh: manual body under shard_map, cluster axis only
+        # (every vmapped output leaf leads with it) — same regime as
+        # sim.fleet_shard_map, same value-safety argument
+        from jax.experimental.shard_map import shard_map
+        n = jax.tree.leaves(example)[0].shape[0]
+        spec = fleet_axis_spec(mesh, n)
+        fl = NamedSharding(mesh, spec)
+        f = shard_map(f, mesh, in_specs=spec, out_specs=spec,
+                      check_rep=False)
+        in_sh = (jax.tree.map(lambda a: fl, example),
+                 jax.tree.map(lambda a: fl, example_inject))
+        return jax.jit(f, in_shardings=in_sh)
     in_sh = (sim_shardings(mesh, example), sim_shardings(mesh,
                                                          example_inject))
     return jax.jit(f, in_shardings=in_sh)

@@ -130,6 +130,7 @@ class FleetRunner:
         F = self.spec.fleet
         self.mesh = None
         self._shardings = None
+        self._mixed_mesh = False
         mesh_spec = test.get("mesh")
         if mesh_spec:
             from .. import parallel
@@ -140,20 +141,10 @@ class FleetRunner:
                     f"--fleet {F} with --mesh {mesh_spec}: the fleet "
                     f"axis shards over dp, so fleet must be a multiple "
                     f"of dp={dp}")
-            if dp > 1 and self.mesh.shape["sp"] > 1:
-                # the PR 2 hazard, one axis over: GSPMD scatter-set is
-                # not value-safe over a mesh axis the operands are
-                # replicated on (per-replica contributions combine
-                # additively), and with BOTH axes > 1 every in-scan
-                # scatter is replicated over one of them (observed:
-                # corrupted reply rows under --fleet 2 --mesh 2,2).
-                # Shard the fleet over ALL devices (dp,1) or the
-                # per-cluster axes over all devices (1,sp) instead.
-                raise ValueError(
-                    f"--fleet with --mesh {mesh_spec}: dp and sp cannot "
-                    f"both exceed 1 (GSPMD scatter-set is not value-safe "
-                    f"over the replicated axis); use --mesh "
-                    f"{self.mesh.size},1 or --mesh 1,{self.mesh.size}")
+            # dp>1 x sp>1 (mixed) meshes run the scan body manual under
+            # shard_map (sim.fleet_shard_map) — the PR 2 GSPMD
+            # scatter-over-replicated-axis hazard cannot occur there, so
+            # no mixed-mesh rejection remains.
         # one full runner shell per cluster, each built from the exact
         # option set its standalone run would use
         self.shells: list[_FleetClusterShell] = []
@@ -212,9 +203,18 @@ class FleetRunner:
             self._shardings = parallel.fleet_scan_shardings(
                 self.mesh, self.sim, inject_ex)
             self.sim = jax.device_put(self.sim, self._shardings[0])
-            log.info("fleet mesh mode: %d clusters over dp=%d sp=%d "
-                     "(%d devices)", F, self.mesh.shape["dp"],
-                     self.mesh.shape["sp"], self.mesh.size)
+            self._mixed_mesh = parallel.mesh_is_mixed(self.mesh)
+            if self._mixed_mesh:
+                log.info(
+                    "fleet MIXED mesh mode: %d clusters over dp=%d "
+                    "sp=%d (%d devices), shard_map manual body, fleet "
+                    "axis %s", F, self.mesh.shape["dp"],
+                    self.mesh.shape["sp"], self.mesh.size,
+                    parallel.fleet_axis_spec(self.mesh, F))
+            else:
+                log.info("fleet mesh mode: %d clusters over dp=%d sp=%d "
+                         "(%d devices)", F, self.mesh.shape["dp"],
+                         self.mesh.shape["sp"], self.mesh.size)
 
         from ..checkers.netstats import TransferStats
         self.transfer = TransferStats()
@@ -267,9 +267,12 @@ class FleetRunner:
         self._pack_c = None          # continuous drain (replies + mids)
         self._empty_inject = T.Msgs.empty(max(self.concurrency, 1))
         donate = (0,) if donation_enabled() else ()
+        from ..sim import fleet_shard_map
         self._bump_fn = jax.jit(
-            lambda sim, ks: sim.replace(net=sim.net.replace(
-                round=sim.net.round + ks)),
+            fleet_shard_map(
+                lambda sim, ks: sim.replace(net=sim.net.replace(
+                    round=sim.net.round + ks)),
+                self._shardings),
             donate_argnums=donate, **self._pins(n_args=2))
         # fleet checkpointing (per-cluster snapshots coalesced per wave)
         ck = test.get("checkpoint_every")
@@ -361,8 +364,9 @@ class FleetRunner:
                 net = sim.net.replace(down=sim.net.down & ~m)
                 return sim.replace(nodes=nodes, net=net,
                                    durable=prog.durable_view(nodes))
+            from ..sim import fleet_shard_map
             self._restart_fn = jax.jit(
-                jax.vmap(_one),
+                fleet_shard_map(jax.vmap(_one), self._shardings),
                 donate_argnums=(0,) if donation_enabled() else (),
                 **self._pins(n_args=2))
         m = np.zeros((self.spec.fleet, self.cfg.n_nodes), bool)
@@ -490,7 +494,8 @@ class FleetRunner:
         ring = self.sim.telemetry if self.telemetry_rings else ()
         tree = (rl, k, self.sim.net.next_mid, ring)
         if self._pack is None:
-            self._pack = TpuRunner._make_packer(tree)
+            self._pack = TpuRunner._make_packer(
+                tree, fleet_dim=self._mixed_mesh)
         pack, unpack = self._pack
         # ONE fetched array for the whole fleet per wave
         t_f0 = time.perf_counter()
@@ -565,7 +570,8 @@ class FleetRunner:
         ring = self.sim.telemetry if self.telemetry_rings else ()
         tree = (rl, im, k, self.sim.net.next_mid, ring)
         if self._pack_c is None:
-            self._pack_c = TpuRunner._make_packer(tree)
+            self._pack_c = TpuRunner._make_packer(
+                tree, fleet_dim=self._mixed_mesh)
         pack, unpack = self._pack_c
         # ONE fetched array for the whole fleet per wave: replies,
         # confirmed inj_mids, per-lane k, and the mid counters together

@@ -48,9 +48,38 @@ fleet byte-identity pins.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 _I64MAX = np.iinfo(np.int64).max
+_I32MAX = np.iinfo(np.int32).max
+
+_WAVE_REDUCE = None
+
+
+def _wave_reduce_fn():
+    """The jitted device kernel behind `ColumnarSessions.encode_wave`'s
+    device mode (ISSUE 18, PR 17 follow-on): the same two masked min
+    reductions as the numpy pass, compiled once and dispatched
+    asynchronously — the fleet driver calls encode_wave inside its
+    poll-gather span, so on an accelerator the reduction overlaps the
+    rest of the poll instead of serializing an O(F*S) host loop.
+    int32 in/out: deadlines and due rounds are virtual-round values
+    (int32-safe by construction); the int64 table sentinel is restored
+    on the way out."""
+    global _WAVE_REDUCE
+    if _WAVE_REDUCE is None:
+        import jax
+        import jax.numpy as jnp
+
+        def reduce_(p_mid, p_dl, r_valid, r_due):
+            dl = jnp.where(p_mid >= 0, p_dl, _I32MAX).min(axis=1)
+            due = jnp.where(r_valid, r_due, _I32MAX).min(axis=1)
+            return dl, due
+
+        _WAVE_REDUCE = jax.jit(reduce_)
+    return _WAVE_REDUCE
 
 
 def trunc_exp_bound(base, cap, attempt: int):
@@ -196,12 +225,23 @@ class ColumnarSessions:
     ``seq`` for requeues — see the module docstring's byte-identity
     contract."""
 
-    def __init__(self, fleet: int, concurrency: int, cap: int = 0):
+    def __init__(self, fleet: int, concurrency: int, cap: int = 0,
+                 device_reduce: bool | None = None):
         F = max(int(fleet), 1)
         C = max(int(concurrency), 1)
         S = int(cap) or max(2 * C, 8)
         R = max(C, 8)
         self.F, self.C = F, C
+        # device mode (ISSUE 18): run the wave reduction as a jitted
+        # kernel instead of host numpy. None = auto (on once the fleet
+        # is big enough that the [F, S] host pass shows up in the poll
+        # span); MAELSTROM_SESSIONS_DEVICE=0|1 forces either path.
+        # Both paths produce identical aggregates — pinned in
+        # tests/test_sessions.py.
+        if device_reduce is None:
+            env = os.environ.get("MAELSTROM_SESSIONS_DEVICE", "")
+            device_reduce = env == "1" if env in ("0", "1") else F >= 64
+        self.device_reduce = bool(device_reduce)
         # wave-pass columns [F, S]: mid < 0 marks a free slot; ONLY
         # what encode_wave reduces over lives in numpy
         self.p_mid = np.full((F, S), -1, np.int64)
@@ -245,6 +285,23 @@ class ColumnarSessions:
         the flight recorder); shells the wave leaves untouched then
         answer their scan bounds from the cache instead of scanning
         their pending sets."""
+        if self.device_reduce:
+            # the jitted segment reduction (ISSUE 18): int32 views in,
+            # async dispatch, int64 sentinel restored on the way out so
+            # the cached aggregates are bit-identical to the numpy
+            # path's
+            dl, due = _wave_reduce_fn()(
+                self.p_mid.astype(np.int32),
+                np.minimum(self.p_dl, _I32MAX).astype(np.int32),
+                self.r_valid,
+                np.minimum(self.r_due, _I32MAX).astype(np.int32))
+            dl = np.asarray(dl).astype(np.int64)
+            due = np.asarray(due).astype(np.int64)
+            dl[dl == _I32MAX] = _I64MAX
+            due[due == _I32MAX] = _I64MAX
+            self._min_dl, self._min_due = dl, due
+            self._cache_ok[:] = True
+            return
         pvalid = self.p_mid >= 0
         self._min_dl = np.where(pvalid, self.p_dl, _I64MAX).min(axis=1)
         self._min_due = np.where(self.r_valid, self.r_due,

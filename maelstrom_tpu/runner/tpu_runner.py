@@ -812,14 +812,38 @@ class TpuRunner:
             self._fed_upto = hi
 
     @staticmethod
-    def _make_packer(example):
+    def _make_packer(example, fleet_dim: bool = False):
         """(pack_fn, unpack) shipping a bool/int32 pytree as ONE int32
         array: remote backends pay a round trip per fetched array, and
-        journal io trees have ~50 leaves."""
-        pack = jax.jit(lambda t: jnp.concatenate(
-            [x.astype(jnp.int32).reshape(-1) for x in jax.tree.leaves(t)]))
+        journal io trees have ~50 leaves.
+
+        `fleet_dim=True` (the fleet runner's MIXED dp>1 x sp>1 meshes):
+        every leaf leads with the fleet axis, and the pack keeps it —
+        leaves reshape to [F, -1] and concatenate along axis 1 instead
+        of flattening. Flatten-concat is NOT value-safe there: the 1-D
+        reshape reshards the fleet-sharded dim, and GSPMD assembles that
+        reshard as a masked SUM over the whole mesh, double-counting the
+        sp replicas of `fleet_axis_spec`'s A-mode (observed: -1 packed
+        as -2, k=8 as 16). The [F, -1] form keeps the sharded dim intact
+        so no cross-replica assembly happens inside the jit."""
         leaves, treedef = jax.tree.flatten(example)
         shapes = [(x.shape, np.dtype(x.dtype)) for x in leaves]
+        if fleet_dim:
+            pack = jax.jit(lambda t: jnp.concatenate(
+                [x.astype(jnp.int32).reshape(x.shape[0], -1)
+                 for x in jax.tree.leaves(t)], axis=1))
+
+            def unpack(flat: np.ndarray):
+                out, off = [], 0
+                for shape, dt in shapes:
+                    n_el = int(np.prod(shape[1:]))
+                    out.append(flat[:, off:off + n_el].reshape(shape)
+                               .astype(dt))
+                    off += n_el
+                return jax.tree.unflatten(treedef, out)
+            return pack, unpack
+        pack = jax.jit(lambda t: jnp.concatenate(
+            [x.astype(jnp.int32).reshape(-1) for x in jax.tree.leaves(t)]))
 
         def unpack(flat: np.ndarray):
             out, off = [], 0

@@ -709,6 +709,39 @@ def _jit_kwargs(donate: bool, shardings, n_args: int,
     return kw
 
 
+def fleet_shard_map(fn, shardings):
+    """Wrap a FLEET entry point (every arg and output leaf leads with the
+    fleet axis) in `shard_map` when `shardings` describes a MIXED
+    (dp>1 x sp>1) mesh; otherwise return `fn` unchanged.
+
+    On a mixed mesh the body runs MANUAL over the whole device grid: the
+    fleet axis is sharded per `parallel.fleet_axis_spec` (over both axes
+    when divisible, dp-only with sp replicas otherwise) and each shard
+    executes its clusters' scatters as plain local scatters — the GSPMD
+    scatter-over-replicated-axis value hazard (corrupted reply rows at
+    `--fleet 2 --mesh 2,2`, see `parallel.mesh_is_mixed`) structurally
+    cannot occur. Because every boundary leaf leads with the fleet axis,
+    the aux sharding's single PartitionSpec serves as a pytree-prefix
+    in/out spec for the entire signature, and the jit-level pins built by
+    `_jit_kwargs` from the same triple keep donation no-reshard intact
+    (in pin == out pin for the donated carry).
+
+    `check_rep=False` is required: the manual body contains while_loops
+    and scatters whose replication factors jax cannot infer; correctness
+    rests on the specs (sharded or all-replicas-identical), pinned by the
+    mixed-mesh bit-identity tests."""
+    if shardings is None:
+        return fn
+    aux = shardings[2]
+    mesh = getattr(aux, "mesh", None)
+    from .parallel import mesh_is_mixed  # local: parallel imports sim
+    if not mesh_is_mixed(mesh):
+        return fn
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh, in_specs=aux.spec, out_specs=aux.spec,
+                     check_rep=False)
+
+
 def make_round_fn(program, cfg: NetConfig, donate: bool = False,
                   shardings=None):
     """Jitted interactive round: one XLA dispatch per simulated round."""
@@ -953,8 +986,13 @@ def make_fleet_scan_fn(program, cfg: NetConfig,
     drain per wave for the whole fleet.
 
     `shardings` pins the cluster-batched placement for `--mesh dp,sp`
-    execution: the fleet axis shards over dp, per-cluster node/pool axes
-    over sp (`parallel.fleet_scan_shardings`)."""
+    execution (`parallel.fleet_scan_shardings`): on a single-axis mesh
+    the fleet axis shards over dp and per-cluster node/pool axes over sp
+    (GSPMD partitions the body); on a MIXED dp>1 x sp>1 mesh the whole
+    body instead runs manual under `shard_map` with every leaf sharded
+    on its fleet axis only (`fleet_shard_map`) — per-cluster scatters
+    become plain local scatters, which is what makes the mixed shape
+    value-safe at all."""
     scan_fn, n_outs = _build_scan_fn(program, cfg, journal_cap, reply_cap,
                                      sched_inject)
     n_in = 5 if sched_inject else 4
@@ -1000,7 +1038,7 @@ def make_fleet_scan_fn(program, cfg: NetConfig,
             return _mask_held(out, sim, active)
         n_args = 5
 
-    return jax.jit(fleet_fn,
+    return jax.jit(fleet_shard_map(fleet_fn, shardings),
                    **_jit_kwargs(donate, shardings, n_args, n_outs))
 
 

@@ -140,6 +140,17 @@ step artifacts/bench-ordering-r15.json 2400 \
 step artifacts/bench-byzantine-r16.json 2400 \
     env BENCH_MODE=byzantine python bench.py
 
+# 1l. pod-scale mixed mesh (BENCH_MODE=podmesh, ISSUE 18): the
+#     end-to-end `--fleet N --mesh dp,sp` grid — fleet {2,8} x mesh
+#     {1,1 / 2,1 / 1,2 / 2,2}, the 2,2 cells running the shard_map
+#     manual scan body PR 2 had to reject — headline `value` =
+#     aggregate msgs/sec on the biggest mixed cell, agg client
+#     ops/vsec alongside (doc/perf.md "pod-scale mixed mesh"; CPU r01
+#     in artifacts/bench-podmesh-cpu-r01.json, captured under a forced
+#     4-device host mesh). Gate: every cell's run grades valid
+step artifacts/bench-podmesh-r18.json 2400 \
+    env BENCH_MODE=podmesh python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

@@ -365,4 +365,61 @@ PY
     echo "== byzantine smoke valid =="
 fi
 
+# Pod-scale mixed-mesh smoke (ISSUE 18, doc/perf.md "pod-scale mixed
+# mesh"): an AUDITED `--fleet 2 --mesh 2,2` run — the dp>1 x sp>1
+# shape PR 2 had to reject, now running the scan body manual under
+# shard_map — on a FORCED 4-device CPU mesh, under the combined
+# kill/pause/partition/duplicate soup. The fleet self-report must
+# trace the shard_map-wrapped fns at zero new findings
+# (replicated-scatter armed by the 2x2 pins), and every cluster's
+# history and workload verdict must be bit-equal to its own standalone
+# run of the same seed. MIXEDMESH_SMOKE=0 skips.
+if [ "${MIXEDMESH_SMOKE:-1}" = "1" ]; then
+    echo "== pod-scale mixed-mesh smoke =="
+    SMOKE_STORE="$(mktemp -d)"
+    MIXEDMESH_XLA="--xla_force_host_platform_device_count=4"
+    XLA_FLAGS="$MIXEDMESH_XLA" python -m maelstrom_tpu test \
+        -w broadcast --node tpu:broadcast --topology grid \
+        --node-count 5 --rate 10 --time-limit 2 --seed 7 \
+        --fleet 2 --mesh 2,2 \
+        --nemesis kill,pause,partition,duplicate \
+        --nemesis-interval 0.4 --store "$SMOKE_STORE/fleet" > /dev/null
+    for seed in 7 8; do
+        XLA_FLAGS="$MIXEDMESH_XLA" python -m maelstrom_tpu test \
+            -w broadcast --node tpu:broadcast --topology grid \
+            --node-count 5 --rate 10 --time-limit 2 --seed "$seed" \
+            --nemesis kill,pause,partition,duplicate \
+            --nemesis-interval 0.4 --no-audit \
+            --store "$SMOKE_STORE/solo$seed" > /dev/null
+    done
+    python - "$SMOKE_STORE" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+with open(os.path.join(root, "fleet", "latest", "results.json")) as f:
+    res = json.load(f)
+assert res["fleet"] == 2 and res["mesh"] == "2,2", res
+assert res["valid"] is True, res.get("valid")
+assert res["static-audit"]["ok"] is True, res["static-audit"]
+def wl(path):
+    with open(os.path.join(path, "results.json")) as f:
+        r = json.load(f)["workload"]
+    return {k: v for k, v in r.items()
+            if k not in ("windows", "checker-lag", "check-wall-s")}
+for i, seed in enumerate((7, 8)):
+    cdir = os.path.join(root, "fleet", "latest", f"cluster-{i:04d}")
+    sdir = os.path.join(root, f"solo{seed}", "latest")
+    with open(os.path.join(cdir, "history.jsonl"), "rb") as f:
+        ch = f.read()
+    with open(os.path.join(sdir, "history.jsonl"), "rb") as f:
+        sh = f.read()
+    assert ch == sh, f"cluster {i} history diverges from seed {seed}"
+    assert wl(cdir) == wl(sdir), \
+        f"cluster {i} verdict diverges from seed {seed}"
+print("mixed-mesh smoke: --fleet 2 --mesh 2,2 audited, per-cluster "
+      "histories + verdicts bit-equal to standalone")
+PY
+    rm -rf "$SMOKE_STORE"
+    echo "== mixed-mesh smoke valid =="
+fi
+
 echo "== static gate clean =="

@@ -100,6 +100,7 @@ def test_equivocation_convicted_on_device():
     assert "byzantine equivocation culprit=n0" in vals
 
 
+@pytest.mark.slow
 def test_stale_ballot_convicted_on_device():
     res = run(dict(nemesis_targets="byzantine=sequencers",
                    byz_attacks="stale-ballot"))

@@ -42,9 +42,11 @@ def smoke_baseline(tmp_path_factory):
                                os.path.join(root, "baseline.log"))
 
 
+@pytest.mark.slow
 def test_single_sigkill_resume_bit_identical(smoke_baseline, tmp_path):
-    """Tier-1 smoke: one SIGKILL after the first checkpoint, one
-    resume, byte-identical history and verdicts."""
+    """One SIGKILL after the first checkpoint, one resume,
+    byte-identical history and verdicts (subprocess-signal path; the
+    in-process preempt/resume pins stay tier-1)."""
     res = crash_soak.run_with_kills(str(tmp_path), SMOKE_OPTS, kills=1,
                                     rng=random.Random(5),
                                     kill_jitter_s=0.2)

@@ -300,6 +300,7 @@ def test_elle_device_edges_match_both_oracles(seed, corrupt,
         == analyze(h, edges_impl=_edges_python)
 
 
+@pytest.mark.slow
 @settings(max_examples=25, deadline=None)
 @given(evs=events, ring=st.integers(2, 6),
        lat_of_round=st.lists(st.integers(0, 5), min_size=16, max_size=16))

@@ -119,6 +119,20 @@ def test_fleet_continuous_mesh_dp2_bit_identical():
         assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
 
 
+@pytest.mark.multichip
+def test_fleet_continuous_mesh_dp2_sp2_bit_identical():
+    """`--fleet 2 --continuous --mesh 2,2`: the MIXED mesh with the
+    sched-inject fleet scan — per-lane round-offset injection and the
+    inj_mids drain run inside the shard_map manual body, and every
+    cluster equals its standalone continuous run bit for bit."""
+    solos = [_solo({**LIN_KV, "seed": 11 + i})[0] for i in range(2)]
+    runner, hs = _fleet(LIN_KV, fleet=2, mesh="2,2")
+    assert runner.mesh is not None
+    assert runner.mesh.shape["dp"] == 2 and runner.mesh.shape["sp"] == 2
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
 def test_fleet_continuous_capacity_sweep():
     """`--fleet-sweep capacity` composes with --continuous: cluster i
     streams at rate * (i + 1) and equals the standalone continuous run
@@ -152,6 +166,7 @@ def test_fleet_sessions_coroutine_vs_columnar_soup_bit_identical():
             f"cluster {i}: session backends diverged"
 
 
+@pytest.mark.slow
 def test_fleet_sessions_cross_backend_resume_bit_identical(tmp_path):
     """A coalesced fleet checkpoint written under COLUMNAR sessions
     resumes under COROUTINE sessions (and lands the uninterrupted

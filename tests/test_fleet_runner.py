@@ -228,15 +228,37 @@ def test_fleet_mesh_dp2_bit_identical():
 
 
 @pytest.mark.multichip
-def test_fleet_mesh_dp2_sp2_rejected():
-    """Mixed dp x sp sharding is rejected up front: with both axes > 1
-    every in-scan scatter-set is replicated over one of them, which
-    GSPMD does not partition value-safely (the PR 2 hazard class —
-    observed as corrupted reply rows under --fleet 2 --mesh 2,2).
-    Pure shapes (dp,1 / 1,sp) are the supported layouts."""
-    test = core.build_test({**BROADCAST, "fleet": 2, "mesh": "2,2"})
-    with pytest.raises(ValueError, match="dp and sp cannot both"):
-        FleetRunner(test)
+def test_fleet_mesh_dp2_sp2_bit_identical():
+    """`--fleet 2 --mesh 2,2`: the POD-SCALE MIXED mesh — the shape PR 2
+    and PR 18's predecessors had to reject (GSPMD scatter-set over a
+    replicated axis combined per-replica contributions additively;
+    observed as corrupted reply rows under exactly this configuration).
+    The scan body now runs MANUAL over the mesh under shard_map
+    (sim.fleet_shard_map): per-cluster scatters are plain local
+    scatters, and every cluster equals its standalone run bit for
+    bit."""
+    solos = [_solo({**BROADCAST, "seed": 7 + i}) for i in range(2)]
+    runner, hs = _fleet(BROADCAST, fleet=2, mesh="2,2")
+    assert runner.mesh is not None
+    assert runner.mesh.shape["dp"] == 2 and runner.mesh.shape["sp"] == 2
+    for i in range(2):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_fleet_mesh_dp2_sp2_soup_bit_identical():
+    """`--fleet 4 --mesh 2,2` under the combined fault soup
+    (kill/pause/partition/duplicate): mask surgery, crash-restarts, and
+    duplicate deliveries all land inside the shard_map manual body, and
+    with fleet % mesh.size == 0 the cluster axis shards over BOTH mesh
+    axes (one cluster per device). Every cluster still replays its
+    standalone run exactly."""
+    opts = {**BROADCAST, **SOUP, "time_limit": 1.2}
+    solos = [_solo({**opts, "seed": 7 + i}) for i in range(4)]
+    _, hs = _fleet(opts, fleet=4, mesh="2,2")
+    for i in range(4):
+        assert _ops(hs[i]) == _ops(solos[i]), f"cluster {i} diverged"
 
 
 @pytest.mark.slow
@@ -317,6 +339,76 @@ def test_fleet_checkpoint_rejects_other_fleet(tmp_path):
     bad = core.build_test({**opts, "fleet": 4})
     with pytest.raises(ValueError, match="fleet"):
         cp.check_fingerprint(ck, bad)
+
+
+def test_fleet_checkpoint_mesh_fingerprint():
+    """A checkpoint's mesh shape is part of the campaign: a 2,2
+    fingerprint refuses a 2,1 resume (no device work — the full
+    run/resume pin is the slow test below)."""
+    opts = {**LIN_KV, "time_limit": 2.0}
+    ck = {"fingerprint": cp.fingerprint(
+        core.build_test({**opts, "fleet": 2, "mesh": "2,2"}))}
+    with pytest.raises(ValueError, match="mesh"):
+        cp.check_fingerprint(
+            ck, core.build_test({**opts, "fleet": 2, "mesh": "2,1"}))
+    cp.check_fingerprint(
+        ck, core.build_test({**opts, "fleet": 2, "mesh": "2,2"}))
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_fleet_checkpoint_mesh_shapes(tmp_path):
+    """ISSUE 18: checkpoint/resume across mesh shapes. A `--fleet 2
+    --mesh 2,2` (mixed-mesh) checkpoint resumes byte-identical on the
+    SAME mesh — the sharded carries snapshot and restore through the
+    same host-replayable path as unsharded fleets — and a checkpoint
+    taken on a DIFFERENT mesh shape is rejected by fingerprint (`mesh`
+    is a FINGERPRINT_KEYS member; mirrors the PR 4 multichip pins)."""
+    opts = {**LIN_KV, "time_limit": 2.0}
+
+    a_dir = tmp_path / "a"
+    a_dir.mkdir()
+    t = core.build_test({**opts, "fleet": 2, "mesh": "2,2"})
+    t["store_dir"] = str(a_dir)
+    hs_a = FleetRunner(t).run()
+
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    t2 = core.build_test({**opts, "fleet": 2, "mesh": "2,2",
+                          "checkpoint_every": 0.25})
+    t2["store_dir"] = str(b_dir)
+    fr2 = FleetRunner(t2)
+
+    def preempt_after_first_checkpoint():
+        deadline = time.time() + 300
+        while time.time() < deadline and not fr2._preempt.is_set():
+            if fr2.transfer.ckpt_saves >= 1:
+                fr2._preempt.set()
+                return
+            time.sleep(0.01)
+    threading.Thread(target=preempt_after_first_checkpoint,
+                     daemon=True).start()
+    with pytest.raises(cp.Preempted):
+        fr2.run()
+
+    ck = cp.load(str(b_dir))
+    assert ck["fingerprint"]["mesh"] == "2,2"
+    # a different mesh shape cannot adopt the checkpoint: the placement
+    # (and with it the compiled layout) is part of the campaign
+    bad = core.build_test({**opts, "fleet": 2, "mesh": "2,1",
+                           "checkpoint_every": 0.25})
+    with pytest.raises(ValueError, match="mesh"):
+        cp.check_fingerprint(ck, bad)
+    # the same mesh resumes every cluster bit-identically
+    t3 = core.build_test({**opts, "fleet": 2, "mesh": "2,2",
+                          "checkpoint_every": 0.25})
+    t3["store_dir"] = str(b_dir)
+    fr3 = FleetRunner(t3)
+    cp.check_fingerprint(ck, t3)
+    hs_c = fr3.run(resume=ck)
+    for i in range(2):
+        assert _ops(hs_c[i]) == _ops(hs_a[i]), \
+            f"cluster {i} diverged after mixed-mesh resume"
 
 
 @pytest.mark.slow

@@ -308,6 +308,7 @@ def test_fault_package_smoke_echo(fault):
     assert res["valid"] is True, res["net"]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("fault", ["partition", "kill", "pause",
                                    "duplicate", "weather"])
 def test_fault_package_smoke_broadcast(fault):

@@ -58,6 +58,7 @@ def test_solo_raft_bit_identical_plain():
     _pin_identity({"workload": "lin-kv", "node": "tpu:lin-kv"}, "raft")
 
 
+@pytest.mark.slow
 def test_solo_broadcast_bit_identical_combined_nemesis():
     """broadcast under kill,pause,partition,duplicate: durable views,
     kill/restart, freeze masks, and duplication all flow through the

@@ -223,3 +223,69 @@ def test_resolve_mode_defaults_and_validation():
     assert isinstance(make_sessions({}, 4), CoroutineSessions)
     cols = make_sessions({"sessions": "columnar"}, 4)
     assert cols.table.F == 1 and cols.table.C == 4
+
+
+# ---------------------------------------------------------------------------
+# device-resident wave reduction (ISSUE 18, PR 17 follow-on)
+# ---------------------------------------------------------------------------
+
+def _fill_table(t):
+    a, b, c = t.view(0), t.view(1), t.view(2)
+    a.register(1, 0, {"s": 0}, 1, 25)
+    a.register(2, 1, {"s": 0}, 2, 7)
+    b.register(1, 1, {"s": 1}, 0, 15)
+    b.requeue(8, 2, {"r": 1}, 1, 0, 0, 0, 0)
+    b.requeue(3, 0, {"r": 2}, 0, 0, 0, 0, 0)
+    c.requeue(40, 1, {"r": 3}, 2, 0, 0, 0, 0)
+    return a, b, c
+
+
+def test_encode_wave_device_parity():
+    """The jitted device reduction and the numpy pass land
+    bit-identical per-shell aggregates — including the int64
+    empty-shell sentinel — so flipping `device_reduce` can never move
+    a scan bound."""
+    import numpy as np
+
+    host = ColumnarSessions(3, 4, device_reduce=False)
+    dev = ColumnarSessions(3, 4, device_reduce=True)
+    _fill_table(host)
+    _fill_table(dev)
+    host.encode_wave()
+    dev.encode_wave()
+    assert host._min_dl.dtype == dev._min_dl.dtype == np.int64
+    assert np.array_equal(host._min_dl, dev._min_dl)
+    assert np.array_equal(host._min_due, dev._min_due)
+    assert bool(dev._cache_ok.all())
+    # and the view-level answers agree op-for-op
+    for t in (host, dev):
+        assert t.view(0).min_deadline() == 7
+        assert t.view(1).min_deadline() == 15
+        assert t.view(2).min_deadline() is None
+        assert t.view(1).requeue_min_due() == 3
+        assert t.view(2).requeue_min_due() == 40
+    # mutations after the pass keep the caches in lockstep: absorb the
+    # current min (raising bound -> dirty), then re-encode
+    for t in (host, dev):
+        assert t.view(0).absorb_results([2]) == [(1, {"s": 0}, 2, 7)]
+        t.encode_wave()
+    assert np.array_equal(host._min_dl, dev._min_dl)
+    assert host.view(0).min_deadline() == dev.view(0).min_deadline() == 25
+
+
+def test_device_reduce_resolution():
+    """None = auto (on at F >= 64); MAELSTROM_SESSIONS_DEVICE forces
+    either path; an explicit argument always wins."""
+    import os
+    from unittest import mock
+
+    assert ColumnarSessions(2, 4).device_reduce is False
+    assert ColumnarSessions(64, 4).device_reduce is True
+    assert ColumnarSessions(64, 4, device_reduce=False).device_reduce \
+        is False
+    with mock.patch.dict(os.environ, {"MAELSTROM_SESSIONS_DEVICE": "1"}):
+        assert ColumnarSessions(2, 4).device_reduce is True
+    with mock.patch.dict(os.environ, {"MAELSTROM_SESSIONS_DEVICE": "0"}):
+        assert ColumnarSessions(64, 4).device_reduce is False
+        assert ColumnarSessions(64, 4, device_reduce=True).device_reduce \
+            is True

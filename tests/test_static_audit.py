@@ -98,6 +98,43 @@ def test_fixture_overlapping_scatter_fires_once():
 
 
 @pytest.mark.multichip
+def test_fixture_replicated_scatter_fires_once():
+    """ISSUE 18: a scatter-SET traced while >= 2 mesh axes of size > 1
+    are visible to GSPMD (the dp>1 x sp>1 regime that corrupted reply
+    rows pre-PR-18) fires replicated-scatter exactly once; the same
+    body run manual under shard_map is clean — per-shard scatters are
+    local and no mesh axis is visible inside the region."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maelstrom_tpu import parallel
+    mesh = parallel.mesh_from_spec("2,2")
+    sh = NamedSharding(mesh, P("dp"))
+
+    def body(x):
+        row = jnp.ones((1, x.shape[1]), jnp.int32)
+        return x.at[jnp.array([1])].set(row, unique_indices=True)
+
+    x = jax.device_put(jnp.zeros((4, 8), jnp.int32), sh)
+    spec = StepSpec(name="fx", fn=body, args=(x,), in_shardings=sh)
+    assert rules_of(audit_step(spec)) == ["replicated-scatter"]
+
+    # the PR 18 shape: the same scatter inside a full-manual shard_map
+    # region (sim.fleet_shard_map's construction) — rule is quiet
+    manual = shard_map(body, mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       check_rep=False)
+    assert rules_of(audit_step(
+        StepSpec(name="fx", fn=manual, args=(x,), in_shardings=sh))) == []
+    # and a single->1 mesh (dp=2, sp=1) is NOT mixed: plain dp-sharded
+    # scatters stay legal without shard_map
+    mesh21 = parallel.mesh_from_spec("2,1")
+    sh21 = NamedSharding(mesh21, P("dp"))
+    x21 = jax.device_put(jnp.zeros((4, 8), jnp.int32), sh21)
+    assert rules_of(audit_step(
+        StepSpec(name="fx", fn=body, args=(x21,), in_shardings=sh21))) == []
+
+
+@pytest.mark.multichip
 def test_fixture_donation_reshard_fires_once():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -477,6 +514,30 @@ def test_gate_production_mesh_round_and_scan_fns():
     new, _suppressed = apply_baseline(dedupe_sites(findings),
                                       Baseline.load())
     assert new == [], [f.as_dict() for f in new]
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+def test_gate_production_mixed_mesh_fleet_fns():
+    """ISSUE 18: the pod-scale mixed mesh (`--fleet 4 --mesh 2,2`). The
+    `mesh="auto"` gate now traces the fleet scan/cscan/round variants
+    whose bodies run manual under shard_map, with the
+    replicated-scatter rule armed by the 2x2 sharding pins — zero new
+    findings proves every scatter sits inside the manual region, and
+    no mixed-mesh finding needed baselining at all."""
+    findings, entries, _notes = jaxpr_audit.audit_production(
+        programs=["lin-kv"], mesh="auto")
+    assert any("@mesh=2,2" in e and e.startswith("fleet_scan_fn[")
+               for e in entries), entries
+    assert any("@mesh=2,2" in e and e.startswith("fleet_cscan_fn[")
+               for e in entries), entries
+    assert any("@mesh=2,2" in e and e.startswith("fleet_round_fn[")
+               for e in entries), entries
+    new, _suppressed = apply_baseline(dedupe_sites(findings),
+                                      Baseline.load())
+    assert new == [], [f.as_dict() for f in new]
+    rep = [f for f in findings if f.rule == "replicated-scatter"]
+    assert rep == [], [f.as_dict() for f in rep]
 
 
 def test_baseline_file_is_well_formed():
