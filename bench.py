@@ -126,6 +126,86 @@ def _fallback_meta() -> dict:
     return meta
 
 
+def predicted_block(program, cfg, *, fleet=None,
+                    measured_rounds_per_sec=None,
+                    msgs_per_round=None,
+                    rounds_per_dispatch=1) -> dict | None:
+    """Static cost-model prediction for a bench's own program/config
+    (doc/analyze.md "predicted vs measured"): traces the per-round step
+    abstractly — no arrays materialize, 100k-node shapes trace in
+    milliseconds — and returns the roofline block with the
+    predicted/measured round-rate ratio. The model predicts ROUND rate;
+    message density is workload semantics, so predicted msgs/s uses the
+    run's OWN msgs-per-round. Best-effort: any failure returns None — a
+    bench must never die on its own self-report."""
+    try:
+        from maelstrom_tpu.analyze.cost_model import (predict_round,
+                                                      resolve_profile)
+        prof = resolve_profile(None)
+        rec = predict_round(program, cfg, fleet=fleet, profile=prof,
+                            msgs_per_round=msgs_per_round,
+                            rounds_per_dispatch=rounds_per_dispatch)
+        pred = rec["predicted"]
+        out = {
+            "profile": prof.name,
+            "rounds_per_sec": pred["rounds_per_sec"],
+            "msgs_per_sec": pred["msgs_per_sec"],
+            "round_s": pred["round_s"],
+            "flops_per_round": rec["flops"],
+            "hbm_bytes_per_round": rec["hbm_bytes_read"]
+            + rec["hbm_bytes_written"],
+        }
+        if measured_rounds_per_sec:
+            m = float(measured_rounds_per_sec)
+            out["measured_rounds_per_sec"] = round(m, 3)
+            out["predicted_vs_measured"] = round(
+                pred["rounds_per_sec"] / m, 3)
+        return out
+    except Exception as e:       # pragma: no cover - depends on env
+        print(f"bench: cost prediction skipped: {e!r}", file=sys.stderr)
+        return None
+
+
+def predicted_for_test(opts: dict, wall_s: float, *, msgs=None,
+                       fleet=None) -> dict | None:
+    """`predicted_block` for a `core.run`-driven bench: rebuilds the
+    run's program + NetConfig the way TpuRunner does (node spec from
+    the ordering axis, pool/inbox/client-lane defaults) and uses the
+    virtual-time round count (time_limit / ms_per_round) as the
+    measured basis. Best-effort, returns None on any failure."""
+    try:
+        from maelstrom_tpu import core
+        from maelstrom_tpu.net import tpu as T
+        from maelstrom_tpu.nodes import get_program
+        merged = {**core.DEFAULTS, **opts}
+        if merged.get("ordering"):
+            merged["node"] = "tpu:ordered"
+        nodes = core.parse_nodes(merged)
+        spec = str(merged["node"]).split(":", 1)[1]
+        conc = int(merged.get("concurrency") or len(nodes))
+        program = get_program(spec, merged, nodes)
+        n = len(nodes)
+        if getattr(program, "is_edge", False):
+            default_pool = max(8 * conc, 64)
+        else:
+            default_pool = max(4096, 4 * n * program.outbox_cap)
+        cfg = T.NetConfig(
+            n_nodes=n, n_clients=conc,
+            pool_cap=int(merged.get("pool_cap") or default_pool),
+            inbox_cap=program.inbox_cap,
+            client_cap=max(2 * conc, 8),
+            unit_words=tuple(getattr(program, "unit_words", ()) or ()))
+        ms_per_round = float(merged.get("ms_per_round") or 1.0)
+        rounds = float(merged["time_limit"]) * 1000.0 / ms_per_round
+        return predicted_block(
+            program, cfg, fleet=fleet,
+            measured_rounds_per_sec=rounds / wall_s if wall_s else None,
+            msgs_per_round=(msgs / rounds) if msgs and rounds else None)
+    except Exception as e:       # pragma: no cover - depends on env
+        print(f"bench: cost prediction skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def run_with_env_retry(fn, attempts=None, backoff_s=None,
                        metric="broadcast_sim_msgs_per_sec_100k_nodes",
                        unit="msgs/sec"):
@@ -658,6 +738,11 @@ def bench_fleet_record(sizes=None) -> dict:
             "clusters_per_sec": round(F / dt, 3),
             "converged": bool(seen.all()),
             "dropped_overflow": st["dropped_overflow"],
+            "predicted": predicted_block(
+                program, cfg, fleet=F,
+                measured_rounds_per_sec=R / dt,
+                msgs_per_round=st["recv_all"] / R,
+                rounds_per_dispatch=chunk),
         })
         print(f"bench[fleet={F}]: {rows[-1]['agg_msgs_per_sec']:.0f} "
               f"agg msgs/s, {rows[-1]['clusters_per_sec']:.2f} "
@@ -763,6 +848,10 @@ def bench_podmesh_record(fleets=None, meshes=None) -> dict:
                     "agg_msgs_per_sec": round(msgs / dt, 1),
                     "wall_s": round(dt, 3),
                     "valid": res["valid"] is True,
+                    "predicted": predicted_for_test(
+                        dict(workload="lin-kv", node="tpu:lin-kv",
+                             node_count=3, time_limit=tl),
+                        dt, msgs=msgs, fleet=F),
                 })
                 print(f"bench[podmesh fleet={F} mesh={spec}]: "
                       f"{rows[-1]['agg_msgs_per_sec']:.0f} agg msgs/s, "
@@ -936,6 +1025,11 @@ def bench_broadcast_batched_record() -> dict:
             "units_delivered": int(units),
             "units_per_msg": round(units / max(st["recv_all"], 1), 3),
             "dropped_overflow": st["dropped_overflow"],
+            "predicted": predicted_block(
+                prog, cfg,
+                measured_rounds_per_sec=rounds / dt if dt else None,
+                msgs_per_round=st["recv_all"] / max(rounds, 1),
+                rounds_per_dispatch=chunk),
         }
 
     rows = [measure("eager"), measure("batched")]
@@ -1038,6 +1132,11 @@ def bench_stream_record(mults=None) -> dict:
                 "windows": lag.get("windows"),
                 "max_lag_rounds": lag.get("max-lag-rounds"),
                 "valid": res["valid"] is True,
+                "predicted": predicted_for_test(
+                    dict(workload="kafka", node="tpu:kafka",
+                         node_count=5, concurrency=conc,
+                         time_limit=tl, kafka_groups=2),
+                    dt, msgs=res["net"]["all"]["recv-count"]),
             })
             print(f"bench[stream x{m}]: {rows[-1]['ops_per_sec']:.0f} "
                   f"ops/s, {rows[-1]['msgs_per_sec']:.0f} msgs/s, "
@@ -1425,6 +1524,13 @@ def bench_compartment_record(proxies=None) -> dict:
                 "ops_per_vsec": round(ok / tl, 1),
                 "wall_s": round(dt, 3),
                 "ops_per_wall_sec": round(ok / dt, 1),
+                "predicted": predicted_for_test(
+                    dict(workload="lin-kv", node="tpu:compartment",
+                         roles=f"proxies={p},acceptors=2x2,replicas=2",
+                         concurrency=conc, time_limit=tl,
+                         leader_slots=128, proxy_slots=8,
+                         compartment_inbox=16, kv_keys=1024),
+                    dt, msgs=res["net"]["all"]["recv-count"]),
                 # definite fails: leader backpressure sheds (error 11)
                 # PLUS ordinary lin-kv cas-mismatch/absent-key errors —
                 # the stats checker doesn't split by code, so this is
@@ -1660,6 +1766,8 @@ def bench_ordering_record() -> dict:
                 "failed_ops": res["stats"]["fail-count"],
                 "valid": (res.get("workload") or {}).get("valid")
                 is True,
+                "predicted": predicted_for_test(
+                    opts, dt, msgs=res["net"]["all"]["recv-count"]),
             })
             print(f"bench[ordering {eng}]: "
                   f"{rows[-1]['ops_per_vsec']:.0f} client-ops/vsec "
@@ -2030,6 +2138,11 @@ def _main_broadcast():
         "eager_resend": eager,
         "dropped_overflow": st["dropped_overflow"],
         "donated_carry": donate,
+        "predicted": predicted_block(
+            program, cfg,
+            measured_rounds_per_sec=R / dt,
+            msgs_per_round=msgs / R,
+            rounds_per_dispatch=chunk),
         **_fallback_meta(),
     }
 

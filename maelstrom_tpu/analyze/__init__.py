@@ -37,7 +37,8 @@ from dataclasses import dataclass, field
 __all__ = [
     "RULES", "Finding", "AuditReport", "Baseline", "baseline_path",
     "dedupe_sites", "apply_baseline", "run_audit", "audit_runner",
-    "audit_fleet_runner",
+    "audit_fleet_runner", "check_fingerprint_coverage", "cost_runner",
+    "cost_fleet_runner",
 ]
 
 
@@ -145,6 +146,59 @@ RULES: dict[str, dict] = {
                    "a replayed path; use a seeded random.Random",
         "incident": "nemesis/generator decisions must replay identically "
                     "from the same seed on both paths",
+    },
+    "thread-shared-mutation": {
+        "severity": "warn",
+        "summary": "unguarded assignment to an attribute that a worker "
+                   "thread of the same class also reads (no enclosing "
+                   "`with self.<lock>:` block)",
+        "incident": "the checker pipeline / checkpoint writer / "
+                    "telemetry session all pair a worker thread with "
+                    "main-thread readers; a torn or lost update "
+                    "surfaces only under scheduler jitter",
+    },
+    "fingerprint-coverage": {
+        "severity": "error",
+        "summary": "a core.DEFAULTS key is neither in FINGERPRINT_KEYS "
+                   "nor allowlisted in checkpoint.FINGERPRINT_EXEMPT "
+                   "(or the two lists contradict)",
+        "incident": "a new CLI knob that changes the compiled schedule "
+                    "but skips the fingerprint lets a checkpoint resume "
+                    "into a different program silently",
+    },
+    # ---- cost-model rules (analyze/cost_model.py) ----
+    "collective-on-dp": {
+        "severity": "error",
+        "summary": "a collective inside the round body crosses the "
+                   "dp/DCN axis (dp size > 1) — per-round DCN latency "
+                   "in the hot loop",
+        "incident": "the multi-host leg's perf killer: dp is the "
+                    "data-center network axis; round-rate collapses if "
+                    "the scan body synchronizes across it every round",
+    },
+    "carry-growth": {
+        "severity": "warn",
+        "summary": "scan/while carry bytes exceed the per-program "
+                   "budget declared in analyze/cost_baseline.json",
+        "incident": "the carry is resident for the whole stretch; "
+                    "silent carry growth is how HBM headroom erodes "
+                    "release over release",
+    },
+    "hbm-overflow": {
+        "severity": "error",
+        "summary": "predicted peak live-buffer footprint (donation "
+                   "credited) exceeds the device profile's HBM",
+        "incident": "an OOM found at trace time instead of on the first "
+                    "pod dispatch",
+    },
+    "intensity-regression": {
+        "severity": "warn",
+        "summary": "predicted msgs/s under the baseline profile dropped "
+                   "more than tolerance_pct vs the checked-in "
+                   "analyze/cost_baseline.json",
+        "incident": "the static analogue of a bench regression: catches "
+                    "a round body quietly gaining FLOPs/bytes before "
+                    "any hardware run",
     },
 }
 
@@ -327,6 +381,46 @@ class AuditReport:
 
 
 # ---------------------------------------------------------------------------
+# Fingerprint coverage (satellite of the cost auditor PR): every
+# core.DEFAULTS key must either pin the checkpoint fingerprint
+# (checkpoint.FINGERPRINT_KEYS) or be explicitly allowlisted with a
+# reason (checkpoint.FINGERPRINT_EXEMPT). A new CLI knob that changes
+# the compiled schedule cannot silently skip resume pinning.
+# ---------------------------------------------------------------------------
+
+def check_fingerprint_coverage() -> list[Finding]:
+    from .. import core
+    from ..checkpoint import FINGERPRINT_EXEMPT, FINGERPRINT_KEYS
+    out: list[Finding] = []
+    fp = set(FINGERPRINT_KEYS)
+    exempt = set(FINGERPRINT_EXEMPT)
+    for k in sorted(set(core.DEFAULTS) - fp - exempt):
+        out.append(Finding(
+            rule="fingerprint-coverage", entry="source-lint",
+            where=f"maelstrom_tpu/core.py DEFAULTS[{k!r}]",
+            key=f"maelstrom_tpu/core.py:DEFAULTS.{k}",
+            detail=f"{k!r} is neither in FINGERPRINT_KEYS nor "
+                   f"allowlisted in checkpoint.FINGERPRINT_EXEMPT"))
+    for k in sorted(fp & exempt):
+        out.append(Finding(
+            rule="fingerprint-coverage", entry="source-lint",
+            where=f"maelstrom_tpu/checkpoint.py FINGERPRINT_EXEMPT"
+                  f"[{k!r}]",
+            key=f"maelstrom_tpu/checkpoint.py:FINGERPRINT_EXEMPT.{k}",
+            detail=f"{k!r} is both fingerprinted and allowlisted — "
+                   f"the lists contradict"))
+    for k in sorted(exempt - set(core.DEFAULTS)):
+        out.append(Finding(
+            rule="fingerprint-coverage", entry="source-lint",
+            where=f"maelstrom_tpu/checkpoint.py FINGERPRINT_EXEMPT"
+                  f"[{k!r}]",
+            key=f"maelstrom_tpu/checkpoint.py:FINGERPRINT_EXEMPT.{k}",
+            detail=f"allowlist entry {k!r} is not a core.DEFAULTS key "
+                   f"(stale)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Top-level drivers
 # ---------------------------------------------------------------------------
 
@@ -352,6 +446,7 @@ def run_audit(programs=None, mesh: str | None = "auto",
     if lint:
         from . import source_lint
         raw += source_lint.lint_default_paths()
+        raw += check_fingerprint_coverage()
         report.entries.append("source-lint")
     sites = dedupe_sites(raw)
     report.new, report.suppressed = apply_baseline(
@@ -391,6 +486,7 @@ def _runner_audit(cfg_key_fn, steps_fn, trace: bool,
             raw += fs
         from . import source_lint
         raw += source_lint.lint_default_paths()
+        raw += check_fingerprint_coverage()
         if donation_enabled() and jax.default_backend() == "cpu":
             raw.append(Finding(
                 rule="donation-cpu-view", entry="runtime-config",
@@ -458,3 +554,88 @@ def audit_fleet_runner(runner, trace: bool = True) -> dict:
                  getattr(runner, "continuous", False),
                  donation_enabled()),
         steps, trace, extra_fn=lambda: {"fleet": runner.spec.fleet})
+
+
+# ---------------------------------------------------------------------------
+# Cost self-report blocks (the `cost` sub-block beside `static-audit`
+# in results.json — doc/analyze.md "cost model")
+# ---------------------------------------------------------------------------
+
+_runner_cost_memo: dict = {}
+
+
+def _runner_cost(cfg_key_fn, specs_fn, trace: bool, profile,
+                 extra_fn=lambda: {}) -> dict:
+    """Shared body of `cost_runner`/`cost_fleet_runner`: memoized per
+    (profile, config) key, costs the runner's own entry points when
+    tracing is on, and applies the structural cost rules (carry-growth
+    / hbm-overflow / collective-on-dp; NO baseline regression — the
+    self-report entry tags differ from the production baseline's).
+    Never raises: a cost-model failure must not fail a real run."""
+    t0 = time.perf_counter()
+    try:
+        from . import cost_model
+        prof = cost_model.resolve_profile(profile)
+        cfg_key = (prof.name,) + tuple(cfg_key_fn())
+        cached = _runner_cost_memo.get(cfg_key)
+        if cached is not None:
+            out = dict(cached)
+            out["wall-s"] = round(time.perf_counter() - t0, 3)
+            out["memoized"] = True
+            return out
+        records: dict = {}
+        findings: list[Finding] = []
+        if trace:
+            for spec in specs_fn():
+                records[spec.name] = cost_model.cost_step(spec, prof)
+            findings = cost_model.cost_findings(records, baseline={},
+                                                profile=prof)
+        out = {"ok": (not findings) if trace else None,
+               "profile": prof.name,
+               "records": {k: records[k] for k in sorted(records)},
+               "findings": [f.as_dict() for f in findings],
+               "traced": bool(trace),
+               **extra_fn()}
+        _runner_cost_memo[cfg_key] = dict(out)
+        out["wall-s"] = round(time.perf_counter() - t0, 3)
+        return out
+    except Exception as e:     # the cost block must never fail a run
+        return {"ok": None, "cost-error": repr(e),
+                "wall-s": round(time.perf_counter() - t0, 3)}
+
+
+def cost_runner(runner, trace: bool = True, profile=None) -> dict:
+    """The production cost self-report block (`cost` in results.json,
+    beside `static-audit`): per-round FLOPs/bytes/collective totals and
+    roofline predictions for the runner's OWN entry points under the
+    active device profile. Memoized per config; never raises."""
+    from ..sim import donation_enabled
+
+    def specs():
+        from . import jaxpr_audit
+        return jaxpr_audit.runner_step_specs(runner)
+    return _runner_cost(
+        lambda: (type(runner.program).__name__, repr(runner.cfg),
+                 runner._shardings is not None, bool(trace),
+                 getattr(runner, "continuous", False),
+                 donation_enabled()),
+        specs, trace, profile)
+
+
+def cost_fleet_runner(runner, trace: bool = True, profile=None) -> dict:
+    """The fleet-level `cost` results block: ONE costing of the vmapped
+    fleet dispatch shared by every cluster. Same contract as
+    `cost_runner`."""
+    from ..sim import donation_enabled
+
+    def specs():
+        from . import jaxpr_audit
+        return jaxpr_audit.fleet_runner_step_specs(runner)
+    return _runner_cost(
+        lambda: ("fleet", type(runner.program).__name__,
+                 repr(runner.cfg), runner.spec.fleet,
+                 runner._shardings is not None, bool(trace),
+                 getattr(runner, "continuous", False),
+                 donation_enabled()),
+        specs, trace, profile,
+        extra_fn=lambda: {"fleet": runner.spec.fleet})

@@ -33,12 +33,29 @@ def add_analyze_args(p: argparse.ArgumentParser) -> None:
                         "the cluster axis sharded over dp — when >= 2 "
                         "devices are visible)")
     p.add_argument("--baseline",
-                   help="Alternate baseline.json (default: the "
-                        "checked-in analyze/baseline.json)")
+                   help="Alternate baseline file (default: the "
+                        "checked-in analyze/baseline.json, or "
+                        "analyze/cost_baseline.json under --cost)")
     p.add_argument("--write-baseline", action="store_true",
                    help="Regenerate the baseline to cover every current "
                         "finding (existing reasons are preserved; new "
                         "entries get a FIXME reason to edit) and exit 0")
+    p.add_argument("--cost", action="store_true",
+                   help="Run the jaxpr cost auditor instead of the "
+                        "hazard audit: static roofline records "
+                        "(FLOPs/HBM/collective bytes, predicted "
+                        "rounds/s) for the same production entry "
+                        "points, gated against cost_baseline.json "
+                        "(collective-on-dp, carry-growth, "
+                        "hbm-overflow, intensity-regression)")
+    p.add_argument("--profile",
+                   help="Device profile for --cost predictions "
+                        "(cpu, tpu-v4, tpu-v5e; default: inferred "
+                        "from the JAX backend)")
+    p.add_argument("--write-cost-baseline", action="store_true",
+                   help="With --cost: regenerate cost_baseline.json "
+                        "from the current records (tolerance and "
+                        "carry budgets preserved) and exit 0")
 
 
 def run_analyze(args) -> int:
@@ -52,6 +69,8 @@ def run_analyze(args) -> int:
             programs = [p.strip() for p in args.programs.split(",")
                         if p.strip()]
     mesh = None if args.mesh == "none" else args.mesh
+    if getattr(args, "cost", False):
+        return _run_cost(args, programs, mesh)
     try:
         report = run_audit(programs=programs, mesh=mesh, jaxpr=jaxpr,
                            lint=not args.no_lint, baseline=args.baseline,
@@ -64,6 +83,31 @@ def run_analyze(args) -> int:
         print(f"wrote {path} ({len(report.new) + len(report.suppressed)} "
               f"suppressed site(s)); edit any FIXME reasons before "
               f"committing")
+        return 0
+    if args.format == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
+def _run_cost(args, programs, mesh) -> int:
+    from .cost_model import (cost_production, load_cost_baseline,
+                             resolve_profile, write_cost_baseline)
+    try:
+        profile = resolve_profile(args.profile)
+        baseline = load_cost_baseline(args.baseline)
+        report = cost_production(programs=programs, mesh=mesh,
+                                 fleet=not args.no_fleet,
+                                 profile=profile, baseline=baseline)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.write_cost_baseline:
+        path = write_cost_baseline(report.records, args.baseline,
+                                   profile=profile)
+        print(f"wrote {path} ({len(report.records)} entr"
+              f"{'y' if len(report.records) == 1 else 'ies'})")
         return 0
     if args.format == "json":
         print(json.dumps(report.as_dict(), indent=2))

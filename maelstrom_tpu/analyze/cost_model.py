@@ -1,0 +1,725 @@
+"""Static roofline cost model over the production jaxprs.
+
+The jaxpr audit (`jaxpr_audit.py`) proves the compiled hot loop is
+*hazard-free*; this module prices it. The same abstract traces (nothing
+is compiled or executed) are walked again, booking per equation:
+
+  - FLOPs (a documented per-primitive table: 2·M·N·K for dot_general,
+    n·log2(n) comparator passes for sort, element counts for the rest),
+  - HBM bytes read/written (operand/result aval bytes — a fusion-free
+    upper bound; the device profile's effective bandwidth absorbs the
+    constant factor),
+  - collective bytes per mesh axis: explicit collectives inside
+    `shard_map` manual regions (psum/all_gather/reduce_scatter/
+    ppermute/all_to_all, standard ring-cost factors), plus a documented
+    GSPMD heuristic charging partial-reshard traffic for sort/scatter/
+    gather reached under >1-size visible mesh axes.
+
+Loop handling mirrors the audit's recursion: `scan` bodies multiply by
+the static `length`, `while` bodies are booked ONCE (trip counts are
+data-dependent; the production scan drives rounds through a
+`lax.while_loop`, so a scan-entry total reads as ~one round plus the
+dispatch prologue/epilogue), `cond` books every branch (upper bound),
+`pjit`/custom-call sub-jaxprs recurse transparently.
+
+The roll-up per entry is a cost *record*: arithmetic intensity, scan
+carry bytes, peak live-buffer bytes (last-use liveness scan, donation
+credited — a donated carry aliases its output and counts once), and a
+predicted round rate on a declared `DeviceProfile`
+(`overhead + max(compute, memory, ICI, DCN)` — roofline, not additive).
+Predicted msgs/s scales the round rate by the config's per-round
+message capacity bound (`min(pool_cap, n·inbox_cap + client_cap)`), an
+upper bound; bench stamping substitutes each record's own measured
+msgs/round for the ratio (the model predicts the ROUND RATE; message
+density is workload semantics).
+
+Four gateable rules ride on the model (registered in `analyze.RULES`):
+`collective-on-dp`, `carry-growth`, `hbm-overflow`, and
+`intensity-regression` against the checked-in
+`analyze/cost_baseline.json`. See doc/analyze.md for the catalog, the
+profile format, and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from dataclasses import dataclass, field
+
+from . import Finding
+from .jaxpr_audit import StepSpec, _iter_subjaxprs, _mesh_axis_sizes, _site
+
+__all__ = [
+    "DeviceProfile", "PROFILES", "resolve_profile", "default_profile",
+    "cost_jaxpr", "cost_step", "predict", "predict_round",
+    "cost_production", "cost_findings", "CostReport",
+    "cost_baseline_path", "load_cost_baseline", "write_cost_baseline",
+    "DEFAULT_CARRY_BUDGET", "STRETCH_ROUNDS",
+]
+
+# Rounds in one scan stretch for the per-stretch roll-up (matches the
+# k=8 example the audit traces the scan entries with).
+STRETCH_ROUNDS = 8
+
+# Per-entry scan-carry budget when cost_baseline.json declares none.
+DEFAULT_CARRY_BUDGET = 64 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Device profiles
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DeviceProfile:
+    """Declared (not measured) peak numbers for one device class.
+
+    `hbm_bw` is an EFFECTIVE bandwidth: the model books fusion-free
+    aval bytes, so the profile's bandwidth is calibrated against real
+    round rates (doc/analyze.md records the calibration band) rather
+    than copied from a spec sheet. `dispatch_overhead_s` is the fixed
+    per-round host+launch cost that dominates small configs."""
+    name: str
+    peak_flops: float           # FLOP/s
+    hbm_bw: float               # bytes/s, effective
+    ici_bw: float               # bytes/s per device (sp-axis links)
+    dcn_bw: float               # bytes/s per host (dp-axis links)
+    hbm_bytes: float            # per-device memory capacity
+    dispatch_overhead_s: float  # fixed per-round overhead
+
+
+PROFILES: dict[str, DeviceProfile] = {
+    # The 2-core CPU dev box, CALIBRATED against the committed r01
+    # bench artifacts (doc/analyze.md "predicted vs measured"). The
+    # numbers are far above physical DRAM/scalar rates on purpose: the
+    # model books fusion-free aval bytes and per-element logical ops,
+    # and XLA:CPU fuses the mask-heavy round bodies ~100x (SIMD bool
+    # lanes, fused elementwise chains), so the EFFECTIVE bandwidth/peak
+    # absorb that constant. The per-round dispatch+Python overhead
+    # (milliseconds) dominates small configs.
+    "cpu": DeviceProfile("cpu", peak_flops=1.0e11, hbm_bw=1.6e10,
+                         ici_bw=2.0e9, dcn_bw=1.0e9,
+                         hbm_bytes=8.0 * 2**30,
+                         dispatch_overhead_s=6.0e-3),
+    # TPU v4 (public spec: 275 TFLOP/s bf16, 1.2 TB/s HBM, 32 GiB,
+    # ~300 GB/s aggregate ICI per chip, DCN O(25 GB/s) per host).
+    # int32-heavy round bodies see a fraction of bf16 peak; declared.
+    "tpu-v4": DeviceProfile("tpu-v4", peak_flops=275.0e12, hbm_bw=1.2e12,
+                            ici_bw=300.0e9, dcn_bw=25.0e9,
+                            hbm_bytes=32.0 * 2**30,
+                            dispatch_overhead_s=5.0e-6),
+    # TPU v5e (public spec: 197 TFLOP/s bf16, 819 GB/s HBM, 16 GiB,
+    # ~200 GB/s ICI).
+    "tpu-v5e": DeviceProfile("tpu-v5e", peak_flops=197.0e12,
+                             hbm_bw=819.0e9, ici_bw=200.0e9,
+                             dcn_bw=25.0e9, hbm_bytes=16.0 * 2**30,
+                             dispatch_overhead_s=5.0e-6),
+}
+
+
+def default_profile() -> str:
+    """MAELSTROM_COST_PROFILE env override, else by visible backend."""
+    env = os.environ.get("MAELSTROM_COST_PROFILE")
+    if env:
+        return env
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    return "tpu-v4" if backend == "tpu" else "cpu"
+
+
+def resolve_profile(profile=None) -> DeviceProfile:
+    if isinstance(profile, DeviceProfile):
+        return profile
+    name = profile or default_profile()
+    if name not in PROFILES:
+        raise ValueError(f"unknown device profile {name!r}; expected one "
+                         f"of {sorted(PROFILES)}")
+    return PROFILES[name]
+
+
+# ---------------------------------------------------------------------------
+# Per-equation booking tables
+# ---------------------------------------------------------------------------
+
+# Pure data movement / metadata: 0 FLOPs, bytes only.
+_ZERO_FLOP_PRIMS = frozenset({
+    "reshape", "broadcast_in_dim", "transpose", "squeeze", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "rev", "gather", "scatter", "iota", "copy", "convert_element_type",
+    "bitcast_convert_type", "stop_gradient", "device_put",
+    "sharding_constraint", "split", "expand_dims",
+})
+
+# Explicit collectives (shard_map manual regions / GSPMD-visible axis
+# primitives). Wire-byte factors are the standard ring costs.
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "pbroadcast", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "ppermute",
+})
+
+# GSPMD resharding heuristic: primitives whose sharded lowering
+# typically moves operand data across >1-size visible mesh axes
+# (partitioned sorts merge across shards; scatter/gather may target
+# remote shards). Booked as (s-1)/s of operand bytes per axis — a
+# declared estimate, never a `collective-on-dp` trigger.
+_GSPMD_RESHARD_PRIMS = frozenset({
+    "sort", "scatter", "scatter-add", "scatter-mul", "scatter-min",
+    "scatter-max", "gather", "dynamic_update_slice",
+})
+
+
+def _aval_bytes(v) -> int:
+    import numpy as np
+    aval = getattr(v, "aval", None)
+    try:
+        return int(aval.size) * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _elems(v) -> int:
+    aval = getattr(v, "aval", None)
+    try:
+        return int(aval.size)
+    except Exception:
+        return 0
+
+
+def _flops(eqn, p: str) -> int:
+    """Documented per-primitive FLOP table (doc/analyze.md). Counts are
+    per logical element; the profile's peak absorbs the constant."""
+    if p in _ZERO_FLOP_PRIMS:
+        return 0
+    out_elems = sum(_elems(v) for v in eqn.outvars)
+    in_elems = sum(_elems(v) for v in eqn.invars)
+    if p == "dot_general":
+        (lc, _rc), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        k = 1
+        for ax in lc:
+            k *= int(lhs.shape[ax])
+        return 2 * out_elems * max(k, 1)
+    if p == "sort":
+        dim = eqn.params.get("dimension", -1)
+        shape = getattr(eqn.invars[0].aval, "shape", ())
+        n = int(shape[dim]) if shape else 1
+        return in_elems * max(1, math.ceil(math.log2(max(n, 2))))
+    if p.startswith("reduce_") or p in ("argmax", "argmin"):
+        return in_elems
+    if p.startswith("cum"):
+        return 2 * in_elems
+    if p.startswith("scatter-"):
+        return _elems(eqn.invars[-1])        # one combine per update elem
+    if p == "integer_pow":
+        return 2 * out_elems
+    return out_elems                         # elementwise default
+
+
+def _collective_axis_names(eqn) -> tuple:
+    ax = eqn.params.get("axes")
+    if ax is None:
+        ax = eqn.params.get("axis_name")
+    if ax is None:
+        return ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _wire_bytes(p: str, in_b: int, s: int) -> int:
+    """Per-device wire bytes for one collective over a group of size s
+    (ring algorithms): all-reduce moves 2(s-1)/s of the data, gather
+    (s-1)x the shard, scatter/all-to-all (s-1)/s, permute 1x."""
+    if p in ("psum", "pmax", "pmin"):
+        return 2 * in_b * (s - 1) // s
+    if p == "all_gather":
+        return in_b * (s - 1)
+    if p in ("reduce_scatter", "psum_scatter", "all_to_all"):
+        return in_b * (s - 1) // s
+    return in_b                              # ppermute / pbroadcast
+
+
+# ---------------------------------------------------------------------------
+# The recursive walker
+# ---------------------------------------------------------------------------
+
+class _Acc:
+    """Booked totals for one entry trace."""
+
+    def __init__(self):
+        self.flops = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.collective: dict[str, int] = {}
+        self.carry_bytes = 0
+        self.carry_site = ""
+        self.dp_sites: list[dict] = []
+
+    def note_carry(self, b: int, eqn) -> None:
+        if b > self.carry_bytes:
+            self.carry_bytes = b
+            self.carry_site, _ = _site(eqn)
+
+    def note_dp(self, eqn, p: str, wire: int) -> None:
+        where, key = _site(eqn)
+        self.dp_sites.append({
+            "where": where, "key": key,
+            "detail": f"{p} crosses the dp/DCN axis ({wire} wire B per "
+                      f"round-body execution)"})
+
+
+def _book_leaf(eqn, p, in_b, out_b, coll_axes, gspmd_axes, mult, acc):
+    acc.flops += _flops(eqn, p) * mult
+    acc.bytes_read += in_b * mult
+    acc.bytes_written += out_b * mult
+    if p in _COLLECTIVE_PRIMS:
+        names = _collective_axis_names(eqn)
+        sizes = {a: int(coll_axes.get(a, 1)) for a in names}
+        group = 1
+        for v in sizes.values():
+            group *= max(v, 1)
+        if group > 1:
+            wire = _wire_bytes(p, in_b, group)
+            for a, sz in sizes.items():
+                if sz > 1:
+                    acc.collective[a] = acc.collective.get(a, 0) \
+                        + wire * mult
+            if sizes.get("dp", 1) > 1:
+                acc.note_dp(eqn, p, wire)
+    elif p in _GSPMD_RESHARD_PRIMS:
+        for a, sz in gspmd_axes.items():
+            if sz > 1:
+                acc.collective[a] = acc.collective.get(a, 0) \
+                    + (in_b * (sz - 1) // sz) * mult
+
+
+def _walk(jx, coll_axes, gspmd_axes, mult, acc) -> int:
+    """Books every equation of `jx` (times `mult`) into `acc` and
+    returns the jaxpr's peak live bytes (last-use liveness scan; an
+    equation with sub-jaxprs contributes its sub-peak minus the operand
+    bytes already counted live)."""
+    from jax.core import Literal
+    last: dict = {}
+    for i, eqn in enumerate(jx.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, Literal):
+                last[v] = i
+    for v in jx.outvars:
+        if not isinstance(v, Literal):
+            last[v] = len(jx.eqns)
+    live = sum(_aval_bytes(v)
+               for v in list(jx.invars) + list(jx.constvars))
+    peak = live
+    for i, eqn in enumerate(jx.eqns):
+        p = eqn.primitive.name
+        in_b = sum(_aval_bytes(v) for v in eqn.invars)
+        out_b = sum(_aval_bytes(v) for v in eqn.outvars)
+        subs = list(_iter_subjaxprs(eqn.params))
+        sub_peak = 0
+        if not subs:
+            _book_leaf(eqn, p, in_b, out_b, coll_axes, gspmd_axes, mult,
+                       acc)
+        elif p == "scan":
+            length = int(eqn.params.get("length") or 1)
+            nc = int(eqn.params.get("num_consts") or 0)
+            nk = int(eqn.params.get("num_carry") or 0)
+            acc.note_carry(
+                sum(_aval_bytes(v) for v in eqn.invars[nc:nc + nk]), eqn)
+            for sub in subs:
+                sub_peak = max(sub_peak, _walk(sub, coll_axes, gspmd_axes,
+                                               mult * length, acc))
+        elif p == "while":
+            # trip count is data-dependent: body booked ONCE. The
+            # production scan entries drive rounds through a
+            # lax.while_loop, so their totals read as ~one round.
+            bn = int(eqn.params.get("body_nconsts") or 0)
+            body = eqn.params.get("body_jaxpr")
+            bj = getattr(body, "jaxpr", body)
+            if bj is not None:
+                acc.note_carry(
+                    sum(_aval_bytes(v) for v in list(bj.invars)[bn:]),
+                    eqn)
+            for sub in subs:
+                sub_peak = max(sub_peak, _walk(sub, coll_axes, gspmd_axes,
+                                               mult, acc))
+        elif p == "shard_map":
+            # inside the manual region the mesh axes become explicit
+            # collective axis names; GSPMD only sees the `auto` subset
+            m = eqn.params.get("mesh")
+            auto = eqn.params.get("auto") or frozenset()
+            mesh_shape = dict(getattr(m, "shape", {}) or {})
+            sub_gspmd = {k: v for k, v in mesh_shape.items() if k in auto}
+            for sub in subs:
+                sub_peak = max(sub_peak, _walk(sub, mesh_shape, sub_gspmd,
+                                               mult, acc))
+        else:
+            # pjit / cond / custom_* / remat: recurse transparently.
+            # cond books EVERY branch — a deterministic upper bound.
+            for sub in subs:
+                sub_peak = max(sub_peak, _walk(sub, coll_axes, gspmd_axes,
+                                               mult, acc))
+        transient = sub_peak - in_b if sub_peak > in_b else 0
+        cand = live + out_b + transient
+        if cand > peak:
+            peak = cand
+        live += out_b
+        for v in set(eqn.outvars):
+            if v not in last:               # result never used: dies here
+                live -= _aval_bytes(v)
+        for v in {v for v in eqn.invars
+                  if not isinstance(v, Literal) and last.get(v) == i}:
+            live -= _aval_bytes(v)
+    return peak
+
+
+def cost_jaxpr(closed, mesh_axes: dict | None = None):
+    """Walks one ClosedJaxpr; returns (acc, peak_bytes, donated_bytes).
+    Donation credit reads the REAL `donated_invars` off a single-pjit
+    trace (the shape every jitted entry point produces)."""
+    from jax.core import Literal
+    axes = dict(mesh_axes or {})
+    acc = _Acc()
+    peak = _walk(closed.jaxpr, axes, axes, 1, acc)
+    donated = 0
+    eqns = closed.jaxpr.eqns
+    if len(eqns) == 1 and eqns[0].primitive.name == "pjit":
+        don = eqns[0].params.get("donated_invars") or ()
+        for flag, v in zip(don, eqns[0].invars):
+            if flag and not isinstance(v, Literal):
+                donated += _aval_bytes(v)
+    return acc, peak, donated
+
+
+# ---------------------------------------------------------------------------
+# Entry records and predictions
+# ---------------------------------------------------------------------------
+
+def predict(record: dict, profile=None,
+            rounds_per_dispatch: int = 1) -> dict:
+    """Roofline prediction from a cost record's invariant totals:
+    round_s = overhead + max(compute, memory, ICI, DCN). Returns a
+    fresh dict; `record` is not mutated.
+
+    `rounds_per_dispatch` amortizes the dispatch overhead for chunked
+    scan drivers (the benches run `chunk` rounds per host dispatch);
+    the production host loop pays it every round, the default."""
+    prof = resolve_profile(profile)
+    flops = record["flops"]
+    hbm = record["hbm_bytes_read"] + record["hbm_bytes_written"]
+    coll = record.get("collective_bytes") or {}
+    ici_b = sum(b for a, b in coll.items() if a != "dp")
+    dcn_b = coll.get("dp", 0)
+    t = prof.dispatch_overhead_s / max(int(rounds_per_dispatch), 1) \
+        + max(flops / prof.peak_flops, hbm / prof.hbm_bw,
+              ici_b / prof.ici_bw, dcn_b / prof.dcn_bw)
+    rps = 1.0 / t
+    cap = record.get("msgs_per_round_cap")
+    return {
+        "profile": prof.name,
+        "round_s": round(t, 9),
+        "rounds_per_sec": round(rps, 3),
+        "msgs_per_round_cap": cap,
+        "msgs_per_sec": round(cap * rps, 3) if cap else None,
+    }
+
+
+def _msgs_per_round_cap(spec: StepSpec):
+    """Static per-round message capacity bound from the spec's config:
+    deliveries are capped by pool occupancy and per-node inbox + client
+    lanes; a fleet multiplies by the cluster count. An upper bound —
+    real message density is workload semantics."""
+    cfg = (spec.meta or {}).get("cfg")
+    if cfg is None:
+        return None
+    try:
+        cap = min(int(cfg.pool_cap),
+                  int(cfg.n_nodes) * int(cfg.inbox_cap)
+                  + int(getattr(cfg, "client_cap", 0)))
+    except Exception:
+        return None
+    fleet = (spec.meta or {}).get("fleet")
+    return cap * int(fleet) if fleet else cap
+
+
+def cost_step(spec: StepSpec, profile=None) -> dict:
+    """Cost record for one auditable entry point (abstract trace only).
+    Counts are exact integers of the model — goldens pin them
+    tolerance-free."""
+    import jax
+    prof = resolve_profile(profile)
+    closed = jax.make_jaxpr(spec.fn)(*spec.args)
+    acc, peak, donated = cost_jaxpr(
+        closed, _mesh_axis_sizes(spec.in_shardings))
+    hbm = acc.bytes_read + acc.bytes_written
+    record = {
+        "entry": spec.name,
+        "flops": int(acc.flops),
+        "hbm_bytes_read": int(acc.bytes_read),
+        "hbm_bytes_written": int(acc.bytes_written),
+        "collective_bytes": {k: int(v)
+                             for k, v in sorted(acc.collective.items())},
+        "arithmetic_intensity": round(acc.flops / max(hbm, 1), 6),
+        "carry_bytes": int(acc.carry_bytes),
+        "carry_site": acc.carry_site,
+        "peak_bytes": int(peak),
+        "donated_bytes": int(donated),
+        "peak_bytes_donated": int(max(peak - donated, 0)),
+        "msgs_per_round_cap": _msgs_per_round_cap(spec),
+        "dp_collectives": list(acc.dp_sites),
+        "stretch": {"rounds": STRETCH_ROUNDS,
+                    "flops": int(acc.flops) * STRETCH_ROUNDS,
+                    "hbm_bytes": int(hbm) * STRETCH_ROUNDS},
+    }
+    record["predicted"] = predict(record, prof)
+    return record
+
+
+def predict_round(program, cfg, *, fleet: int | None = None,
+                  inject_width: int = 1, profile=None,
+                  msgs_per_round: float | None = None,
+                  rounds_per_dispatch: int = 1) -> dict:
+    """Bench-facing prediction: traces the per-round step for an
+    ALREADY-BUILT program/config at its real shape (state via
+    `jax.eval_shape` — no arrays are materialized, so 100k-node bench
+    shapes trace in milliseconds) and returns a cost record. With
+    `msgs_per_round` (the record under comparison's own message
+    density) `predicted.msgs_per_sec` uses it instead of the static
+    capacity bound. `rounds_per_dispatch` amortizes dispatch overhead
+    for chunked-scan benches (see `predict`)."""
+    import jax
+
+    from ..net import tpu as T
+    from ..sim import make_round_fn, make_sim
+
+    prof = resolve_profile(profile)
+    ex = jax.eval_shape(lambda: make_sim(program, cfg, seed=0))
+    inj = jax.eval_shape(lambda: T.Msgs.empty(max(int(inject_width), 1)))
+    fn = make_round_fn(program, cfg, donate=False)
+    if fleet:
+        F = int(fleet)
+        bcast = lambda s: jax.ShapeDtypeStruct((F,) + tuple(s.shape),
+                                               s.dtype)
+        ex = jax.tree.map(bcast, ex)
+        inj = jax.tree.map(bcast, inj)
+        fn = jax.vmap(fn)
+    spec = StepSpec(name=f"predict[{type(program).__name__}"
+                         f"{'@fleet=' + str(fleet) if fleet else ''}]",
+                    fn=fn, args=(ex, inj),
+                    meta={"cfg": cfg, "fleet": fleet})
+    record = cost_step(spec, prof)
+    if rounds_per_dispatch > 1:
+        record["predicted"] = predict(
+            record, prof, rounds_per_dispatch=rounds_per_dispatch)
+    if msgs_per_round:
+        rps = record["predicted"]["rounds_per_sec"]
+        record["predicted"]["msgs_per_round"] = round(
+            float(msgs_per_round), 3)
+        record["predicted"]["msgs_per_sec"] = round(
+            float(msgs_per_round) * rps, 3)
+    return record
+
+
+# ---------------------------------------------------------------------------
+# Baseline + rules
+# ---------------------------------------------------------------------------
+
+def cost_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "cost_baseline.json")
+
+
+def load_cost_baseline(path: str | None = None) -> dict:
+    path = path or cost_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_cost_baseline(records: dict, path: str | None = None,
+                        profile=None) -> str:
+    """Regenerates cost_baseline.json from current records. Carry
+    budgets and the tolerance are preserved across rewrites; entries
+    are emitted in sorted order so regenerated baselines diff
+    cleanly."""
+    path = path or cost_baseline_path()
+    prof = resolve_profile(profile)
+    old = load_cost_baseline(path)
+    entries = {}
+    for name in sorted(records):
+        rec = records[name]
+        pred = predict(rec, prof)
+        entries[name] = {
+            "flops": rec["flops"],
+            "hbm_bytes": rec["hbm_bytes_read"] + rec["hbm_bytes_written"],
+            "collective_bytes": rec["collective_bytes"],
+            "carry_bytes": rec["carry_bytes"],
+            "peak_bytes_donated": rec["peak_bytes_donated"],
+            "rounds_per_sec": pred["rounds_per_sec"],
+            "msgs_per_sec": pred["msgs_per_sec"],
+        }
+    data = {
+        "version": 1,
+        "profile": prof.name,
+        "tolerance_pct": float(old.get("tolerance_pct", 20.0)),
+        "default_carry_budget_bytes": int(
+            old.get("default_carry_budget_bytes", DEFAULT_CARRY_BUDGET)),
+        "carry_budgets": dict(sorted(
+            (old.get("carry_budgets") or {}).items())),
+        "entries": entries,
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def cost_findings(records: dict, baseline: dict | None = None,
+                  profile=None) -> list[Finding]:
+    """The four model rules over a set of entry records.
+
+    `intensity-regression` always compares under the BASELINE's
+    declared profile (like-with-like regardless of --profile);
+    `hbm-overflow` checks the REQUESTED profile's capacity. Pass
+    `baseline={}` to skip the regression gate (runner self-report
+    blocks, whose entry tags differ from the production baseline's)."""
+    prof = resolve_profile(profile)
+    base = load_cost_baseline() if baseline is None else baseline
+    base_prof = None
+    if base:
+        try:
+            base_prof = resolve_profile(base.get("profile", "cpu"))
+        except ValueError:
+            base_prof = None
+    tol = float(base.get("tolerance_pct", 20.0)) if base else 20.0
+    budgets = (base.get("carry_budgets") or {}) if base else {}
+    default_budget = int(base.get("default_carry_budget_bytes",
+                                  DEFAULT_CARRY_BUDGET)) \
+        if base else DEFAULT_CARRY_BUDGET
+    out: list[Finding] = []
+    for name in sorted(records):
+        rec = records[name]
+        for hit in rec.get("dp_collectives") or ():
+            out.append(Finding(
+                rule="collective-on-dp", entry=name,
+                where=hit["where"], key=hit["key"],
+                detail=hit["detail"]))
+        budget = int(budgets.get(name, default_budget))
+        if rec["carry_bytes"] > budget:
+            out.append(Finding(
+                rule="carry-growth", entry=name,
+                where=rec.get("carry_site") or f"{name} scan carry",
+                key=f"cost:{name}:carry",
+                detail=f"scan carry {rec['carry_bytes']} B exceeds "
+                       f"budget {budget} B"))
+        if rec["peak_bytes_donated"] > prof.hbm_bytes:
+            out.append(Finding(
+                rule="hbm-overflow", entry=name, where=name,
+                key=f"cost:{name}:hbm",
+                detail=f"predicted peak {rec['peak_bytes_donated']} B "
+                       f"(donation credited) exceeds {prof.name} HBM "
+                       f"{int(prof.hbm_bytes)} B"))
+        if base and base_prof is not None:
+            bent = (base.get("entries") or {}).get(name)
+            cur = predict(rec, base_prof)
+            cur_v = cur["msgs_per_sec"] or cur["rounds_per_sec"]
+            if bent is None:
+                out.append(Finding(
+                    rule="intensity-regression", entry=name, where=name,
+                    key=f"cost:{name}:baseline",
+                    detail="entry missing from cost_baseline.json "
+                           "(regenerate with --write-cost-baseline)"))
+            else:
+                prev = bent.get("msgs_per_sec") or \
+                    bent.get("rounds_per_sec")
+                if prev and cur_v < prev * (1.0 - tol / 100.0):
+                    out.append(Finding(
+                        rule="intensity-regression", entry=name,
+                        where=name, key=f"cost:{name}:intensity",
+                        detail=f"predicted {cur_v:.1f}/s under "
+                               f"{base_prof.name} profile is "
+                               f"{100 * (1 - cur_v / prev):.1f}% below "
+                               f"baseline {prev:.1f}/s "
+                               f"(tolerance {tol:.0f}%)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The production report (CLI / gate surface)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CostReport:
+    records: dict = field(default_factory=dict)
+    findings: list = field(default_factory=list)    # [Finding]
+    notes: list = field(default_factory=list)
+    profile: str = "cpu"
+    wall_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {"ok": self.ok, "profile": self.profile,
+                "records": {k: self.records[k]
+                            for k in sorted(self.records)},
+                "findings": [f.as_dict() for f in self.findings],
+                "notes": list(self.notes),
+                "wall-s": round(self.wall_s, 3)}
+
+    def render_text(self) -> str:
+        lines = [f"cost audit [{self.profile}]: "
+                 f"{len(self.records)} entries costed, "
+                 f"{len(self.findings)} finding(s), {self.wall_s:.1f}s"]
+        for note in self.notes:
+            lines.append(f"  note: {note}")
+        hdr = (f"  {'entry':<44} {'MFLOP':>8} {'MB':>8} {'AI':>7} "
+               f"{'rounds/s':>9} {'msgs/s cap':>11}")
+        lines.append(hdr)
+        for name in sorted(self.records):
+            rec = self.records[name]
+            pred = rec["predicted"]
+            hbm = rec["hbm_bytes_read"] + rec["hbm_bytes_written"]
+            mps = pred["msgs_per_sec"]
+            lines.append(
+                f"  {name:<44} {rec['flops'] / 1e6:>8.2f} "
+                f"{hbm / 1e6:>8.2f} {rec['arithmetic_intensity']:>7.4f} "
+                f"{pred['rounds_per_sec']:>9.1f} "
+                f"{(f'{mps:.0f}' if mps else '-'):>11}")
+        from . import RULES
+        for f in self.findings:
+            meta = RULES.get(f.rule, {})
+            lines.append(f"\nNEW [{f.severity}] {f.rule} @ {f.where}")
+            lines.append(f"  {meta.get('summary', '')}")
+            if f.detail:
+                lines.append(f"  detail: {f.detail}")
+            if f.entry:
+                lines.append(f"  entry: {f.entry}")
+        lines.append("\ncost result: " + (
+            "CLEAN (no findings)" if self.ok
+            else f"{len(self.findings)} finding(s)"))
+        return "\n".join(lines)
+
+
+def cost_production(programs=None, mesh: str | None = "auto",
+                    fleet: bool = True, profile=None,
+                    baseline: dict | None = None) -> CostReport:
+    """Costs every production entry point the hazard audit traces (same
+    job list: plain + mesh variants + fleet + telemetry + checker
+    kernels) and gates the records against cost_baseline.json."""
+    from .jaxpr_audit import iter_production_specs
+    t0 = time.perf_counter()
+    prof = resolve_profile(profile)
+    specs, notes = iter_production_specs(programs=programs, mesh=mesh,
+                                         fleet=fleet)
+    records = {}
+    for spec in specs:
+        records[spec.name] = cost_step(spec, prof)
+    findings = cost_findings(records, baseline=baseline, profile=prof)
+    return CostReport(records=records, findings=findings, notes=notes,
+                      profile=prof.name,
+                      wall_s=time.perf_counter() - t0)

@@ -127,6 +127,9 @@ class StepSpec:
     in_shardings: object = None     # sharding pytree for the carry, or None
     out_shardings: object = None    # sharding pytree for output 0, or None
     extra_findings: list = field(default_factory=list)
+    # builder context the cost model reads (cfg/fleet for the per-round
+    # message-capacity bound); the hazard audit ignores it
+    meta: dict = field(default_factory=dict)
 
 
 def _repo_rel(path: str) -> str:
@@ -454,7 +457,8 @@ def production_step_specs(workload: str, mesh: str | None = None,
         tag = (f"{workload}{'@mesh=' + mesh if mesh else ''}"
                f"{'@telemetry' if telemetry else ''}")
         common = dict(donate_argnums=(0,) if donate else (),
-                      in_shardings=sim_sh, out_shardings=out0_sh)
+                      in_shardings=sim_sh, out_shardings=out0_sh,
+                      meta={"cfg": runner.cfg, "workload": workload})
         specs = [
             StepSpec(name=f"round_fn[{tag}]",
                      fn=make_round_fn(runner.program, runner.cfg,
@@ -543,7 +547,9 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
         tag = f"{workload}@fleet={F}" + (f"@mesh={mesh}" if mesh else "")
         sim_sh = sh[0] if sh is not None else None
         common = dict(donate_argnums=(0,) if donate else (),
-                      in_shardings=sim_sh, out_shardings=sim_sh)
+                      in_shardings=sim_sh, out_shardings=sim_sh,
+                      meta={"cfg": runner.cfg, "workload": workload,
+                            "fleet": F})
         specs = [
             StepSpec(name=f"fleet_scan_fn[{tag}]",
                      fn=make_fleet_scan_fn(runner.program, runner.cfg,
@@ -570,7 +576,9 @@ def fleet_step_specs(workload: str, fleet: int = AUDIT_FLEET,
                          example=sim, example_inject=inject),
                      args=(sim, inject),
                      donate_argnums=(), in_shardings=sim_sh,
-                     out_shardings=None),
+                     out_shardings=None,
+                     meta={"cfg": runner.cfg, "workload": workload,
+                           "fleet": F}),
         ]
     return specs
 
@@ -617,20 +625,24 @@ def checker_step_specs() -> list[StepSpec]:
     ]
 
 
-def audit_production(programs=None, mesh: str | None = "auto",
-                     fleet: bool = True):
-    """Traces and audits the production step functions for each
-    workload. `mesh="auto"` adds `--mesh 1,2` variants for
-    DEFAULT_MESH_PROGRAMS when >= 2 devices are visible; an explicit
-    mesh spec is applied to every requested program; None disables mesh
-    variants. `fleet` additionally traces the vmapped fleet scan/round
-    for DEFAULT_FLEET_PROGRAMS (plain, and sharded `--mesh 2,1` when
-    the devices are there — the dp>1 configuration only the fleet can
-    run). Returns (findings, entry_names, notes)."""
+def iter_production_specs(programs=None, mesh: str | None = "auto",
+                          fleet: bool = True):
+    """Builds the FULL production job list — every entry point the gate
+    traces — and returns (specs, notes). Shared by the hazard audit
+    (`audit_production`) and the cost model (`cost_model.
+    cost_production`), so both gates always cover the same surface.
+
+    `mesh="auto"` adds `--mesh 1,2` variants for DEFAULT_MESH_PROGRAMS
+    when >= 2 devices are visible; an explicit mesh spec is applied to
+    every requested program; None disables mesh variants. `fleet`
+    additionally builds the vmapped fleet scan/round for
+    DEFAULT_FLEET_PROGRAMS (plain, sharded `--mesh 2,1` at >= 2
+    devices, and the mixed `--mesh 2,2` shard_map configuration at
+    >= 4). Telemetry-ring variants and the device checker kernels ride
+    along as in the audit."""
     import jax
     programs = list(programs or DEFAULT_PROGRAMS)
-    findings: list[Finding] = []
-    entries: list[str] = []
+    specs: list[StepSpec] = []
     notes: list[str] = []
 
     jobs: list[tuple[str, str | None]] = [(p, None) for p in programs]
@@ -646,9 +658,7 @@ def audit_production(programs=None, mesh: str | None = "auto",
         jobs += [(p, mesh) for p in programs]
 
     for workload, mesh_spec in jobs:
-        for spec in production_step_specs(workload, mesh=mesh_spec):
-            findings += audit_step(spec)
-            entries.append(spec.name)
+        specs += production_step_specs(workload, mesh=mesh_spec)
 
     if fleet:
         fleet_jobs: list[tuple[str, str | None]] = \
@@ -678,9 +688,7 @@ def audit_production(programs=None, mesh: str | None = "auto",
                 fleet_jobs += [(p, mesh) for p in DEFAULT_FLEET_PROGRAMS
                                if p in programs]
         for workload, mesh_spec in fleet_jobs:
-            for spec in fleet_step_specs(workload, mesh=mesh_spec):
-                findings += audit_step(spec)
-                entries.append(spec.name)
+            specs += fleet_step_specs(workload, mesh=mesh_spec)
 
     # flight-recorder rings (doc/observability.md): ring-enabled traces
     # of one pool-path and one edge-path workload, so the gate audits
@@ -688,24 +696,36 @@ def audit_production(programs=None, mesh: str | None = "auto",
     # must stay at zero findings with rings compiled in
     for workload in ("lin-kv", "broadcast"):
         if workload in programs:
-            for spec in production_step_specs(workload, telemetry=True):
-                findings += audit_step(spec)
-                entries.append(spec.name)
+            specs += production_step_specs(workload, telemetry=True)
 
     # device-resident checker kernels (doc/perf.md "device-resident
     # grading"): traced whenever the program set includes the elle
     # workload — the checker is part of that workload's hot path now
     if "txn-list-append" in programs:
-        for spec in checker_step_specs():
-            findings += audit_step(spec)
-            entries.append(spec.name)
+        specs += checker_step_specs()
+    return specs, notes
+
+
+def audit_production(programs=None, mesh: str | None = "auto",
+                     fleet: bool = True):
+    """Traces and audits the production step functions for each
+    workload (job list from `iter_production_specs` — the shared
+    audit/cost surface). Returns (findings, entry_names, notes)."""
+    specs, notes = iter_production_specs(programs=programs, mesh=mesh,
+                                         fleet=fleet)
+    findings: list[Finding] = []
+    entries: list[str] = []
+    for spec in specs:
+        findings += audit_step(spec)
+        entries.append(spec.name)
     return findings, entries, notes
 
 
-def audit_fleet_runner_steps(runner):
-    """Self-report variant for a LIVE FleetRunner: audits the vmapped
+def fleet_runner_step_specs(runner) -> list[StepSpec]:
+    """Spec for a LIVE FleetRunner's dispatch entry point: the vmapped
     fleet scan over the runner's own batched tree, shardings, and
-    donation setting (the exact dispatch every fleet wave runs)."""
+    donation setting (the exact dispatch every fleet wave runs).
+    Shared by the `static-audit` and `cost` self-report blocks."""
     import jax
     import jax.numpy as jnp
 
@@ -722,7 +742,8 @@ def audit_fleet_runner_steps(runner):
     flags = jnp.ones((F,), bool)
     tag = f"{type(runner.program).__name__}@fleet={F}"
     common = dict(donate_argnums=(0,) if donate else (),
-                  in_shardings=sim_sh, out_shardings=sim_sh)
+                  in_shardings=sim_sh, out_shardings=sim_sh,
+                  meta={"cfg": runner.cfg, "fleet": F})
     if getattr(runner, "continuous", False):
         # a continuous fleet's waves dispatch the vmapped sched-inject
         # scan: that is the entry point to self-report
@@ -741,13 +762,25 @@ def audit_fleet_runner_steps(runner):
                                   reply_cap=runner.reply_log_cap,
                                   donate=donate, shardings=sh),
             args=(runner.sim, inject, kv, flags, flags), **common)
-    return audit_step(spec), [spec.name], []
+    return [spec]
 
 
-def audit_runner_steps(runner):
-    """Self-report variant: audits a LIVE runner's own program/config
-    under its actual donation setting (no as-TPU forcing — the block
-    reports what this run really executed)."""
+def audit_fleet_runner_steps(runner):
+    """Self-report variant for a LIVE FleetRunner: audits the vmapped
+    fleet scan dispatch (`fleet_runner_step_specs`)."""
+    findings: list[Finding] = []
+    names: list[str] = []
+    for spec in fleet_runner_step_specs(runner):
+        findings += audit_step(spec)
+        names.append(spec.name)
+    return findings, names, []
+
+
+def runner_step_specs(runner) -> list[StepSpec]:
+    """Specs for a LIVE runner's own program/config under its actual
+    donation setting (no as-TPU forcing — the self-report blocks
+    describe what this run really executed). Shared by the
+    `static-audit` and `cost` results blocks."""
     import jax.numpy as jnp
 
     from ..net import tpu as T
@@ -759,7 +792,8 @@ def audit_runner_steps(runner):
     sim_sh = sh[0] if sh is not None else None
     tag = type(runner.program).__name__
     common = dict(donate_argnums=(0,) if donate else (),
-                  in_shardings=sim_sh, out_shardings=sim_sh)
+                  in_shardings=sim_sh, out_shardings=sim_sh,
+                  meta={"cfg": runner.cfg})
     specs = [
         StepSpec(name=f"round_fn[{tag}]",
                  fn=make_round_fn(runner.program, runner.cfg,
@@ -783,7 +817,15 @@ def audit_runner_steps(runner):
             args=(runner.sim, inject,
                   jnp.zeros(max(runner.concurrency, 1), jnp.int32),
                   jnp.int32(8), True), **common))
+    return specs
+
+
+def audit_runner_steps(runner):
+    """Self-report variant: audits a LIVE runner's own entry points
+    (`runner_step_specs`)."""
     findings: list[Finding] = []
-    for spec in specs:
+    names: list[str] = []
+    for spec in runner_step_specs(runner):
         findings += audit_step(spec)
-    return findings, [s.name for s in specs], []
+        names.append(spec.name)
+    return findings, names, []

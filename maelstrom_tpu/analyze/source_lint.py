@@ -32,12 +32,21 @@ from . import Finding
 
 # The replay-critical host modules (relative to the package directory):
 # the runner loop, both network paths, the sim composition, nemesis
-# scheduling, and the history/analysis pairing + screening paths.
+# scheduling, and the history/analysis pairing + screening paths —
+# plus the two threaded-worker modules (checkpoint writer, telemetry
+# session) the `thread-shared-mutation` rule covers.
 DEFAULT_LINT_PATHS = (
     "runner", "net", "sim.py", "nemesis.py", "history.py",
     "checkers/pipeline.py", "checkers/linearizable.py",
     "checkers/elle.py", "checkers/elle_device.py",
+    "checkpoint.py", "telemetry.py",
 )
+
+# Classes that pair worker threads with main-thread readers: the
+# `thread-shared-mutation` rule analyzes exactly these (a generic
+# heuristic over every class would drown the gate in false positives).
+THREAD_CLASSES = ("AnalysisPool", "AnalysisPipeline",
+                  "CheckpointWriter", "TelemetrySession")
 
 _RANDOM_DRAWS = {"random", "randint", "randrange", "choice", "choices",
                  "shuffle", "sample", "uniform", "gauss", "betavariate",
@@ -127,11 +136,166 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# ---------------------------------------------------------------------------
+# thread-shared-mutation: unguarded assignment to an attribute that a
+# worker thread of the same class also reads.
+#
+# Worker bodies are found structurally: methods passed as
+# `Thread(target=self.m)` / `pool.submit(self.m)`, nested functions
+# passed as `Thread(target=fn)`, plus the transitive closure over
+# `self.m()` calls from those roots. "Shared" = attributes those
+# bodies READ (`self.x` loads and augmented assigns; method names
+# excluded). A mutation (`self.x = ...` / `self.x += ...`, tuple
+# targets included) anywhere in the class outside `__init__` and
+# outside a `with self.<...lock...>:` block is flagged. Deliberately
+# exempt (documented in doc/analyze.md): mutating METHOD calls
+# (`.append`/`.clear`) and subscript stores (`self.d[k] = v`) — both
+# are container-internal updates whose safety depends on the container,
+# not on attribute rebinding, and flagging them would bury the gate.
+# ---------------------------------------------------------------------------
+
+def _is_self_attr(node) -> bool:
+    return isinstance(node, ast.Attribute) and _is_name(node.value, "self")
+
+
+def _lint_thread_class(cls, relpath: str) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    nested: dict[tuple, ast.FunctionDef] = {}
+    for mname, m in methods.items():
+        for sub in ast.walk(m):
+            if isinstance(sub, ast.FunctionDef) and sub is not m:
+                nested[(mname, sub.name)] = sub
+
+    workers: list = []
+    seen: set[int] = set()
+
+    def add_worker(node):
+        if id(node) not in seen:
+            seen.add(id(node))
+            workers.append(node)
+
+    for mname, m in methods.items():
+        for call in ast.walk(m):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            callee = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if callee == "Thread":
+                for kw in call.keywords:
+                    if kw.arg != "target":
+                        continue
+                    v = kw.value
+                    if _is_self_attr(v) and v.attr in methods:
+                        add_worker(methods[v.attr])
+                    elif isinstance(v, ast.Name) and \
+                            (mname, v.id) in nested:
+                        add_worker(nested[(mname, v.id)])
+            elif callee == "submit" and call.args:
+                v = call.args[0]
+                if _is_self_attr(v) and v.attr in methods:
+                    add_worker(methods[v.attr])
+
+    changed = True
+    while changed:                      # closure over self.m() calls
+        changed = False
+        for node in list(workers):
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call) and \
+                        _is_self_attr(call.func) and \
+                        call.func.attr in methods and \
+                        id(methods[call.func.attr]) not in seen:
+                    add_worker(methods[call.func.attr])
+                    changed = True
+    if not workers:
+        return []
+
+    shared: set[str] = set()
+    for node in workers:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and \
+                    _is_name(sub.value, "self") and \
+                    isinstance(sub.ctx, ast.Load) and \
+                    sub.attr not in methods:
+                shared.add(sub.attr)
+            elif isinstance(sub, ast.AugAssign) and \
+                    _is_self_attr(sub.target):
+                shared.add(sub.target.attr)
+
+    findings: list[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: list[str] = []
+            self.lock = 0
+
+        def _visit_func(self, node):
+            if not self.stack and node.name == "__init__":
+                return              # construction precedes the threads
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_With(self, node):
+            locked = any(
+                _is_self_attr(item.context_expr)
+                and "lock" in item.context_expr.attr.lower()
+                for item in node.items)
+            self.lock += locked
+            self.generic_visit(node)
+            self.lock -= locked
+
+        def _attr_targets(self, t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from self._attr_targets(e)
+            elif isinstance(t, ast.Attribute):
+                yield t
+
+        def _flag(self, a):
+            if self.lock or not self.stack:
+                return
+            if _is_name(a.value, "self") and a.attr in shared:
+                func = f"{cls.name}.{self.stack[-1]}"
+                findings.append(Finding(
+                    rule="thread-shared-mutation", entry="source-lint",
+                    where=f"{relpath}:{a.lineno} ({func})",
+                    key=f"{relpath}:{func}",
+                    detail=f"self.{a.attr} assigned outside a lock; "
+                           f"worker threads of {cls.name} read it"))
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                for a in self._attr_targets(t):
+                    self._flag(a)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            for a in self._attr_targets(node.target):
+                self._flag(a)
+            self.generic_visit(node)
+
+    V().visit(cls)
+    return findings
+
+
+def lint_thread_shared(tree, relpath: str) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in THREAD_CLASSES:
+            out += _lint_thread_class(node, relpath)
+    return out
+
+
 def lint_source(source: str, relpath: str) -> list[Finding]:
     tree = ast.parse(source, filename=relpath)
     v = _Visitor(relpath, source.splitlines())
     v.visit(tree)
-    return v.findings
+    return v.findings + lint_thread_shared(tree, relpath)
 
 
 def lint_file(path: str, relpath: str | None = None) -> list[Finding]:

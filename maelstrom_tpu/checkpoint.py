@@ -148,6 +148,48 @@ FINGERPRINT_KEYS = ("workload", "node", "nodes", "rate", "time_limit",
                     # (the package seed rides `seed`/`nemesis_seed`)
                     "byz_rate", "byz_attacks")
 
+# The EXPLICIT allowlist backing the comment block above: every
+# core.DEFAULTS key that deliberately stays out of FINGERPRINT_KEYS,
+# with the reason. The static gate (analyze.check_fingerprint_coverage,
+# rule `fingerprint-coverage`) fails on any DEFAULTS key in neither
+# list, so a new CLI knob cannot silently skip resume pinning — adding
+# one forces the author to either fingerprint it or justify it here.
+FINGERPRINT_EXEMPT = {
+    "node_count": "derived: build_test expands it into `nodes` (which "
+                  "IS fingerprinted); role-spec programs override it",
+    "consistency_models": "grading-side only: selects checker models "
+                          "over a finished history",
+    "log_stderr": "observability: host logging never touches the op "
+                  "stream",
+    "log_net_send": "observability: wire logging only",
+    "log_net_recv": "observability: wire logging only",
+    "store_root": "durability path: where artifacts land, not what "
+                  "runs",
+    "check_workers": "analysis-side pool sizing (pinned by test_"
+                     "checkpoint_resilience.py::test_fingerprint_"
+                     "excludes_analysis_flags)",
+    "no_overlap": "analysis-side scheduling toggle (same pin)",
+    "device_checker": "grading backend selection: host and device "
+                      "checkers grade the same history",
+    "checkpoint_every": "cadence is observationally neutral for "
+                        "round-synchronous runs; fingerprint() adds it "
+                        "conditionally for --continuous",
+    "resume": "the resume pointer itself",
+    "sync_checkpoint": "durability-side write scheduling (same pin as "
+                       "check_workers)",
+    "on_preempt": "durability-side signal policy (same pin)",
+    "audit": "static-analysis results block toggle",
+    "audit_trace": "static-analysis trace depth toggle",
+    "telemetry": "fingerprint() folds the ring on/off BOOLEAN in as "
+                 "telemetry_rings; the output directory may move "
+                 "between launches",
+    "availability_dip_rounds": "checker threshold: grades the window, "
+                               "never shapes it",
+    "sessions": "coroutine and columnar session backends are "
+                "byte-identical and emit the same checkpoint-meta "
+                "shapes (pinned by tests/test_sessions.py)",
+}
+
 
 class CheckpointError(RuntimeError):
     """A checkpoint could not be written or loaded (torn/truncated file,

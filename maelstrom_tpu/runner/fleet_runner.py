@@ -1050,8 +1050,12 @@ def run_fleet_test(test: dict, test_dir: str) -> dict:
     # would repeat the identical trace F times
     if test.get("audit", True) and \
             os.environ.get("MAELSTROM_AUDIT") != "0":
-        from ..analyze import audit_fleet_runner
+        from ..analyze import audit_fleet_runner, cost_fleet_runner
         results["static-audit"] = audit_fleet_runner(
+            runner, trace=bool(test.get("audit_trace")))
+        # ONE cost block likewise (doc/analyze.md "cost model"):
+        # roofline totals for the shared vmapped fleet step functions
+        results["cost"] = cost_fleet_runner(
             runner, trace=bool(test.get("audit_trace")))
 
     store.write_history(test_dir, histories[0] if F == 1 else

@@ -334,8 +334,14 @@ class TpuNetStats(Checker):
         import os as _os
         if test.get("audit", True) and \
                 _os.environ.get("MAELSTROM_AUDIT") != "0":
-            from ..analyze import audit_runner
+            from ..analyze import audit_runner, cost_runner
             out["static-audit"] = audit_runner(
+                self.runner, trace=bool(test.get("audit_trace")))
+            # cost self-report (doc/analyze.md "cost model"): static
+            # roofline totals + predicted rounds/s for this run's own
+            # step functions. Same contract as static-audit: memoized
+            # per config, informational, never flips `valid`.
+            out["cost"] = cost_runner(
                 self.runner, trace=bool(test.get("audit_trace")))
         out["valid"] = bool(ok)
         return out

@@ -151,6 +151,21 @@ step artifacts/bench-byzantine-r16.json 2400 \
 step artifacts/bench-podmesh-r18.json 2400 \
     env BENCH_MODE=podmesh python bench.py
 
+# 1m. predicted-vs-measured (ISSUE 20, doc/analyze.md "predicted vs
+#     measured"): the fleet, batched-broadcast, and ordering benches
+#     re-run on the TPU backend — every record row now carries a
+#     `predicted` block (static roofline under the active device
+#     profile) with the predicted/measured round-rate ratio stamped
+#     in. These three artifacts are the TPU calibration points for the
+#     cost model's tpu-v4/v5e profiles (the CPU band is committed in
+#     doc/analyze.md; regenerate the table from these when captured)
+step artifacts/bench-fleet-predicted-r20.json 2400 \
+    env BENCH_MODE=fleet python bench.py
+step artifacts/bench-batched-predicted-r20.json 2400 \
+    env BENCH_MODE=broadcast_batched python bench.py
+step artifacts/bench-ordering-predicted-r20.json 2400 \
+    env BENCH_MODE=ordering python bench.py
+
 # 2. raft fleet bench + the DESCRIBED graded config: 512 sampled of
 #    10k clusters, 50 ops/worker, partition nemesis (README claim)
 step artifacts/bench-raft-r5.json 3600 env BENCH_MODE=raft python bench.py

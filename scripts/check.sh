@@ -27,6 +27,19 @@ echo "== static audit: python -m maelstrom_tpu.analyze =="
 python -m maelstrom_tpu.analyze --format "${ANALYZE_FORMAT:-text}" \
     ${ANALYZE_ARGS:-}
 
+# Jaxpr cost auditor (doc/analyze.md "cost model"): roofline records
+# for the same production entry points on the same forced 2-device
+# mesh, gated against analyze/cost_baseline.json — fails on
+# collective-on-dp / carry-growth / hbm-overflow / intensity-regression
+# findings. COST_AUDIT=0 skips (the hazard audit above stays the core).
+if [ "${COST_AUDIT:-1}" = "1" ]; then
+    echo "== cost audit: python -m maelstrom_tpu.analyze --cost =="
+    # shellcheck disable=SC2086
+    python -m maelstrom_tpu.analyze --cost \
+        --format "${ANALYZE_FORMAT:-text}" ${COST_ARGS:-} > /dev/null
+    echo "== cost audit clean =="
+fi
+
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="
     ruff check .

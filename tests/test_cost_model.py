@@ -1,0 +1,346 @@
+"""The jaxpr cost & collective auditor (maelstrom_tpu.analyze.cost_model).
+
+Mirrors the ISSUE 20 acceptance contract:
+
+  - golden cost records: the REAL production round_fn/cscan_fn for
+    lin-kv, broadcast-batched and compartment — plain and (multichip)
+    --mesh 1,2 — with PINNED integer totals, tolerance-free: the model
+    books exact aval bytes and per-equation FLOPs, so any drift is a
+    deliberate model or program change that must re-pin these numbers
+    AND regenerate analyze/cost_baseline.json;
+  - seeded-violation fixtures per rule: a minimal record/step that
+    trips carry-growth, hbm-overflow, intensity-regression and (on a
+    2,2 mesh) collective-on-dp exactly once;
+  - the zero-new-findings gate + baseline round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from maelstrom_tpu.analyze.cost_model import (DeviceProfile, PROFILES,
+                                              cost_findings,
+                                              cost_production, cost_step,
+                                              load_cost_baseline, predict,
+                                              predict_round,
+                                              resolve_profile,
+                                              write_cost_baseline)
+from maelstrom_tpu.analyze.jaxpr_audit import StepSpec
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# golden records: pinned exact totals for the stock production programs
+# ---------------------------------------------------------------------------
+
+# (entry, flops, hbm_read, hbm_written, carry, peak) — exact integers.
+_GOLDEN_PLAIN = {
+    "lin-kv": [
+        ("round_fn[lin-kv]", 33031, 572509, 340417, 512, 131194),
+        ("cscan_fn[lin-kv]", 67682, 1180388, 712433, 57429, 200801),
+    ],
+    "broadcast-batched": [
+        ("round_fn[broadcast-batched]",
+         1425156, 3758834, 2683610, 512, 441016),
+        ("cscan_fn[broadcast-batched]",
+         2934172, 8087404, 5954693, 100162, 624746),
+    ],
+    "compartment": [
+        ("round_fn[compartment]",
+         1482756, 10944030, 6797295, 32768, 642191),
+        ("cscan_fn[compartment]",
+         2966998, 21922156, 13625537, 178638, 826676),
+    ],
+}
+
+
+def _assert_golden(records, pins):
+    for entry, flops, read, written, carry, peak in pins:
+        rec = records[entry]
+        got = (rec["flops"], rec["hbm_bytes_read"],
+               rec["hbm_bytes_written"], rec["carry_bytes"],
+               rec["peak_bytes"])
+        assert got == (flops, read, written, carry, peak), \
+            f"{entry}: {got} != pinned — a model/program change must " \
+            f"re-pin this AND regenerate cost_baseline.json"
+
+
+@pytest.mark.parametrize("program", sorted(_GOLDEN_PLAIN))
+def test_golden_records_plain(program):
+    rep = cost_production(programs=[program], mesh=None, fleet=False,
+                          profile="cpu", baseline={})
+    _assert_golden(rep.records, _GOLDEN_PLAIN[program])
+    # structural rules clean on every stock program
+    assert rules_of(rep.findings) == []
+
+
+@pytest.mark.multichip
+def test_golden_records_mesh_12():
+    """--mesh 1,2: same invariant totals as plain (costs are booked on
+    the UNSHARDED abstract shapes — the model is mesh-invariant for
+    compute/HBM) plus an explicit sp collective-byte column from the
+    GSPMD reshard heuristic."""
+    rep = cost_production(programs=["lin-kv"], mesh="1,2", fleet=False,
+                          profile="cpu", baseline={})
+    rec = rep.records["round_fn[lin-kv@mesh=1,2]"]
+    assert (rec["flops"], rec["hbm_bytes_read"], rec["hbm_bytes_written"],
+            rec["carry_bytes"]) == (33031, 572509, 340417, 512)
+    assert rec["collective_bytes"] == {"sp": 96547}
+    # the sp reshard traffic never counts as a dp hazard
+    assert rec["dp_collectives"] == []
+    assert rules_of(rep.findings) == []
+
+
+def test_record_derived_fields_consistent():
+    rep = cost_production(programs=["lin-kv"], mesh=None, fleet=False,
+                          profile="cpu", baseline={})
+    rec = rep.records["round_fn[lin-kv]"]
+    hbm = rec["hbm_bytes_read"] + rec["hbm_bytes_written"]
+    assert rec["arithmetic_intensity"] == round(rec["flops"] / hbm, 6)
+    assert rec["peak_bytes_donated"] == max(
+        rec["peak_bytes"] - rec["donated_bytes"], 0)
+    assert rec["stretch"]["hbm_bytes"] == hbm * rec["stretch"]["rounds"]
+    pred = rec["predicted"]
+    assert pred["profile"] == "cpu"
+    assert pred["rounds_per_sec"] == round(1.0 / pred["round_s"], 3)
+    # capacity bound: pool vs inbox+client lanes from the run's cfg
+    assert rec["msgs_per_round_cap"] is not None
+    assert pred["msgs_per_sec"] == pytest.approx(
+        rec["msgs_per_round_cap"] * pred["rounds_per_sec"], rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# seeded-violation fixtures: each rule fires exactly once
+# ---------------------------------------------------------------------------
+
+def _scan_record(carry_elems=8, name="fx"):
+    """Cost record for a minimal scan whose carry is carry_elems f32s."""
+    def fn(x):
+        def body(c, _):
+            return c * 2.0, ()
+        c, _ = jax.lax.scan(body, x, None, length=4)
+        return c
+    spec = StepSpec(name=name, fn=fn,
+                    args=(jnp.zeros((carry_elems,), jnp.float32),))
+    return cost_step(spec, "cpu")
+
+
+def test_fixture_carry_growth_fires_once():
+    rec = _scan_record(carry_elems=1024)     # 4096 B carry
+    base = {"profile": "cpu", "entries": {"fx": dict(rec["predicted"])},
+            "carry_budgets": {"fx": 1024}}
+    found = cost_findings({"fx": rec}, baseline=base, profile="cpu")
+    assert rules_of(found) == ["carry-growth"]
+    assert "exceeds budget 1024" in found[0].detail
+    # under the default budget the same record is clean
+    assert cost_findings({"fx": rec}, baseline={}, profile="cpu") == []
+
+
+def test_fixture_hbm_overflow_fires_once():
+    rec = _scan_record(carry_elems=1024)
+    tiny = DeviceProfile("tiny", peak_flops=1e9, hbm_bw=1e9,
+                         ici_bw=1e9, dcn_bw=1e9,
+                         hbm_bytes=64.0,     # smaller than any real peak
+                         dispatch_overhead_s=1e-3)
+    found = cost_findings({"fx": rec}, baseline={}, profile=tiny)
+    assert rules_of(found) == ["hbm-overflow"]
+    assert cost_findings({"fx": rec}, baseline={}, profile="cpu") == []
+
+
+def test_fixture_intensity_regression_fires_once():
+    rec = _scan_record()
+    fast = {"rounds_per_sec": rec["predicted"]["rounds_per_sec"] * 10,
+            "msgs_per_sec": None}
+    base = {"profile": "cpu", "tolerance_pct": 20.0,
+            "entries": {"fx": fast}}
+    found = cost_findings({"fx": rec}, baseline=base, profile="cpu")
+    assert rules_of(found) == ["intensity-regression"]
+    # within tolerance: the same prediction against itself is clean
+    same = {"profile": "cpu", "tolerance_pct": 20.0,
+            "entries": {"fx": dict(rec["predicted"])}}
+    assert cost_findings({"fx": rec}, baseline=same,
+                         profile="cpu") == []
+
+
+def test_fixture_missing_baseline_entry_fires():
+    rec = _scan_record()
+    base = {"profile": "cpu", "entries": {}}
+    found = cost_findings({"fx": rec}, baseline=base, profile="cpu")
+    assert rules_of(found) == ["intensity-regression"]
+    assert "missing from cost_baseline.json" in found[0].detail
+
+
+@pytest.mark.multichip
+def test_fixture_collective_on_dp_fires_once():
+    """An explicit psum over the dp axis inside shard_map on a 2,2
+    mesh — the cross-replica traffic the fleet contract forbids —
+    fires collective-on-dp exactly once; the same psum over sp is a
+    legal shard-parallel reduction and stays quiet."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maelstrom_tpu import parallel
+    mesh = parallel.mesh_from_spec("2,2")
+
+    def over(axis):
+        def fn(x):
+            return shard_map(
+                lambda v: jax.lax.psum(v, axis), mesh,
+                in_specs=P("dp", "sp"), out_specs=P(None, "sp"),
+                check_rep=False)(x)
+        sh = NamedSharding(mesh, P("dp", "sp"))
+        x = jax.device_put(jnp.ones((4, 8), jnp.float32), sh)
+        spec = StepSpec(name=f"fx-{axis}", fn=fn, args=(x,),
+                        in_shardings=sh)
+        rec = cost_step(spec, "cpu")
+        return cost_findings({spec.name: rec}, baseline={},
+                             profile="cpu"), rec
+
+    found_dp, rec_dp = over("dp")
+    assert rules_of(found_dp) == ["collective-on-dp"]
+    assert rec_dp["collective_bytes"].get("dp", 0) > 0
+    found_sp, rec_sp = over("sp")
+    assert rules_of(found_sp) == []
+    assert rec_sp["collective_bytes"].get("sp", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + gate
+# ---------------------------------------------------------------------------
+
+def test_checked_in_cost_baseline_is_well_formed():
+    base = load_cost_baseline()
+    assert base, "analyze/cost_baseline.json missing"
+    assert base["profile"] in PROFILES
+    assert base["entries"], "no entries"
+    assert list(base["entries"]) == sorted(base["entries"]), \
+        "baseline entries must be emitted sorted (clean diffs)"
+    for name, ent in base["entries"].items():
+        assert ent["rounds_per_sec"] > 0, name
+        assert ent["flops"] >= 0 and ent["hbm_bytes"] > 0, name
+
+
+def test_gate_production_lin_kv_clean_vs_checked_in_baseline():
+    """The committed cost_baseline.json covers today's lin-kv entries
+    at a >=20% tolerance: the production trace gates clean."""
+    rep = cost_production(programs=["lin-kv"], mesh=None, fleet=False,
+                          profile="cpu", baseline=load_cost_baseline())
+    assert rep.ok, [f.as_dict() for f in rep.findings]
+
+
+def test_write_cost_baseline_round_trips_sorted(tmp_path):
+    rec = _scan_record(name="zz")
+    rec2 = _scan_record(carry_elems=16, name="aa")
+    path = str(tmp_path / "cost_baseline.json")
+    write_cost_baseline({"zz": rec, "aa": rec2}, path, profile="cpu")
+    data = json.load(open(path))
+    assert list(data["entries"]) == ["aa", "zz"]
+    # tolerance/carry budgets survive a rewrite
+    data["tolerance_pct"] = 35.0
+    data["carry_budgets"] = {"zz": 12345}
+    json.dump(data, open(path, "w"))
+    write_cost_baseline({"zz": rec, "aa": rec2}, path, profile="cpu")
+    data2 = json.load(open(path))
+    assert data2["tolerance_pct"] == 35.0
+    assert data2["carry_budgets"] == {"zz": 12345}
+    # and gating against the round-tripped file is clean
+    assert cost_findings({"zz": rec, "aa": rec2}, baseline=data2,
+                         profile="cpu") == []
+
+
+# ---------------------------------------------------------------------------
+# bench-facing prediction + CLI
+# ---------------------------------------------------------------------------
+
+def test_predict_round_traces_bench_shape_abstractly():
+    from maelstrom_tpu.net import tpu as T
+    from maelstrom_tpu.nodes import get_program
+    nodes = [f"n{i}" for i in range(64)]
+    prog = get_program("broadcast",
+                       {"topology": "grid", "max_values": 4,
+                        "latency": {"mean": 0}}, nodes)
+    cfg = T.NetConfig(n_nodes=64, n_clients=1, pool_cap=256,
+                      inbox_cap=prog.inbox_cap, client_cap=0)
+    rec = predict_round(prog, cfg, profile="cpu", msgs_per_round=10.0)
+    assert rec["flops"] > 0 and rec["hbm_bytes_read"] > 0
+    assert rec["predicted"]["msgs_per_sec"] == round(
+        10.0 * rec["predicted"]["rounds_per_sec"], 3)
+    # fleet vmap multiplies the booked work ~linearly (a few scalar
+    # bookkeeping equations stay unbatched, so not exactly 8x)
+    rec8 = predict_round(prog, cfg, fleet=8, profile="cpu")
+    assert 6 * rec["flops"] < rec8["flops"] <= 8 * rec["flops"]
+    # chunked dispatch amortizes the overhead: strictly faster rounds
+    rec_amort = predict_round(prog, cfg, profile="cpu",
+                              rounds_per_dispatch=64)
+    assert rec_amort["predicted"]["round_s"] < \
+        rec["predicted"]["round_s"]
+
+
+def test_roofline_bound_selection():
+    base = {"flops": 0, "hbm_bytes_read": 0, "hbm_bytes_written": 0,
+            "collective_bytes": {}, "msgs_per_round_cap": None}
+    prof = DeviceProfile("t", peak_flops=10.0, hbm_bw=10.0, ici_bw=10.0,
+                         dcn_bw=10.0, hbm_bytes=1e9,
+                         dispatch_overhead_s=1.0)
+    assert predict(dict(base, flops=30), prof)["round_s"] == 4.0
+    assert predict(dict(base, hbm_bytes_read=50), prof)["round_s"] == 6.0
+    assert predict(dict(base, collective_bytes={"sp": 20}),
+                   prof)["round_s"] == 3.0
+    # dp traffic rides the (slower) DCN lane in the max()
+    slow_dcn = DeviceProfile("t2", peak_flops=10.0, hbm_bw=10.0,
+                             ici_bw=10.0, dcn_bw=1.0, hbm_bytes=1e9,
+                             dispatch_overhead_s=1.0)
+    assert predict(dict(base, collective_bytes={"dp": 20}),
+                   slow_dcn)["round_s"] == 21.0
+
+
+def test_resolve_profile_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown device profile"):
+        resolve_profile("gpu-z9000")
+    assert resolve_profile("tpu-v4").name == "tpu-v4"
+    assert resolve_profile(PROFILES["cpu"]) is PROFILES["cpu"]
+
+
+def test_analyze_cli_cost_json(capsys, tmp_path):
+    from maelstrom_tpu.analyze.cli import main
+    rc = main(["--cost", "--programs", "lin-kv", "--mesh", "none",
+               "--no-fleet", "--format", "json", "--profile", "cpu"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["ok"] is True
+    assert "round_fn[lin-kv]" in out["records"]
+    # --write-cost-baseline emits a fresh gateable file
+    path = str(tmp_path / "cb.json")
+    rc = main(["--cost", "--programs", "lin-kv", "--mesh", "none",
+               "--no-fleet", "--profile", "cpu",
+               "--write-cost-baseline", "--baseline", path])
+    capsys.readouterr()
+    assert rc == 0
+    assert json.load(open(path))["entries"]
+
+
+def test_runner_results_carry_cost_block(tmp_path):
+    """End to end: a CLI-path run's results carry the `cost` block
+    beside `static-audit`, memoized on the second identical config."""
+    from maelstrom_tpu import core
+    res = core.run(dict(store_root=str(tmp_path), seed=5,
+                        workload="echo", node="tpu:echo", node_count=2,
+                        rate=5, time_limit=0.5, journal_rows=False,
+                        audit=True, audit_trace=True))
+    blk = res["net"]["cost"]
+    assert blk["ok"] is True, blk
+    assert blk["records"], blk
+    rec = next(iter(blk["records"].values()))
+    assert rec["flops"] > 0 and rec["predicted"]["rounds_per_sec"] > 0
+    res2 = core.run(dict(store_root=str(tmp_path), seed=6,
+                         workload="echo", node="tpu:echo", node_count=2,
+                         rate=5, time_limit=0.5, journal_rows=False,
+                         audit=True, audit_trace=True))
+    assert res2["net"]["cost"].get("memoized") is True

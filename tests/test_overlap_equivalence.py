@@ -166,12 +166,12 @@ def _run_pair(opts):
 
 def _comparable(res):
     """Checker results minus wall-clock-dependent accounting (the
-    static-audit self-report carries audit wall time + memo state; the
-    windowed-grading blocks carry checker lag, which is wall-clock, and
-    exist only on the overlapped path — the FINAL verdict fields are
-    compared and must match bit-for-bit)."""
+    static-audit and cost self-reports carry audit wall time + memo
+    state; the windowed-grading blocks carry checker lag, which is
+    wall-clock, and exist only on the overlapped path — the FINAL
+    verdict fields are compared and must match bit-for-bit)."""
     drop = {"host-blocked-s", "host-overlapped-s", "host-poll-s",
-            "host-wall-per-wave", "static-audit", "windows",
+            "host-wall-per-wave", "static-audit", "cost", "windows",
             "checker-lag", "check-wall-s"}
     return {name: ({k: v for k, v in r.items() if k not in drop}
                    if isinstance(r, dict) else r)
